@@ -1,0 +1,28 @@
+"""multiverso_tpu — a TPU-native parameter-server training framework.
+
+Brand-new JAX/XLA/pjit implementation of the capabilities of Microsoft
+Multiverso (the DMTK parameter server): sharded model tables in TPU HBM,
+worker Get/Add push-pull in sync (BSP) and async (ASGD) modes, pluggable
+jitted server-side updaters, allreduce model-average mode, checkpoint/resume,
+flags, dashboards, and the reference applications (word2vec, logistic
+regression). See SURVEY.md for the structural map of the reference this
+framework re-implements TPU-first.
+"""
+
+from multiverso_tpu.api import (aggregate, barrier, create_table, get_flag,
+                                init, is_master_worker, num_servers,
+                                num_workers, rank, server_id, set_flag,
+                                shutdown, size, worker_id)
+from multiverso_tpu.core.options import (AddOption, ArrayTableOption,
+                                         GetOption, KVTableOption,
+                                         MatrixTableOption)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "barrier", "rank", "size", "num_workers",
+    "num_servers", "worker_id", "server_id", "is_master_worker",
+    "set_flag", "get_flag", "create_table", "aggregate",
+    "AddOption", "GetOption", "ArrayTableOption", "MatrixTableOption",
+    "KVTableOption",
+]

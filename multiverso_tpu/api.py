@@ -1,0 +1,119 @@
+"""Public API — the ``MV_*`` surface.
+
+Parity with ``include/multiverso/multiverso.h:9-65``: init/shutdown/barrier,
+rank/size/worker/server queries, flag override, table creation (the
+``table_factory`` dispatch, ref ``include/multiverso/table_factory.h:16-26``),
+and allreduce aggregate. TPU-native: ``init`` stands in for
+``jax.distributed``-based bring-up; there is no explicit net bind/connect —
+device discovery is the runtime's job.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+
+from multiverso_tpu.core.options import (AddOption, ArrayTableOption, GetOption,
+                                         KVTableOption, MatrixTableOption,
+                                         TableOption)
+from multiverso_tpu.core.zoo import Zoo
+from multiverso_tpu.parallel import collectives
+from multiverso_tpu.utils import configure
+from multiverso_tpu.utils.log import check
+
+
+def init(argv: Optional[List[str]] = None, sync: Optional[bool] = None,
+         num_local_workers: int = 1,
+         devices: Optional[List[jax.Device]] = None) -> List[str]:
+    """``MV_Init`` analog (ref src/multiverso.cpp:11-16).
+
+    Parses ``-key=value`` flags out of argv (returning the rest), then starts
+    the runtime. ``sync=True`` selects BSP semantics (ref ``-sync`` flag).
+    ``num_local_workers`` configures in-process async worker slots (the
+    analog of running several worker ranks on one host).
+    """
+    if sync is not None:
+        configure.set_flag("sync", bool(sync))
+    return Zoo.get().start(argv, devices=devices,
+                           num_local_workers=num_local_workers)
+
+
+def shutdown(finalize_net: bool = True) -> None:
+    """``MV_ShutDown`` analog."""
+    Zoo.get().stop(finalize_net)
+    Zoo._reset_for_tests()
+
+
+def barrier() -> None:
+    """``MV_Barrier`` analog."""
+    Zoo.get().barrier()
+
+
+def rank() -> int:
+    return Zoo.get().rank()
+
+
+def size() -> int:
+    return Zoo.get().size()
+
+
+def num_workers() -> int:
+    return Zoo.get().num_workers()
+
+
+def num_servers() -> int:
+    return Zoo.get().num_servers()
+
+
+def worker_id() -> int:
+    return Zoo.get().worker_id()
+
+
+def server_id() -> int:
+    return Zoo.get().server_id()
+
+
+def is_master_worker() -> bool:
+    """Rank-0 check (binding parity: ``binding/python/multiverso/api.py:66-75``)."""
+    return worker_id() == 0
+
+
+def set_flag(name: str, value: Any) -> None:
+    """``MV_SetFlag`` analog."""
+    configure.set_flag(name, value)
+
+
+def get_flag(name: str) -> Any:
+    return configure.get_flag(name)
+
+
+def create_table(option: TableOption):
+    """``MV_CreateTable`` + table_factory dispatch
+    (ref include/multiverso/multiverso.h:35-41)."""
+    from multiverso_tpu.tables.array_table import ArrayTable
+    from multiverso_tpu.tables.kv_table import KVTable
+    from multiverso_tpu.tables.matrix_table import MatrixTable
+    from multiverso_tpu.tables.sparse_matrix_table import SparseMatrixTable
+
+    zoo = Zoo.get()
+    check(zoo.started, "call mv.init() first")
+    check(not zoo.ma_mode,
+          "table service is disabled in model-average (-ma) mode "
+          "(ref src/zoo.cpp:49)")
+    if isinstance(option, ArrayTableOption):
+        table = ArrayTable(option)
+    elif isinstance(option, MatrixTableOption):
+        table = (SparseMatrixTable(option) if option.is_sparse
+                 else MatrixTable(option))
+    elif isinstance(option, KVTableOption):
+        table = KVTable(option)
+    else:
+        raise TypeError(f"unknown table option {type(option).__name__}")
+    barrier()  # ref multiverso.h:40: creation is followed by a barrier
+    return table
+
+
+def aggregate(data):
+    """``MV_Aggregate`` analog: allreduce-SUM across processes."""
+    return collectives.aggregate(data)

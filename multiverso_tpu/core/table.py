@@ -1,0 +1,210 @@
+"""Core table machinery: device-resident server store + worker handle.
+
+Reference semantics being reproduced
+(``include/multiverso/table_interface.h:24-75``, ``src/table.cpp``):
+
+* ``WorkerTable``: sync ``Get/Add`` wrap async ops; ``GetAsync/AddAsync``
+  allocate a message id + Waiter; ``Wait(id)`` blocks until every touched
+  server shard replied.
+* ``ServerTable``: sharded storage; every Add runs the pluggable Updater;
+  Get reads current values; ``Store/Load`` serialize for checkpointing.
+
+TPU-native re-design (SURVEY.md §7): the server store is a **sharded
+``jax.Array`` living in HBM** (``NamedSharding`` over the mesh's "server"
+axis) — the shard boundary that the reference expresses with per-server
+processes is expressed here with device shards. ``Add`` dispatches ONE jitted
+donated update kernel (the updater); XLA inserts the ICI collectives the
+layout requires. ``AddAsync`` is therefore nearly free: JAX's async dispatch
+*is* the reference's request pipeline, and ``Wait`` maps to
+``block_until_ready`` — the Waiter/notify machinery collapses into the XLA
+stream. The worker-side Partition (``src/table/array_table.cpp:69-86``) is
+kept as an explicit helper because the async host engine and the parity tests
+need it.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.core.options import AddOption, GetOption
+from multiverso_tpu.core.updater import Updater
+from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.utils.dashboard import monitor
+from multiverso_tpu.utils.log import check
+
+
+class ServerStore:
+    """Device-resident sharded storage for one table + its updater state.
+
+    The analog of one *row* of the reference's per-server ``store_`` vector
+    (``src/server.cpp:23-58``) — except a single store object spans all
+    shards, because XLA owns cross-shard placement.
+    """
+
+    def __init__(self, name: str, shape: Tuple[int, ...], dtype: Any,
+                 updater: Updater, mesh: jax.sharding.Mesh,
+                 num_workers: int, shard_axis: int = 0,
+                 init_array: Optional[np.ndarray] = None):
+        self.name = name
+        self.logical_shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self.updater = updater
+        self.mesh = mesh
+        self.shard_axis = shard_axis
+        self.num_workers = num_workers
+        num_servers = mesh.shape.get(mesh_lib.SERVER_AXIS, 1)
+        self.num_servers = num_servers
+
+        padded = list(self.logical_shape)
+        padded[shard_axis] = mesh_lib.pad_to_multiple(padded[shard_axis],
+                                                      num_servers)
+        self.padded_shape = tuple(padded)
+        self._pad = self.padded_shape[shard_axis] - self.logical_shape[shard_axis]
+
+        self.sharding = mesh_lib.table_sharding(mesh, len(padded), shard_axis)
+        if init_array is None:
+            host = np.zeros(self.padded_shape, dtype=self.dtype)
+        else:
+            check(tuple(init_array.shape) == self.logical_shape,
+                  f"init shape {init_array.shape} != {self.logical_shape}")
+            host = np.zeros(self.padded_shape, dtype=self.dtype)
+            host[tuple(slice(0, s) for s in self.logical_shape)] = init_array
+        self.data = jax.device_put(host, self.sharding)
+
+        # Updater state: shard each leaf along the same logical axis, shifted
+        # by any leading worker axis (AdaGrad's [num_workers, ...] g2).
+        state_host = updater.init_state(self.padded_shape, self.dtype,
+                                        num_workers)
+        self.state = {}
+        for key, leaf in state_host.items():
+            leaf_axis = shard_axis + (leaf.ndim - len(self.padded_shape))
+            leaf_sharding = mesh_lib.table_sharding(mesh, leaf.ndim, leaf_axis)
+            self.state[key] = jax.device_put(leaf, leaf_sharding)
+
+        self._build_kernels()
+        self._lock = threading.Lock()
+
+    # -- jitted kernels ----------------------------------------------------
+    def _build_kernels(self) -> None:
+        updater = self.updater
+        pad = self._pad
+        axis = self.shard_axis
+        ndim = len(self.padded_shape)
+
+        def dense(data, state, delta, *opt):
+            if pad:
+                pads = [(0, 0)] * ndim
+                pads[axis] = (0, pad)
+                delta = jnp.pad(delta, pads)
+            return updater.update_dense(data, state, delta, opt)
+
+        def rows(data, state, row_ids, delta, *opt):
+            return updater.update_rows(data, state, row_ids, delta, opt)
+
+        def access(data):
+            if pad:
+                index = [slice(None)] * ndim
+                index[axis] = slice(0, self.logical_shape[axis])
+                return data[tuple(index)]
+            return data
+
+        def access_rows(data, row_ids):
+            return jnp.take(data, row_ids, axis=axis, mode="clip")
+
+        self._dense_update = jax.jit(dense, donate_argnums=(0, 1))
+        self._row_update = jax.jit(rows, donate_argnums=(0, 1))
+        self._access = jax.jit(access)
+        self._access_rows = jax.jit(access_rows)
+
+    # -- server ops (ref ServerTable::ProcessAdd/ProcessGet) ---------------
+    def apply_dense(self, delta: jax.Array, opt: AddOption) -> None:
+        with self._lock:
+            self.data, self.state = self._dense_update(
+                self.data, self.state, delta, *opt.scalars())
+
+    def apply_rows(self, row_ids: jax.Array, delta: jax.Array,
+                   opt: AddOption) -> None:
+        with self._lock:
+            self.data, self.state = self._row_update(
+                self.data, self.state, row_ids, delta, *opt.scalars())
+
+    def read(self) -> jax.Array:
+        """Logical (unpadded) view of the whole table."""
+        return self._access(self.data)
+
+    def read_rows(self, row_ids: jax.Array) -> jax.Array:
+        return self._access_rows(self.data, row_ids)
+
+    def block(self) -> None:
+        jax.block_until_ready(self.data)
+
+    # -- checkpointing (ref table_interface.h:61-75) -----------------------
+    def store_state(self) -> Dict[str, np.ndarray]:
+        out = {"data": np.asarray(self.read())}
+        for key, leaf in self.state.items():
+            out[f"state/{key}"] = np.asarray(leaf)
+        return out
+
+    def load_state(self, payload: Dict[str, np.ndarray]) -> None:
+        data = payload["data"]
+        host = np.zeros(self.padded_shape, dtype=self.dtype)
+        host[tuple(slice(0, s) for s in self.logical_shape)] = data
+        self.data = jax.device_put(host, self.sharding)
+        for key in list(self.state):
+            saved = payload.get(f"state/{key}")
+            if saved is not None:
+                self.state[key] = jax.device_put(
+                    saved, self.state[key].sharding)
+
+
+class WorkerTable:
+    """Client-side handle: sync wraps async, per-request waiters.
+
+    Ref ``src/table.cpp:27-111``. ``wait`` blocks on the dispatched XLA
+    computation; the reference's counted ``Waiter`` (one notify per touched
+    server) is subsumed by a single sharded computation touching all shards.
+    """
+
+    def __init__(self, store: ServerStore):
+        self.store = store
+        self._msg_id = 0
+        self._pending: Dict[int, Callable[[], Any]] = {}
+        self._lock = threading.Lock()
+        from multiverso_tpu.core.zoo import Zoo
+        self.table_id = Zoo.get().register_table(self)
+
+    # -- waiter bookkeeping ------------------------------------------------
+    def _register(self, resolve: Callable[[], Any]) -> int:
+        with self._lock:
+            self._msg_id += 1
+            msg_id = self._msg_id
+            self._pending[msg_id] = resolve
+        return msg_id
+
+    def wait(self, msg_id: int) -> Any:
+        with self._lock:
+            resolve = self._pending.pop(msg_id, None)
+        check(resolve is not None, f"unknown msg_id {msg_id}")
+        return resolve()
+
+    @property
+    def name(self) -> str:
+        return self.store.name
+
+    def close(self) -> None:
+        with self._lock:
+            self._pending.clear()
+
+
+def default_add_option() -> AddOption:
+    return AddOption()
+
+
+def default_get_option() -> GetOption:
+    return GetOption()

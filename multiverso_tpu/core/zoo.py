@@ -1,0 +1,162 @@
+"""Zoo — the runtime singleton: lifecycle, roles, registry, barrier.
+
+Parity with the reference Zoo (``include/multiverso/zoo.h:19-85``,
+``src/zoo.cpp``): it owns startup/shutdown ordering, node roles, table
+registration, rank/size/worker/server id queries, and the global barrier.
+
+TPU-native re-design: there are no actor threads or an explicit Controller —
+JAX's single-controller/multi-controller runtime replaces node registration
+(``jax.distributed.initialize`` is the RegisterNode/Controller analog,
+ref ``src/controller.cpp:38-80``), a device Mesh replaces the server set, and
+the barrier maps to a cross-process sync. Roles are kept for API/semantics
+parity (``-ps_role``, ref ``src/zoo.cpp:23-35``; ``-ma`` skips the table
+service, ref ``src/zoo.cpp:49``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.utils import configure
+from multiverso_tpu.utils.log import log, check
+
+
+class Role:
+    """Bitmask roles (ref include/multiverso/node.h:6-27)."""
+    NONE = 0
+    WORKER = 1
+    SERVER = 2
+    ALL = 3
+
+    _BY_NAME = {"none": NONE, "worker": WORKER, "server": SERVER,
+                "default": ALL, "all": ALL}
+
+    @classmethod
+    def parse(cls, name: str) -> int:
+        try:
+            return cls._BY_NAME[name.lower()]
+        except KeyError:
+            raise ValueError(f"unknown ps_role '{name}'") from None
+
+    @staticmethod
+    def is_worker(role: int) -> bool:
+        return bool(role & Role.WORKER)
+
+    @staticmethod
+    def is_server(role: int) -> bool:
+        return bool(role & Role.SERVER)
+
+
+class Node:
+    """Membership record (ref include/multiverso/node.h:14-27)."""
+
+    def __init__(self, rank: int, role: int, worker_id: int = -1,
+                 server_id: int = -1):
+        self.rank = rank
+        self.role = role
+        self.worker_id = worker_id
+        self.server_id = server_id
+
+
+class Zoo:
+    _instance: Optional["Zoo"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.started = False
+        self.mesh: Optional[jax.sharding.Mesh] = None
+        self.role: int = Role.ALL
+        self.ma_mode: bool = False
+        self.sync_mode: bool = False
+        self.tables: List[Any] = []
+        self._barrier_count = 0
+        self._num_local_workers = 1
+
+    # -- singleton ---------------------------------------------------------
+    @classmethod
+    def get(cls) -> "Zoo":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = Zoo()
+            return cls._instance
+
+    @classmethod
+    def _reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    # -- lifecycle (ref src/zoo.cpp:41-80) ---------------------------------
+    def start(self, argv: Optional[List[str]] = None,
+              devices: Optional[List[jax.Device]] = None,
+              num_local_workers: int = 1) -> List[str]:
+        check(not self.started, "Zoo already started")
+        remaining = configure.parse_cmd_flags(argv)
+        self.role = Role.parse(configure.get_flag("ps_role"))
+        self.ma_mode = configure.get_flag("ma")
+        self.sync_mode = configure.get_flag("sync")
+        self._num_local_workers = max(1, int(num_local_workers))
+        # Mesh = the server set (unless ma mode, which is allreduce-only —
+        # still build the mesh: aggregate uses it).
+        self.mesh = mesh_lib.build_mesh(devices=devices)
+        self.started = True
+        log.debug("Zoo started: rank %d/%d, %d server shards, sync=%s ma=%s",
+                  self.rank(), self.size(), self.num_servers(),
+                  self.sync_mode, self.ma_mode)
+        return remaining
+
+    def stop(self, finalize_net: bool = True) -> None:
+        del finalize_net
+        if not self.started:
+            return
+        self.barrier()
+        for table in self.tables:
+            close = getattr(table, "close", None)
+            if close:
+                close()
+        self.tables.clear()
+        self.mesh = None
+        self.started = False
+
+    # -- identity (ref include/multiverso/zoo.h:38-50) ---------------------
+    def rank(self) -> int:
+        return jax.process_index()
+
+    def size(self) -> int:
+        return jax.process_count()
+
+    def num_workers(self) -> int:
+        """Total logical workers: processes x local worker threads."""
+        return self.size() * self._num_local_workers
+
+    def num_servers(self) -> int:
+        if self.mesh is None or mesh_lib.SERVER_AXIS not in self.mesh.shape:
+            return 1
+        return self.mesh.shape[mesh_lib.SERVER_AXIS]
+
+    def worker_id(self) -> int:
+        return self.rank() * self._num_local_workers if Role.is_worker(self.role) else -1
+
+    def server_id(self) -> int:
+        return self.rank() if Role.is_server(self.role) else -1
+
+    @property
+    def num_local_workers(self) -> int:
+        return self._num_local_workers
+
+    # -- barrier (ref src/zoo.cpp:164-176) ---------------------------------
+    def barrier(self) -> None:
+        check(self.started, "Zoo not started")
+        self._barrier_count += 1
+        if self.size() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"mv_barrier_{self._barrier_count}")
+
+    # -- table registry (ref src/zoo.cpp:178-186) --------------------------
+    def register_table(self, table: Any) -> int:
+        table_id = len(self.tables)
+        self.tables.append(table)
+        return table_id

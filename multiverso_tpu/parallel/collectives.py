@@ -1,0 +1,57 @@
+"""Allreduce / aggregate — the model-average ("ma") path.
+
+Reference: ``MV_Aggregate`` -> ``MPI_Allreduce(MPI_IN_PLACE, SUM)``
+(``src/multiverso.cpp:53-56``, ``mpi_net.h:147-151``), plus the algorithmic
+``AllreduceEngine`` (Bruck allgather + recursive-halving reduce-scatter,
+``src/net/allreduce_engine.cpp:31-172``) for transports without native
+allreduce.
+
+TPU-native: XLA owns the topology — ``jax.lax.psum`` over ICI replaces the
+hand-written Bruck/halving schedules entirely (SURVEY.md §2.3). Two surfaces:
+
+* :func:`device_allreduce` — in-graph psum over a mesh axis (use inside
+  jitted training steps; this is the hot path).
+* :func:`aggregate` — host-level eager sum across JAX processes, the direct
+  ``MV_Aggregate`` analog for host-resident buffers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.parallel.mesh import SERVER_AXIS
+
+
+def device_allreduce(x: jax.Array, mesh: Mesh,
+                     axis: str = SERVER_AXIS) -> jax.Array:
+    """Sum ``x`` (replicated input, one contribution per device along
+    ``axis``) via psum under shard_map. For in-graph use compose
+    ``jax.lax.psum`` directly inside your own shard_map."""
+    def _sum(v):
+        return jax.lax.psum(v, axis)
+
+    fn = jax.shard_map(_sum, mesh=mesh,
+                   in_specs=P(*([axis] + [None] * (x.ndim - 1))),
+                   out_specs=P(*([None] * x.ndim)))
+    return fn(x)
+
+
+def aggregate(data) -> np.ndarray:
+    """``MV_Aggregate`` analog: elementwise SUM across all JAX processes.
+
+    In a single-process world this is the identity (sum over one
+    contributor), matching ``mpirun -np 1`` semantics of the reference test
+    (``Test/test_allreduce.cpp:11-20``).
+    """
+    arr = np.asarray(data)
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(jnp.asarray(arr))
+    return np.asarray(jnp.sum(gathered, axis=0))

@@ -1,0 +1,81 @@
+"""1-D dense ArrayTable, contiguously sharded over the server axis.
+
+Reference: ``include/multiverso/table/array_table.h``,
+``src/table/array_table.cpp`` — the worker always requests the whole table
+(sentinel key -1, ``array_table.cpp:29-66``); ``Partition`` slices the value
+blob by per-server offsets (``array_table.cpp:69-86``); the server shard
+applies the updater on Add and returns its slice on Get
+(``array_table.cpp:116-141``).
+
+TPU-native: storage is a 1-D ``jax.Array`` sharded contiguously across device
+shards; Add = one jitted donated updater kernel over the sharded array; Get =
+logical read (XLA all-gathers on host transfer). ``partition`` reproduces the
+reference's offset arithmetic for the async host engine and parity tests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from multiverso_tpu.core.options import AddOption, ArrayTableOption, GetOption
+from multiverso_tpu.core.table import ServerStore, WorkerTable
+from multiverso_tpu.core.updater import get_updater
+from multiverso_tpu.core.zoo import Zoo
+from multiverso_tpu.parallel.mesh import reference_server_offsets
+from multiverso_tpu.utils.dashboard import monitor
+from multiverso_tpu.utils.log import check
+
+
+class ArrayTable(WorkerTable):
+    def __init__(self, option: ArrayTableOption):
+        zoo = Zoo.get()
+        check(zoo.started, "call mv.init() before creating tables")
+        updater = get_updater(option.dtype, option.updater)
+        name = option.name or f"array_{len(zoo.tables)}"
+        store = ServerStore(name, (option.size,), option.dtype, updater,
+                            zoo.mesh, zoo.num_workers())
+        super().__init__(store)
+        self.size = option.size
+        self.server_offsets = reference_server_offsets(option.size,
+                                                       store.num_servers)
+
+    # -- get (ref array_table.cpp:29-46) -----------------------------------
+    def get_async(self) -> int:
+        arr = self.store.read()
+        return self._register(lambda: np.asarray(arr))
+
+    def get(self) -> np.ndarray:
+        with monitor("WORKER_TABLE_SYNC_GET"):
+            return self.wait(self.get_async())
+
+    def raw(self) -> jax.Array:
+        """Device-resident logical view (for jitted consumers)."""
+        return self.store.read()
+
+    # -- add (ref array_table.cpp:48-66) -----------------------------------
+    def add_async(self, delta, option: Optional[AddOption] = None) -> int:
+        delta = np.asarray(delta, dtype=self.store.dtype)
+        check(delta.shape == (self.size,),
+              f"delta shape {delta.shape} != ({self.size},)")
+        self.store.apply_dense(delta, option or AddOption())
+        return self._register(lambda: self.store.block())
+
+    def add(self, delta, option: Optional[AddOption] = None) -> None:
+        with monitor("WORKER_TABLE_SYNC_ADD"):
+            self.wait(self.add_async(delta, option))
+
+    # -- parity helper (ref array_table.cpp:69-86) -------------------------
+    def partition(self, values: np.ndarray) -> Dict[int, np.ndarray]:
+        """Slice a whole-table value buffer into per-server pieces using the
+        reference's contiguous offsets."""
+        values = np.asarray(values)
+        out: Dict[int, np.ndarray] = {}
+        offsets = self.server_offsets
+        for sid in range(self.store.num_servers):
+            lo, hi = offsets[sid], offsets[sid + 1]
+            if hi > lo:
+                out[sid] = values[lo:hi]
+        return out

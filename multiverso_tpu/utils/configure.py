@@ -1,0 +1,193 @@
+"""Typed flag registry with ``-key=value`` CLI parsing.
+
+Capability parity with the reference flag system
+(``include/multiverso/util/configure.h:13-114``,
+``src/util/configure.cpp:9-54``): typed registration (int/bool/string/double),
+command-line parsing that *consumes* matched ``-key=value`` args, and
+programmatic override (``MV_SetFlag``, ``src/multiverso.cpp:48-51``).
+
+TPU-native differences: one process-global registry (no per-type template
+stores needed in Python), thread-safe, and values are plain Python objects.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+_TRUE_STRINGS = frozenset({"true", "1", "yes", "on"})
+_FALSE_STRINGS = frozenset({"false", "0", "no", "off"})
+
+
+class FlagError(KeyError):
+    """Unknown flag or bad flag value."""
+
+
+class _Flag:
+    __slots__ = ("name", "type", "value", "default", "description")
+
+    def __init__(self, name: str, typ: type, default: Any, description: str):
+        self.name = name
+        self.type = typ
+        self.value = default
+        self.default = default
+        self.description = description
+
+
+class FlagRegistry:
+    """Process-global typed flag store."""
+
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.RLock()
+
+    def define(self, name: str, typ: type, default: Any, description: str = "") -> None:
+        with self._lock:
+            existing = self._flags.get(name)
+            if existing is not None:
+                # Re-definition with identical type keeps first default
+                # (mirrors static-init registration being idempotent).
+                if existing.type is not typ:
+                    raise FlagError(
+                        f"flag '{name}' already defined with type {existing.type.__name__}"
+                    )
+                return
+            self._flags[name] = _Flag(name, typ, typ(default), description)
+
+    def is_defined(self, name: str) -> bool:
+        with self._lock:
+            return name in self._flags
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            try:
+                return self._flags[name].value
+            except KeyError:
+                raise FlagError(f"flag '{name}' is not defined") from None
+
+    def set(self, name: str, value: Any) -> None:
+        """Programmatic override (``MV_SetFlag`` analog)."""
+        with self._lock:
+            try:
+                flag = self._flags[name]
+            except KeyError:
+                raise FlagError(f"flag '{name}' is not defined") from None
+            flag.value = self._coerce(flag, value)
+
+    def reset(self) -> None:
+        """Restore every flag to its registered default (test isolation)."""
+        with self._lock:
+            for flag in self._flags.values():
+                flag.value = flag.default
+
+    def parse_cmd_flags(self, argv: Optional[List[str]]) -> List[str]:
+        """Parse ``-key=value`` args; return argv with matched args *removed*.
+
+        Mirrors the reference's consuming parse (``src/util/configure.cpp:24-54``):
+        unmatched args are left for the application's own parser.
+        """
+        if not argv:
+            return []
+        remaining: List[str] = []
+        with self._lock:
+            for arg in argv:
+                body = None
+                if arg.startswith("--"):
+                    body = arg[2:]
+                elif arg.startswith("-"):
+                    body = arg[1:]
+                if body and "=" in body:
+                    key, _, raw = body.partition("=")
+                    flag = self._flags.get(key)
+                    if flag is not None:
+                        flag.value = self._coerce(flag, raw)
+                        continue
+                remaining.append(arg)
+        return remaining
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {name: f.value for name, f in sorted(self._flags.items())}
+
+    @staticmethod
+    def _coerce(flag: _Flag, value: Any) -> Any:
+        if flag.type is bool:
+            if isinstance(value, str):
+                lowered = value.strip().lower()
+                if lowered in _TRUE_STRINGS:
+                    return True
+                if lowered in _FALSE_STRINGS:
+                    return False
+                raise FlagError(f"bad bool value '{value}' for flag '{flag.name}'")
+            return bool(value)
+        try:
+            return flag.type(value)
+        except (TypeError, ValueError) as e:
+            raise FlagError(
+                f"bad {flag.type.__name__} value '{value}' for flag '{flag.name}'"
+            ) from e
+
+
+_registry = FlagRegistry()
+
+
+def define_int(name: str, default: int, description: str = "") -> None:
+    _registry.define(name, int, default, description)
+
+
+def define_bool(name: str, default: bool, description: str = "") -> None:
+    _registry.define(name, bool, default, description)
+
+
+def define_string(name: str, default: str, description: str = "") -> None:
+    _registry.define(name, str, default, description)
+
+
+def define_double(name: str, default: float, description: str = "") -> None:
+    _registry.define(name, float, default, description)
+
+
+def get_flag(name: str) -> Any:
+    return _registry.get(name)
+
+
+def set_flag(name: str, value: Any) -> None:
+    _registry.set(name, value)
+
+
+def parse_cmd_flags(argv: Optional[List[str]]) -> List[str]:
+    return _registry.parse_cmd_flags(argv)
+
+
+def reset_flags() -> None:
+    _registry.reset()
+
+
+def describe_flags() -> Dict[str, Any]:
+    return _registry.describe()
+
+
+# ---------------------------------------------------------------------------
+# Core framework flags — names preserved from the reference for config parity.
+# ---------------------------------------------------------------------------
+define_bool("sync", False, "BSP (synchronous) mode; async ASGD otherwise "
+            "(ref src/server.cpp:20)")
+define_bool("ma", False, "model-average mode: skip the table service, use "
+            "allreduce aggregate only (ref src/zoo.cpp:24)")
+define_string("ps_role", "default", "none|worker|server|default "
+              "(ref src/zoo.cpp:23)")
+define_string("updater_type", "default", "default|sgd|adagrad|momentum_sgd "
+              "(ref src/updater/updater.cpp:18)")
+define_int("omp_threads", 4, "host-side update parallelism hint "
+           "(ref src/updater/updater.cpp:19)")
+define_double("backup_worker_ratio", 0.0, "straggler over-provision ratio "
+              "(ref src/server.cpp:21; unused there too)")
+define_int("allocator_alignment", 16, "host buffer alignment "
+           "(ref src/util/allocator.cpp:10)")
+define_string("machine_file", "", "host list for externally-orchestrated "
+              "clusters (ref zmq_net.h:20)")
+define_int("port", 55555, "transport port (ref zmq_net.h:21)")
+# TPU-native additions.
+define_string("mesh_shape", "", "comma 'axis:size' list, e.g. 'server:8'; "
+              "empty = one axis over all devices")
+define_bool("deterministic", False, "force deterministic reductions")

@@ -1,0 +1,111 @@
+"""Named perf counters: Dashboard / Monitor.
+
+Parity with ``include/multiverso/dashboard.h:16-74``: each Monitor tracks
+{invocation count, total elapsed ms, average ms}; the Dashboard is a global
+registry that can display all monitors. The ``MONITOR_BEGIN/END(name)`` macro
+pair becomes the :func:`monitor` context manager / decorator.
+
+TPU note: wall-clock around dispatch measures host time only; jitted work is
+asynchronous. Callers that want device-inclusive timing should block on the
+result (``jax.block_until_ready``) inside the monitored region — the perf
+harness does exactly that.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from typing import Callable, Dict, Iterator, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+
+class Monitor:
+    __slots__ = ("name", "count", "total_ms", "_begin", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total_ms = 0.0
+        self._begin = None
+        self._lock = threading.Lock()
+
+    def begin(self) -> None:
+        self._begin = time.perf_counter()
+
+    def end(self) -> None:
+        if self._begin is None:
+            return
+        elapsed = (time.perf_counter() - self._begin) * 1000.0
+        self._begin = None
+        with self._lock:
+            self.count += 1
+            self.total_ms += elapsed
+
+    def add(self, elapsed_ms: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_ms += elapsed_ms
+
+    @property
+    def average_ms(self) -> float:
+        return self.total_ms / self.count if self.count else 0.0
+
+    def info_string(self) -> str:
+        return (f"[{self.name}] count = {self.count}, total = {self.total_ms:.2f}ms, "
+                f"average = {self.average_ms:.3f}ms")
+
+
+class Dashboard:
+    _monitors: Dict[str, Monitor] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls, name: str) -> Monitor:
+        with cls._lock:
+            monitor = cls._monitors.get(name)
+            if monitor is None:
+                monitor = cls._monitors[name] = Monitor(name)
+            return monitor
+
+    @classmethod
+    def watch(cls, name: str) -> str:
+        return cls.get(name).info_string()
+
+    @classmethod
+    def display(cls) -> str:
+        with cls._lock:
+            lines = [m.info_string() for m in cls._monitors.values()]
+        report = "\n".join(lines)
+        if report:
+            print(report)
+        return report
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._monitors.clear()
+
+
+@contextlib.contextmanager
+def monitor(name: str) -> Iterator[Monitor]:
+    """``MONITOR_BEGIN(name) ... MONITOR_END(name)`` as a context manager."""
+    m = Dashboard.get(name)
+    m.begin()
+    try:
+        yield m
+    finally:
+        m.end()
+
+
+def monitored(name: str) -> Callable[[F], F]:
+    """Decorator form for hot functions."""
+    def wrap(fn: F) -> F:
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with monitor(name):
+                return fn(*args, **kwargs)
+        return inner  # type: ignore[return-value]
+    return wrap

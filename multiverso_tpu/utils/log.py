@@ -1,0 +1,98 @@
+"""Leveled logging + CHECK macros.
+
+Parity with the reference logger (``include/multiverso/util/log.h:9-142``):
+Debug/Info/Error/Fatal levels, optional file sink, Fatal kills the process
+(toggleable), and ``check``/``check_notnull`` assertion helpers that route to
+Fatal.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+
+class LogLevel(enum.IntEnum):
+    DEBUG = 0
+    INFO = 1
+    ERROR = 2
+    FATAL = 3
+
+
+class FatalError(RuntimeError):
+    """Raised by Log.fatal when kill-on-fatal is disabled."""
+
+
+class Logger:
+    def __init__(self, level: LogLevel = LogLevel.INFO):
+        self._level = level
+        self._file = None
+        self._kill_fatal = False  # raise by default; os._exit if enabled
+        self._lock = threading.Lock()
+
+    # -- configuration -----------------------------------------------------
+    def set_level(self, level: LogLevel) -> None:
+        self._level = LogLevel(level)
+
+    def get_level(self) -> LogLevel:
+        return self._level
+
+    def set_log_file(self, path: Optional[str]) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            if path:
+                self._file = open(path, "a", buffering=1)
+
+    def set_kill_fatal(self, kill: bool) -> None:
+        self._kill_fatal = bool(kill)
+
+    # -- emit --------------------------------------------------------------
+    def _emit(self, level: LogLevel, msg: str, *args: Any) -> None:
+        if level < self._level:
+            return
+        if args:
+            msg = msg % args
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime())
+        line = f"[{level.name}] [{stamp}] [{os.getpid()}] {msg}"
+        with self._lock:
+            stream = sys.stderr if level >= LogLevel.ERROR else sys.stdout
+            print(line, file=stream)
+            if self._file is not None:
+                self._file.write(line + "\n")
+
+    def debug(self, msg: str, *args: Any) -> None:
+        self._emit(LogLevel.DEBUG, msg, *args)
+
+    def info(self, msg: str, *args: Any) -> None:
+        self._emit(LogLevel.INFO, msg, *args)
+
+    def error(self, msg: str, *args: Any) -> None:
+        self._emit(LogLevel.ERROR, msg, *args)
+
+    def fatal(self, msg: str, *args: Any) -> None:
+        self._emit(LogLevel.FATAL, msg, *args)
+        if self._kill_fatal:
+            os._exit(1)
+        raise FatalError(msg % args if args else msg)
+
+
+log = Logger()
+
+
+def check(condition: Any, msg: str = "CHECK failed") -> None:
+    """``CHECK`` macro analog (ref log.h:9-13)."""
+    if not condition:
+        log.fatal("%s", msg)
+
+
+def check_notnull(value: Any, name: str = "value") -> Any:
+    """``CHECK_NOTNULL`` analog (ref log.h:15-18)."""
+    if value is None:
+        log.fatal("'%s' must not be None", name)
+    return value

@@ -1,0 +1,61 @@
+"""Flag system tests (reference altitude: configure.h / MV_SetFlag paths)."""
+
+import pytest
+
+from multiverso_tpu.utils import configure
+
+
+def test_core_flags_defined():
+    for name in ["sync", "ma", "ps_role", "updater_type", "omp_threads",
+                 "backup_worker_ratio", "machine_file", "port"]:
+        assert configure._registry.is_defined(name)
+
+
+def test_defaults():
+    assert configure.get_flag("sync") is False
+    assert configure.get_flag("updater_type") == "default"
+    assert configure.get_flag("omp_threads") == 4
+
+
+def test_parse_consumes_matched_args():
+    remaining = configure.parse_cmd_flags(
+        ["prog", "-sync=true", "-updater_type=adagrad", "-not_a_flag=1",
+         "positional"])
+    assert remaining == ["prog", "-not_a_flag=1", "positional"]
+    assert configure.get_flag("sync") is True
+    assert configure.get_flag("updater_type") == "adagrad"
+
+
+def test_double_dash_and_types():
+    configure.parse_cmd_flags(["--port=1234", "--backup_worker_ratio=0.5"])
+    assert configure.get_flag("port") == 1234
+    assert configure.get_flag("backup_worker_ratio") == 0.5
+
+
+def test_set_flag_coercion():
+    configure.set_flag("sync", "1")
+    assert configure.get_flag("sync") is True
+    configure.set_flag("sync", "off")
+    assert configure.get_flag("sync") is False
+    configure.set_flag("omp_threads", "8")
+    assert configure.get_flag("omp_threads") == 8
+
+
+def test_unknown_flag_raises():
+    with pytest.raises(configure.FlagError):
+        configure.get_flag("nonexistent_flag")
+    with pytest.raises(configure.FlagError):
+        configure.set_flag("nonexistent_flag", 1)
+
+
+def test_bad_value_raises():
+    with pytest.raises(configure.FlagError):
+        configure.set_flag("port", "not_an_int")
+    with pytest.raises(configure.FlagError):
+        configure.set_flag("sync", "maybe")
+
+
+def test_reset_restores_defaults():
+    configure.set_flag("port", 9999)
+    configure.reset_flags()
+    assert configure.get_flag("port") == 55555

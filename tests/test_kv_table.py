@@ -1,0 +1,44 @@
+"""KVTable tests (ref include/multiverso/table/kv_table.h semantics)."""
+
+import numpy as np
+
+import multiverso_tpu as mv
+
+
+def test_add_then_get(mv_env):
+    t = mv.create_table(mv.KVTableOption())
+    t.add([1, 5, 9], [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(t.get([1, 5, 9]), [1.0, 2.0, 3.0])
+    t.add([5], [10.0])  # += semantics (ref kv_table.h:86-93)
+    np.testing.assert_allclose(t.get([5]), [12.0])
+
+
+def test_missing_keys_are_zero(mv_env):
+    t = mv.create_table(mv.KVTableOption())
+    np.testing.assert_allclose(t.get([42]), [0.0])
+
+
+def test_worker_cache(mv_env):
+    t = mv.create_table(mv.KVTableOption())
+    t.add([7], [3.5])
+    t.get([7])
+    assert t.raw()[7] == 3.5  # local cache (ref kv_table.h:30-40)
+
+
+def test_partition_by_hash(mv_env):
+    t = mv.create_table(mv.KVTableOption())
+    keys = list(range(100))
+    parts = t.partition(keys)
+    n = mv.num_servers()
+    assert sum(len(v) for v in parts.values()) == 100
+    for sid, ks in parts.items():
+        assert all(int(k) % n == sid for k in ks)  # ref kv_table.h:48-50
+
+
+def test_store_load_roundtrip(mv_env):
+    t = mv.create_table(mv.KVTableOption())
+    t.add([1, 2, 3], [1.0, 2.0, 3.0])
+    snap = t.store_state()
+    t.add([1], [100.0])
+    t.load_state(snap)
+    np.testing.assert_allclose(t.get([1, 2, 3]), [1.0, 2.0, 3.0])

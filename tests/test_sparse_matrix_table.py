@@ -1,0 +1,65 @@
+"""SparseMatrixTable staleness semantics
+(ref src/table/sparse_matrix_table.cpp:184-258)."""
+
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.options import GetOption
+
+
+def _make(mv, **kw):
+    return mv.create_table(
+        mv.MatrixTableOption(num_row=10, num_col=4, is_sparse=True, **kw))
+
+
+def test_initially_all_rows_stale(mv_env):
+    t = _make(mv)
+    rows, values = t.get_stale(GetOption(worker_id=0))
+    assert len(rows) == 10
+    assert np.all(values == 0)
+    # second get: nothing stale
+    rows2, _ = t.get_stale(GetOption(worker_id=0))
+    assert len(rows2) == 0
+
+
+def test_add_invalidates_other_workers_only(mv_env):
+    t = _make(mv)
+    # drain initial staleness for worker 0
+    t.get_stale(GetOption(worker_id=0))
+    # worker 0 adds rows 2,3 — its own view stays fresh
+    # (ref sparse_matrix_table.cpp:200-223)
+    t.add_rows([2, 3], np.ones((2, 4), dtype=np.float32),
+               mv.AddOption(worker_id=0))
+    rows, _ = t.get_stale(GetOption(worker_id=0))
+    assert len(rows) == 0
+
+
+def test_incremental_whole_get_with_cache(mv_env):
+    t = _make(mv)
+    opt = GetOption(worker_id=0)
+    first = t.get(opt)
+    assert np.all(first == 0)
+    t.add_rows([5], np.full((1, 4), 7.0, dtype=np.float32),
+               mv.AddOption(worker_id=1))  # another worker's add
+    second = t.get(opt)
+    expected = np.zeros((10, 4), dtype=np.float32)
+    expected[5] = 7.0
+    np.testing.assert_allclose(second, expected)
+    # only row 5 crossed the wire: staleness was exactly {5}
+    t.add_rows([1], np.ones((1, 4), dtype=np.float32),
+               mv.AddOption(worker_id=1))
+    stale = t.stale_rows(0)
+    np.testing.assert_array_equal(stale, [1])
+
+
+def test_dense_add_invalidates_everything(mv_env):
+    t = _make(mv)
+    t.get_stale(GetOption(worker_id=0))
+    t.add(np.ones((10, 4), dtype=np.float32), mv.AddOption(worker_id=1))
+    assert len(t.stale_rows(0)) == 10
+
+
+def test_pipeline_doubles_slots(mv_env):
+    t = _make(mv, is_pipeline=True)
+    # ref sparse_matrix_table.cpp:184-197: bitmap doubled when pipelining
+    assert t._stale.shape[0] == 2 * mv.num_workers()

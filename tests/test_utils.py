@@ -1,0 +1,57 @@
+"""Log / CHECK / Timer / Dashboard tests (reference unittest altitude)."""
+
+import time
+
+import pytest
+
+from multiverso_tpu.utils.dashboard import Dashboard, monitor, monitored
+from multiverso_tpu.utils.log import FatalError, check, check_notnull, log
+from multiverso_tpu.utils.timer import Timer
+
+
+def test_check_passes_and_fails():
+    check(True)
+    with pytest.raises(FatalError):
+        check(False, "boom")
+
+
+def test_check_notnull():
+    assert check_notnull(5) == 5
+    with pytest.raises(FatalError):
+        check_notnull(None, "thing")
+
+
+def test_timer_elapses():
+    t = Timer()
+    time.sleep(0.01)
+    assert t.elapse() >= 5.0  # ms
+    t.start()
+    assert t.elapse() < 5.0
+
+
+def test_monitor_counts():
+    with monitor("unit_test_op"):
+        time.sleep(0.005)
+    with monitor("unit_test_op"):
+        time.sleep(0.005)
+    m = Dashboard.get("unit_test_op")
+    assert m.count == 2
+    assert m.total_ms >= 5.0
+    assert m.average_ms > 0
+    assert "unit_test_op" in Dashboard.watch("unit_test_op")
+
+
+def test_monitored_decorator():
+    @monitored("deco_op")
+    def f(x):
+        return x * 2
+
+    assert f(21) == 42
+    assert Dashboard.get("deco_op").count == 1
+
+
+def test_display_contains_all():
+    Dashboard.get("a").add(1.0)
+    Dashboard.get("b").add(2.0)
+    report = Dashboard.display()
+    assert "[a]" in report and "[b]" in report
