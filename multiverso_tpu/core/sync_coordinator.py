@@ -1,0 +1,99 @@
+"""BSP consistency: the SyncServer / VectorClock semantics.
+
+Reference: ``src/server.cpp:68-222`` — in ``-sync=true`` mode the server keeps
+per-worker vector clocks for Gets and Adds, caches out-of-clock requests, and
+drains them when lagging workers catch up, guaranteeing **every worker's i-th
+Get sees identical parameters** (``src/server.cpp:61-67``).
+``Server_Finish_Train`` sets a finished worker's clock to infinity so
+stragglers can't deadlock shutdown (``src/server.cpp:190-213``).
+
+TPU-native: with all workers inside one jitted SPMD step this guarantee is
+free; it matters for the *host-driven* mode where independent worker threads
+(or processes) issue Get/Add against the shared device store. The gating rule
+distilled from the reference's clock algebra:
+
+* Add #a from worker w may be **applied** only once every active worker has
+  completed Get #(a-1) — otherwise a fast worker's next-round add would
+  contaminate a slow worker's current-round view.
+* Get #g from worker w may be **served** only once every active worker's Add
+  count >= g — so the g-th view contains exactly g adds from everyone.
+
+Implemented as a condition-variable-guarded pair of clock vectors rather than
+message caching (threads can simply block; the reference had to cache because
+actors must not block their mailbox loop).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List
+
+from multiverso_tpu.utils.log import check
+
+
+class VectorClock:
+    """Per-worker monotonic counters with infinity masking
+    (ref src/server.cpp:81-139)."""
+
+    INF = float("inf")
+
+    def __init__(self, n: int):
+        self._clock: List[float] = [0.0] * n
+
+    def tick(self, i: int) -> None:
+        if self._clock[i] != self.INF:
+            self._clock[i] += 1
+
+    def finish(self, i: int) -> None:
+        self._clock[i] = self.INF
+
+    def min(self) -> float:
+        active = [c for c in self._clock if c != self.INF]
+        return min(active) if active else self.INF
+
+    def value(self, i: int) -> float:
+        return self._clock[i]
+
+
+class SyncCoordinator:
+    """One per table in sync mode; gates worker threads per the BSP rule."""
+
+    def __init__(self, num_workers: int):
+        check(num_workers >= 1, "need at least one worker")
+        self.num_workers = num_workers
+        self._adds = VectorClock(num_workers)
+        self._gets = VectorClock(num_workers)
+        self._cv = threading.Condition()
+
+    # -- gates -------------------------------------------------------------
+    def before_add(self, worker_id: int, timeout: float = 60.0) -> None:
+        """Block until this worker's next add is in-clock, then tick."""
+        with self._cv:
+            target = self._adds.value(worker_id)  # this will be add #target+1
+            ok = self._cv.wait_for(
+                lambda: self._gets.min() >= target or
+                self._adds.value(worker_id) == VectorClock.INF,
+                timeout)
+            check(ok, f"sync add gate timed out (worker {worker_id})")
+            self._adds.tick(worker_id)
+            self._cv.notify_all()
+
+    def before_get(self, worker_id: int, timeout: float = 60.0) -> None:
+        """Block until every active worker's add count reaches this worker's
+        next get index, then tick."""
+        with self._cv:
+            target = self._gets.value(worker_id) + 1
+            ok = self._cv.wait_for(
+                lambda: self._adds.min() >= target or
+                self._gets.value(worker_id) == VectorClock.INF,
+                timeout)
+            check(ok, f"sync get gate timed out (worker {worker_id})")
+            self._gets.tick(worker_id)
+            self._cv.notify_all()
+
+    def finish_train(self, worker_id: int) -> None:
+        """``Server_Finish_Train`` analog (ref src/server.cpp:190-213)."""
+        with self._cv:
+            self._adds.finish(worker_id)
+            self._gets.finish(worker_id)
+            self._cv.notify_all()

@@ -177,7 +177,29 @@ class WorkerTable:
         self._pending: Dict[int, Callable[[], Any]] = {}
         self._lock = threading.Lock()
         from multiverso_tpu.core.zoo import Zoo
-        self.table_id = Zoo.get().register_table(self)
+        zoo = Zoo.get()
+        self.table_id = zoo.register_table(self)
+        # BSP gating (SyncServer semantics) when multiple workers share the
+        # host-driven path (ref src/server.cpp:68-222).
+        self._sync = None
+        if zoo.sync_mode and zoo.num_workers() > 1:
+            from multiverso_tpu.core.sync_coordinator import SyncCoordinator
+            self._sync = SyncCoordinator(zoo.num_workers())
+
+    # -- BSP gates (no-ops in async mode / single-worker worlds) -----------
+    def _gate_add(self, option: Optional[AddOption]) -> None:
+        if self._sync is not None:
+            self._sync.before_add(option.worker_id if option else 0)
+
+    def _gate_get(self, option: Optional[GetOption]) -> None:
+        if self._sync is not None:
+            self._sync.before_get(option.worker_id if option else 0)
+
+    def finish_train(self, worker_id: int) -> None:
+        """``Zoo::FinishTrain`` analog (ref src/zoo.cpp:152-161): release a
+        finished worker from the BSP clocks so stragglers can drain."""
+        if self._sync is not None:
+            self._sync.finish_train(worker_id)
 
     # -- waiter bookkeeping ------------------------------------------------
     def _register(self, resolve: Callable[[], Any]) -> int:

@@ -1,0 +1,138 @@
+"""Async ASGD engine: host-staged delta aggregation + worker pool.
+
+The reference's async path is per-request: every worker Add is a message the
+server applies immediately (``src/worker.cpp:53-76``, ``src/server.cpp:36-58``).
+On TPU, per-request device dispatch wastes the chip — the idiomatic design
+(SURVEY.md §7 "hard parts (a)") is: worker threads accumulate deltas into a
+**native striped-lock host buffer** (no GIL, C++ merge loop — the analog of
+the reference's OpenMP updater loop), and a drain applies ONE merged jitted
+update to the sharded device table. ASGD semantics are preserved: workers
+never wait for each other, gets see whatever has been applied, and the
+staging window is bounded by ``flush_pending`` / an explicit flush (a get
+always flushes first, so a worker reads its own writes).
+
+Staging merges deltas by summation, which is exact for the accumulating
+updaters (default add / SGD). For stateful updaters (momentum, adagrad) the
+engine bypasses staging and applies per-request, matching reference behavior
+exactly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from multiverso_tpu.core.options import AddOption, GetOption
+from multiverso_tpu.core.updater import SGDUpdater, Updater
+from multiverso_tpu.runtime.ffi import DeltaBuffer
+from multiverso_tpu.utils.dashboard import monitor
+from multiverso_tpu.utils.log import check
+
+
+def _stageable(updater: Updater) -> bool:
+    return type(updater) in (Updater, SGDUpdater)
+
+
+class AsyncTableEngine:
+    """Wraps an ArrayTable or MatrixTable with staged async adds."""
+
+    def __init__(self, table: Any, flush_pending: int = 64,
+                 sparse_drain_max: int = 4096):
+        self.table = table
+        store = table.store
+        check(store.dtype == np.float32,
+              "async staging supports float32 tables")
+        shape = store.logical_shape
+        rows = shape[0]
+        cols = shape[1] if len(shape) > 1 else 1
+        self._is_matrix = len(shape) > 1
+        self._buf = DeltaBuffer(rows, cols)
+        self._staged = _stageable(store.updater)
+        # SGD negates on the server; stage the raw delta and let the updater
+        # negate the merged sum (both are linear).
+        self.flush_pending = flush_pending
+        self.sparse_drain_max = sparse_drain_max
+        self._flush_lock = threading.Lock()
+
+    # -- async ops ---------------------------------------------------------
+    def add_async(self, delta, option: Optional[AddOption] = None) -> None:
+        if not self._staged:
+            self.table.add_async(delta, option)
+            return
+        with monitor("ASYNC_STAGE_ADD"):
+            self._buf.add_dense(np.asarray(delta, dtype=np.float32))
+        if self._buf.pending >= self.flush_pending:
+            self.flush()
+
+    def add_rows_async(self, row_ids, deltas,
+                       option: Optional[AddOption] = None) -> None:
+        if not self._staged:
+            self.table.add_rows_async(row_ids, deltas, option)
+            return
+        with monitor("ASYNC_STAGE_ADD"):
+            self._buf.add_rows(np.asarray(row_ids, dtype=np.int32),
+                               np.asarray(deltas, dtype=np.float32))
+        if self._buf.pending >= self.flush_pending:
+            self.flush()
+
+    # -- flush: one merged jitted update -----------------------------------
+    def flush(self) -> None:
+        if not self._staged:
+            return
+        with self._flush_lock:
+            if self._buf.pending == 0:
+                return
+            with monitor("ASYNC_FLUSH"):
+                if self._is_matrix:
+                    sparse = self._buf.drain_rows(self.sparse_drain_max)
+                    if sparse is not None:
+                        ids, rows = sparse
+                        if len(ids):
+                            self.table.store.apply_rows(ids, rows, AddOption())
+                        return
+                merged, n = self._buf.drain_dense()
+                if n:
+                    self.table.store.apply_dense(merged, AddOption())
+
+    # -- reads (read-your-writes) ------------------------------------------
+    def get(self, *args, **kwargs) -> np.ndarray:
+        self.flush()
+        return self.table.get(*args, **kwargs)
+
+    def get_rows(self, row_ids) -> np.ndarray:
+        self.flush()
+        return self.table.get_rows(row_ids)
+
+    @property
+    def pending(self) -> int:
+        return self._buf.pending
+
+
+class WorkerPool:
+    """Run ``fn(worker_id)`` on N threads — the analog of N worker ranks
+    sharing one host (reference: ``mpirun -np N`` on one box, SURVEY.md §4)."""
+
+    def __init__(self, num_workers: int):
+        self.num_workers = num_workers
+
+    def run(self, fn: Callable[[int], Any]) -> List[Any]:
+        results: List[Any] = [None] * self.num_workers
+        errors: List[BaseException] = []
+
+        def _runner(wid: int) -> None:
+            try:
+                results[wid] = fn(wid)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors.append(e)
+
+        threads = [threading.Thread(target=_runner, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return results

@@ -43,13 +43,14 @@ class ArrayTable(WorkerTable):
                                                        store.num_servers)
 
     # -- get (ref array_table.cpp:29-46) -----------------------------------
-    def get_async(self) -> int:
+    def get_async(self, option: Optional[GetOption] = None) -> int:
+        self._gate_get(option)
         arr = self.store.read()
         return self._register(lambda: np.asarray(arr))
 
-    def get(self) -> np.ndarray:
+    def get(self, option: Optional[GetOption] = None) -> np.ndarray:
         with monitor("WORKER_TABLE_SYNC_GET"):
-            return self.wait(self.get_async())
+            return self.wait(self.get_async(option))
 
     def raw(self) -> jax.Array:
         """Device-resident logical view (for jitted consumers)."""
@@ -60,6 +61,7 @@ class ArrayTable(WorkerTable):
         delta = np.asarray(delta, dtype=self.store.dtype)
         check(delta.shape == (self.size,),
               f"delta shape {delta.shape} != ({self.size},)")
+        self._gate_add(option)
         self.store.apply_dense(delta, option or AddOption())
         return self._register(lambda: self.store.block())
 

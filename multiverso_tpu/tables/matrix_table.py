@@ -54,13 +54,14 @@ class MatrixTable(WorkerTable):
         self.num_row_each = max(1, self.num_row // self.num_servers)
 
     # -- whole-table ops (sentinel key -1 in the reference) ----------------
-    def get_async(self) -> int:
+    def get_async(self, option: Optional[GetOption] = None) -> int:
+        self._gate_get(option)
         arr = self.store.read()
         return self._register(lambda: np.asarray(arr))
 
-    def get(self) -> np.ndarray:
+    def get(self, option: Optional[GetOption] = None) -> np.ndarray:
         with monitor("WORKER_TABLE_SYNC_GET"):
-            return self.wait(self.get_async())
+            return self.wait(self.get_async(option))
 
     def raw(self) -> jax.Array:
         return self.store.read()
@@ -69,6 +70,7 @@ class MatrixTable(WorkerTable):
         delta = np.asarray(delta, dtype=self.store.dtype)
         check(delta.shape == (self.num_row, self.num_col),
               f"delta shape {delta.shape} != {(self.num_row, self.num_col)}")
+        self._gate_add(option)
         self.store.apply_dense(delta, option or AddOption())
         return self._register(lambda: self.store.block())
 
@@ -77,14 +79,17 @@ class MatrixTable(WorkerTable):
             self.wait(self.add_async(delta, option))
 
     # -- row ops (ref matrix_table.h:25-75) --------------------------------
-    def get_rows_async(self, row_ids) -> int:
+    def get_rows_async(self, row_ids,
+                       option: Optional[GetOption] = None) -> int:
         row_ids = np.asarray(row_ids, dtype=np.int32)
+        self._gate_get(option)
         arr = self.store.read_rows(row_ids)
         return self._register(lambda: np.asarray(arr))
 
-    def get_rows(self, row_ids) -> np.ndarray:
+    def get_rows(self, row_ids, option: Optional[GetOption] = None
+                 ) -> np.ndarray:
         with monitor("WORKER_TABLE_SYNC_GET"):
-            return self.wait(self.get_rows_async(row_ids))
+            return self.wait(self.get_rows_async(row_ids, option))
 
     def get_row(self, row_id: int) -> np.ndarray:
         return self.get_rows([row_id])[0]
@@ -96,6 +101,7 @@ class MatrixTable(WorkerTable):
         check(deltas.shape == (len(row_ids), self.num_col),
               f"row delta shape {deltas.shape} != "
               f"{(len(row_ids), self.num_col)}")
+        self._gate_add(option)
         self.store.apply_rows(row_ids, deltas, option or AddOption())
         return self._register(lambda: self.store.block())
 

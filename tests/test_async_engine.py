@@ -1,0 +1,118 @@
+"""Async ASGD engine + native runtime tests."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.parallel.async_engine import AsyncTableEngine, WorkerPool
+from multiverso_tpu.runtime import ffi
+
+
+def test_native_queue_and_waiter():
+    q = ffi.MtQueue()
+    q.push(7)
+    q.push(8)
+    assert len(q) == 2
+    assert q.pop(100) == 7
+    assert q.pop(100) == 8
+    assert q.pop(10) is None  # timeout
+    q.exit()
+    assert q.pop(-1) is None  # poison releases blocked pop
+
+    w = ffi.Waiter(3)
+    assert not w.wait(10)
+    for _ in range(3):
+        w.notify()
+    assert w.wait(100)
+    w.reset(1)
+    assert not w.wait(10)
+
+
+def test_delta_buffer_threaded_accumulation():
+    import threading
+    buf = ffi.DeltaBuffer(64, 4)
+    n_threads, n_adds = 8, 100
+
+    def hammer():
+        d = np.ones((64, 4), dtype=np.float32)
+        for _ in range(n_adds):
+            buf.add_dense(d)
+
+    ts = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    merged, count = buf.drain_dense()
+    assert count == n_threads * n_adds
+    np.testing.assert_allclose(merged, np.full((64, 4),
+                                               float(n_threads * n_adds)))
+
+
+def test_async_staged_array(mv_env):
+    table = mv.create_table(mv.ArrayTableOption(size=32))
+    eng = AsyncTableEngine(table, flush_pending=1000)
+    d = np.ones(32, dtype=np.float32)
+    for _ in range(10):
+        eng.add_async(d)
+    assert eng.pending == 10       # staged, not yet applied
+    out = eng.get()                # get flushes: read-your-writes
+    np.testing.assert_allclose(out, d * 10)
+    assert eng.pending == 0
+
+
+def test_async_staged_matrix_sparse_drain(mv_env):
+    table = mv.create_table(mv.MatrixTableOption(num_row=1000, num_col=8))
+    eng = AsyncTableEngine(table, flush_pending=1000)
+    rows = np.array([3, 500, 999], dtype=np.int32)
+    d = np.ones((3, 8), dtype=np.float32)
+    for _ in range(5):
+        eng.add_rows_async(rows, d)
+    got = eng.get_rows(rows)
+    np.testing.assert_allclose(got, d * 5)
+    # untouched rows stayed zero (sparse drain only moved 3 rows)
+    assert np.all(eng.get_rows([0, 1, 2]) == 0)
+
+
+def test_async_auto_flush_threshold(mv_env):
+    table = mv.create_table(mv.ArrayTableOption(size=8))
+    eng = AsyncTableEngine(table, flush_pending=4)
+    d = np.ones(8, dtype=np.float32)
+    for _ in range(4):
+        eng.add_async(d)
+    assert eng.pending == 0  # hit threshold -> flushed
+    np.testing.assert_allclose(table.get(), d * 4)
+
+
+def test_async_stateful_updater_bypasses_staging(mv_env):
+    table = mv.create_table(mv.ArrayTableOption(size=4, updater="adagrad"))
+    eng = AsyncTableEngine(table)
+    d = np.ones(4, dtype=np.float32)
+    eng.add_async(d, mv.AddOption(rho=0.1, learning_rate=0.1))
+    assert eng.pending == 0  # applied directly, not staged
+    assert np.all(eng.get() < 0)  # adagrad stepped downhill
+
+
+def test_worker_pool_asgd_convergence(mv_env):
+    """N async workers hammer one table; total must equal the sum of all
+    contributions (ASGD loses no updates)."""
+    table = mv.create_table(mv.ArrayTableOption(size=16))
+    eng = AsyncTableEngine(table, flush_pending=32)
+    adds_per_worker = 50
+    pool = WorkerPool(8)
+
+    def work(wid):
+        d = np.full(16, float(wid + 1), dtype=np.float32)
+        for _ in range(adds_per_worker):
+            eng.add_async(d)
+
+    pool.run(work)
+    out = eng.get()
+    expected = sum(w + 1 for w in range(8)) * adds_per_worker
+    np.testing.assert_allclose(out, np.full(16, float(expected)))
+
+
+def test_worker_pool_propagates_errors(mv_env):
+    pool = WorkerPool(2)
+    with pytest.raises(ValueError):
+        pool.run(lambda wid: (_ for _ in ()).throw(ValueError("boom")))

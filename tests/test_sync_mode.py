@@ -1,0 +1,97 @@
+"""BSP (SyncServer) semantics tests — port of ``Test/unittests/test_sync.cpp``
+invariants plus the vector-clock guarantee of ``src/server.cpp:61-67``: every
+worker's i-th Get sees identical parameters."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.options import AddOption, GetOption
+from multiverso_tpu.core.sync_coordinator import SyncCoordinator, VectorClock
+
+
+def test_vector_clock_basics():
+    vc = VectorClock(3)
+    assert vc.min() == 0
+    vc.tick(0)
+    vc.tick(1)
+    assert vc.min() == 0
+    vc.tick(2)
+    assert vc.min() == 1
+    vc.finish(1)
+    vc.tick(0)
+    assert vc.min() == 1  # finished worker excluded
+
+
+def test_sync_world_size_1(sync_env):
+    """test_sync.cpp:9-44 shape: sync mode, one worker — plain round-trips."""
+    mv = sync_env
+    table = mv.create_table(mv.ArrayTableOption(size=10))
+    delta = np.ones(10, dtype=np.float32)
+    for i in range(3):
+        table.add(delta)
+        np.testing.assert_allclose(table.get(), delta * (i + 1))
+
+
+def test_bsp_identical_views_across_workers():
+    """N threaded workers doing (add, get) rounds: worker w's i-th get must
+    equal delta * i * N regardless of interleaving."""
+    num_workers = 4
+    rounds = 5
+    mv.init(["-sync=true"], num_local_workers=num_workers)
+    try:
+        table = mv.create_table(mv.ArrayTableOption(size=8))
+        delta = np.ones(8, dtype=np.float32)
+        views = [[] for _ in range(num_workers)]
+
+        def worker(wid):
+            for _ in range(rounds):
+                table.add(delta, AddOption(worker_id=wid))
+                views[wid].append(table.get(GetOption(worker_id=wid)).copy())
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(num_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        for i in range(rounds):
+            expected = delta * (i + 1) * num_workers
+            for w in range(num_workers):
+                np.testing.assert_allclose(
+                    views[w][i], expected,
+                    err_msg=f"worker {w} round {i} saw a non-BSP view")
+    finally:
+        mv.shutdown()
+
+
+def test_finish_train_releases_stragglers():
+    """Server_Finish_Train analog (ref src/server.cpp:190-213): a finished
+    worker must not block the others' clocks."""
+    num_workers = 2
+    mv.init(["-sync=true"], num_local_workers=num_workers)
+    try:
+        table = mv.create_table(mv.ArrayTableOption(size=4))
+        delta = np.ones(4, dtype=np.float32)
+
+        def short_worker():
+            table.add(delta, AddOption(worker_id=0))
+            table.get(GetOption(worker_id=0))
+            table.finish_train(0)
+
+        def long_worker():
+            for _ in range(3):
+                table.add(delta, AddOption(worker_id=1))
+                table.get(GetOption(worker_id=1))
+
+        t0 = threading.Thread(target=short_worker)
+        t1 = threading.Thread(target=long_worker)
+        t0.start(); t1.start()
+        t0.join(timeout=30); t1.join(timeout=30)
+        assert not t0.is_alive() and not t1.is_alive(), "BSP deadlock"
+        np.testing.assert_allclose(table.get(), delta * 4)
+    finally:
+        mv.shutdown()
