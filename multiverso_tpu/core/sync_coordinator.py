@@ -66,8 +66,12 @@ class SyncCoordinator:
         self._cv = threading.Condition()
 
     # -- gates -------------------------------------------------------------
-    def before_add(self, worker_id: int, timeout: float = 60.0) -> None:
-        """Block until this worker's next add is in-clock, then tick."""
+    # Two-phase: acquire_* blocks until the op is in-clock; commit_* ticks
+    # AFTER the op has been dispatched against the store. Ticking early would
+    # let a peer pass its gate and read/write a state that doesn't yet
+    # include this worker's op (the reference avoids this by construction:
+    # the single-threaded server actor both applies and clocks a message).
+    def acquire_add(self, worker_id: int, timeout: float = 60.0) -> None:
         with self._cv:
             target = self._adds.value(worker_id)  # this will be add #target+1
             ok = self._cv.wait_for(
@@ -75,12 +79,13 @@ class SyncCoordinator:
                 self._adds.value(worker_id) == VectorClock.INF,
                 timeout)
             check(ok, f"sync add gate timed out (worker {worker_id})")
+
+    def commit_add(self, worker_id: int) -> None:
+        with self._cv:
             self._adds.tick(worker_id)
             self._cv.notify_all()
 
-    def before_get(self, worker_id: int, timeout: float = 60.0) -> None:
-        """Block until every active worker's add count reaches this worker's
-        next get index, then tick."""
+    def acquire_get(self, worker_id: int, timeout: float = 60.0) -> None:
         with self._cv:
             target = self._gets.value(worker_id) + 1
             ok = self._cv.wait_for(
@@ -88,6 +93,9 @@ class SyncCoordinator:
                 self._gets.value(worker_id) == VectorClock.INF,
                 timeout)
             check(ok, f"sync get gate timed out (worker {worker_id})")
+
+    def commit_get(self, worker_id: int) -> None:
+        with self._cv:
             self._gets.tick(worker_id)
             self._cv.notify_all()
 

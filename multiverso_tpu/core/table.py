@@ -123,6 +123,10 @@ class ServerStore:
         self._access_rows = jax.jit(access_rows)
 
     # -- server ops (ref ServerTable::ProcessAdd/ProcessGet) ---------------
+    # Every dispatch happens under the store lock: the update kernels DONATE
+    # the parameter buffer, so a concurrent reader must never capture a
+    # reference that a writer is about to invalidate. The lock is held only
+    # for the (async) dispatch, never for device execution.
     def apply_dense(self, delta: jax.Array, opt: AddOption) -> None:
         with self._lock:
             self.data, self.state = self._dense_update(
@@ -135,14 +139,17 @@ class ServerStore:
                 self.data, self.state, row_ids, delta, *opt.scalars())
 
     def read(self) -> jax.Array:
-        """Logical (unpadded) view of the whole table."""
-        return self._access(self.data)
+        """Logical (unpadded) view of the whole table (fresh buffer)."""
+        with self._lock:
+            return self._access(self.data)
 
     def read_rows(self, row_ids: jax.Array) -> jax.Array:
-        return self._access_rows(self.data, row_ids)
+        with self._lock:
+            return self._access_rows(self.data, row_ids)
 
     def block(self) -> None:
-        jax.block_until_ready(self.data)
+        """Wait until all previously dispatched updates have executed."""
+        jax.block_until_ready(self.read())
 
     # -- checkpointing (ref table_interface.h:61-75) -----------------------
     def store_state(self) -> Dict[str, np.ndarray]:
@@ -189,11 +196,19 @@ class WorkerTable:
     # -- BSP gates (no-ops in async mode / single-worker worlds) -----------
     def _gate_add(self, option: Optional[AddOption]) -> None:
         if self._sync is not None:
-            self._sync.before_add(option.worker_id if option else 0)
+            self._sync.acquire_add(option.worker_id if option else 0)
+
+    def _commit_add(self, option: Optional[AddOption]) -> None:
+        if self._sync is not None:
+            self._sync.commit_add(option.worker_id if option else 0)
 
     def _gate_get(self, option: Optional[GetOption]) -> None:
         if self._sync is not None:
-            self._sync.before_get(option.worker_id if option else 0)
+            self._sync.acquire_get(option.worker_id if option else 0)
+
+    def _commit_get(self, option: Optional[GetOption]) -> None:
+        if self._sync is not None:
+            self._sync.commit_get(option.worker_id if option else 0)
 
     def finish_train(self, worker_id: int) -> None:
         """``Zoo::FinishTrain`` analog (ref src/zoo.cpp:152-161): release a

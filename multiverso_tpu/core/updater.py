@@ -122,11 +122,57 @@ class AdaGradUpdater(Updater):
         return data.at[rows].add(-step.astype(data.dtype), mode="drop"), {"g2": g2}
 
 
+class FTRLUpdater(Updater):
+    """FTRL-proximal with server-resident {z, n} state.
+
+    Parity with the LR app's FTRL entry table
+    (``Applications/LogisticRegression/src/util/ftrl_sparse_table.h:12-88``:
+    each weight carries {z, n}). Option mapping: ``learning_rate`` -> alpha,
+    ``rho`` -> beta, ``lambda_`` -> l1, ``momentum`` -> l2. Delta is the raw
+    gradient; weights are recomputed closed-form on every update.
+    """
+
+    name = "ftrl"
+
+    def init_state(self, shape, dtype, num_workers):
+        del num_workers
+        return {"z": jnp.zeros(shape, dtype=jnp.float32),
+                "n": jnp.zeros(shape, dtype=jnp.float32)}
+
+    @staticmethod
+    def _step(w, z, n, g, opt):
+        _, l2, alpha, beta, l1 = opt
+        g32 = g.astype(jnp.float32)
+        n_new = n + jnp.square(g32)
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / alpha
+        z_new = z + g32 - sigma * w.astype(jnp.float32)
+        w_new = jnp.where(
+            jnp.abs(z_new) > l1,
+            -(z_new - jnp.sign(z_new) * l1) /
+            ((beta + jnp.sqrt(n_new)) / alpha + l2),
+            0.0)
+        return w_new.astype(w.dtype), z_new, n_new
+
+    def update_dense(self, data, state, delta, opt):
+        w, z, n = self._step(data, state["z"], state["n"], delta, opt)
+        return w, {"z": z, "n": n}
+
+    def update_rows(self, data, state, rows, delta, opt):
+        w_rows = jnp.take(data, rows, axis=0, mode="clip")
+        z_rows = jnp.take(state["z"], rows, axis=0, mode="clip")
+        n_rows = jnp.take(state["n"], rows, axis=0, mode="clip")
+        w_new, z_new, n_new = self._step(w_rows, z_rows, n_rows, delta, opt)
+        return (data.at[rows].set(w_new, mode="drop"),
+                {"z": state["z"].at[rows].set(z_new, mode="drop"),
+                 "n": state["n"].at[rows].set(n_new, mode="drop")})
+
+
 _REGISTRY: Dict[str, Callable[[], Updater]] = {
     "default": Updater,
     "sgd": SGDUpdater,
     "momentum_sgd": MomentumUpdater,
     "adagrad": AdaGradUpdater,
+    "ftrl": FTRLUpdater,
 }
 
 

@@ -1,0 +1,10 @@
+from multiverso_tpu.models.logreg.logreg import LogReg
+from multiverso_tpu.models.logreg.model import (LocalModel, LogRegConfig,
+                                                PSModel, make_model)
+from multiverso_tpu.models.logreg.reader import (ArrayBatcher, SampleReader,
+                                                 parse_dense_line,
+                                                 parse_libsvm_line)
+
+__all__ = ["LogReg", "LogRegConfig", "LocalModel", "PSModel", "make_model",
+           "SampleReader", "ArrayBatcher", "parse_libsvm_line",
+           "parse_dense_line"]

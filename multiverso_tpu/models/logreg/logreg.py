@@ -1,0 +1,73 @@
+"""LogReg driver: epoch loop, test/predict.
+
+Parity with ``Applications/LogisticRegression/src/logreg.cpp``:
+``Train`` = epoch loop over async reader buffers -> ``model.update`` per
+minibatch (``logreg.cpp:41-87``); ``Test`` computes accuracy and writes
+predictions (``logreg.cpp:121-173``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.logreg.model import (LogRegConfig, make_model)
+from multiverso_tpu.models.logreg.objective import (correct_count,
+                                                    get_objective)
+from multiverso_tpu.utils.log import log
+
+
+class LogReg:
+    def __init__(self, cfg: LogRegConfig):
+        self.cfg = cfg
+        self.model = make_model(cfg)
+        _, predict = get_objective(cfg.objective)
+        self._predict = jax.jit(predict)
+
+    def train(self, batches: Iterable[Tuple[np.ndarray, np.ndarray]],
+              epochs: Optional[int] = None) -> List[float]:
+        """Returns per-epoch mean losses."""
+        epochs = epochs if epochs is not None else self.cfg.epochs
+        epoch_losses: List[float] = []
+        for epoch in range(epochs):
+            losses = []
+            for X, y in batches:
+                # update returns a device scalar; defer the host sync to the
+                # epoch boundary so the step loop never blocks on transfer.
+                losses.append(self.model.update(X, y))
+            sync = getattr(self.model, "sync", None)
+            if sync:
+                sync()      # epoch barrier + fresh model (ref logreg.cpp:81)
+            mean_loss = (float(np.mean([float(l) for l in losses]))
+                         if losses else 0.0)
+            epoch_losses.append(mean_loss)
+            log.debug("epoch %d: loss=%.5f", epoch, mean_loss)
+        return epoch_losses
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        w = jnp.asarray(self.model.get_weights())
+        return np.asarray(self._predict(w, jnp.asarray(X)))
+
+    def test(self, batches: Iterable[Tuple[np.ndarray, np.ndarray]],
+             output_path: Optional[str] = None) -> float:
+        """Accuracy over batches; optionally writes predictions
+        (ref logreg.cpp:121-173)."""
+        total = 0
+        correct = 0
+        out = open(output_path, "w") if output_path else None
+        try:
+            for X, y in batches:
+                probs = self.predict(X)
+                correct += correct_count(self.cfg.objective, probs, y)
+                total += len(y)
+                if out is not None:
+                    for p in np.atleast_1d(probs):
+                        out.write(f"{np.asarray(p).ravel()[0]:.6f}\n")
+        finally:
+            if out is not None:
+                out.close()
+        return correct / max(total, 1)

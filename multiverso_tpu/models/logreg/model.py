@@ -1,0 +1,198 @@
+"""LogReg models: local and parameter-server modes.
+
+Parity with ``Applications/LogisticRegression/src/model/``:
+
+* ``Model`` (local): weights on device, one jitted minibatch step.
+* ``PSModel`` (``ps_model.cpp``): weights in an :class:`ArrayTable`; each
+  minibatch computes the gradient against the worker's local copy and pushes
+  a **client-side lr-scaled delta** (ref ``updater/updater.cpp:12-60``);
+  the model is pulled every ``sync_frequency`` minibatches
+  (``ps_model.cpp:172-182``), optionally **pipelined** with a double-buffered
+  async Get so the pull overlaps compute (``ps_model.cpp:236-271``).
+
+TPU-native: the minibatch step is one jitted function — X @ W on the MXU,
+regularizer fused by XLA. FTRL mode pushes raw gradients; the server-side
+FTRL updater owns {z, n} and recomputes weights (the reference's FTRL table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.options import AddOption, ArrayTableOption
+from multiverso_tpu.models.logreg.objective import get_objective
+from multiverso_tpu.utils.dashboard import monitor
+from multiverso_tpu.utils.log import check
+
+
+@dataclasses.dataclass
+class LogRegConfig:
+    """Key=value config (ref LR ``configure.h:9-115`` surface)."""
+    objective: str = "sigmoid"          # linear|sigmoid|softmax|ftrl
+    num_feature: int = 0
+    num_class: int = 1
+    learning_rate: float = 0.1
+    minibatch_size: int = 20
+    epochs: int = 1
+    sync_frequency: int = 1
+    pipeline: bool = False
+    use_ps: bool = True
+    regular: str = "none"               # none|l1|l2
+    regular_coef: float = 0.0
+    bias: bool = True
+    input_format: str = "libsvm"
+    # FTRL hyperparams (mapped onto AddOption fields)
+    ftrl_alpha: float = 0.1
+    ftrl_beta: float = 1.0
+    ftrl_l1: float = 1.0
+    ftrl_l2: float = 1.0
+
+    @property
+    def width(self) -> int:
+        return self.num_feature + (1 if self.bias else 0)
+
+    @classmethod
+    def from_file(cls, path: str) -> "LogRegConfig":
+        """Parse the reference's ``key=value`` config-file format."""
+        cfg = cls()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                key, _, val = line.partition("=")
+                key = key.strip()
+                val = val.strip()
+                if hasattr(cfg, key):
+                    field_type = type(getattr(cfg, key))
+                    if field_type is bool:
+                        setattr(cfg, key, val.lower() in ("true", "1"))
+                    else:
+                        setattr(cfg, key, field_type(val))
+        return cfg
+
+
+def _make_step(cfg: LogRegConfig):
+    loss_grad, _ = get_objective(cfg.objective)
+    coef = cfg.regular_coef
+    regular = cfg.regular
+
+    def step(weights, X, y):
+        loss, grad = loss_grad(weights, X, y)
+        if regular == "l2" and coef:
+            grad = grad + coef * weights
+        elif regular == "l1" and coef:
+            grad = grad + coef * jnp.sign(weights)
+        return loss, grad
+
+    return jax.jit(step)
+
+
+class LocalModel:
+    """Non-PS mode: weights stay on device, fully fused step."""
+
+    def __init__(self, cfg: LogRegConfig):
+        self.cfg = cfg
+        self.weights = jnp.zeros((cfg.width, cfg.num_class),
+                                 dtype=jnp.float32)
+        step = _make_step(cfg)
+        lr = cfg.learning_rate
+
+        def sgd(weights, X, y):
+            loss, grad = step(weights, X, y)
+            return weights - lr * grad, loss
+
+        self._sgd = jax.jit(sgd, donate_argnums=0)
+
+    def update(self, X: np.ndarray, y: np.ndarray):
+        """Returns the loss as a device scalar (no host sync)."""
+        self.weights, loss = self._sgd(self.weights, jnp.asarray(X),
+                                       jnp.asarray(y))
+        return loss
+
+    def get_weights(self) -> np.ndarray:
+        return np.asarray(self.weights)
+
+
+class PSModel:
+    """PS mode: weights live in a sharded ArrayTable."""
+
+    def __init__(self, cfg: LogRegConfig):
+        self.cfg = cfg
+        is_ftrl = cfg.objective == "ftrl"
+        updater = "ftrl" if is_ftrl else "sgd"
+        self.table = mv.create_table(ArrayTableOption(
+            size=cfg.width * cfg.num_class, updater=updater,
+            name="logreg_weights"))
+        self.is_ftrl = is_ftrl
+        self._step = _make_step(cfg)
+        self.local_weights = np.zeros((cfg.width, cfg.num_class),
+                                      dtype=np.float32)
+        self._minibatches_since_sync = 0
+        self._pending_get: Optional[int] = None
+        if is_ftrl:
+            self._add_option = AddOption(
+                learning_rate=cfg.ftrl_alpha, rho=cfg.ftrl_beta,
+                lambda_=cfg.ftrl_l1, momentum=cfg.ftrl_l2)
+        else:
+            self._add_option = AddOption(learning_rate=cfg.learning_rate)
+
+    def update(self, X: np.ndarray, y: np.ndarray):
+        """Returns the loss as a device scalar (no host sync)."""
+        loss, grad = self._step(jnp.asarray(self.local_weights),
+                                jnp.asarray(X), jnp.asarray(y))
+        grad = np.asarray(grad)
+        if self.is_ftrl:
+            delta = grad          # raw gradient; server FTRL owns the step
+        else:
+            delta = self.cfg.learning_rate * grad  # client-side lr scaling
+        with monitor("LOGREG_PUSH"):
+            self.table.add_async(delta.reshape(-1), self._add_option)
+        self._minibatches_since_sync += 1
+        if self._needs_sync():
+            self._pull()
+        return loss
+
+    def _needs_sync(self) -> bool:
+        # ref ps_model.cpp:172-182
+        return self._minibatches_since_sync >= self.cfg.sync_frequency
+
+    def _pull(self) -> None:
+        cfg = self.cfg
+        with monitor("LOGREG_PULL"):
+            if cfg.pipeline:
+                # Double buffer (ref ps_model.cpp:236-271): wait on the get
+                # issued LAST sync, then immediately issue the next.
+                if self._pending_get is not None:
+                    data = self.table.wait(self._pending_get)
+                    self.local_weights = data.reshape(cfg.width,
+                                                      cfg.num_class)
+                self._pending_get = self.table.get_async()
+            else:
+                self.local_weights = self.table.get().reshape(
+                    cfg.width, cfg.num_class)
+        self._minibatches_since_sync = 0
+
+    def sync(self) -> None:
+        """Blocking pull — epoch boundaries / before test
+        (ref ps_model.cpp:206-233)."""
+        if self._pending_get is not None:
+            self.table.wait(self._pending_get)
+            self._pending_get = None
+        self.local_weights = self.table.get().reshape(
+            self.cfg.width, self.cfg.num_class)
+        self._minibatches_since_sync = 0
+
+    def get_weights(self) -> np.ndarray:
+        return self.local_weights
+
+
+def make_model(cfg: LogRegConfig):
+    return PSModel(cfg) if cfg.use_ps else LocalModel(cfg)

@@ -1,0 +1,90 @@
+"""Objectives for logistic regression — jitted, MXU-shaped.
+
+Parity with ``Applications/LogisticRegression/src/objective/*.h``:
+linear / sigmoid / softmax / (FTRL = sigmoid loss with the FTRL updater).
+
+TPU-native: each objective exposes pure ``(weights, X, y) -> (loss, grad)``
+and ``predict`` functions over **dense minibatches** so the X @ W product
+lands on the MXU as one batched matmul; the reference's per-sample sparse
+dot-product loops (``objective/objective.h``) would starve the systolic
+array.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _linear(weights: jax.Array, X: jax.Array, y: jax.Array):
+    """Squared loss; weights [F, C] (C==1 collapses to a vector)."""
+    pred = X @ weights
+    err = pred - y
+    loss = 0.5 * jnp.mean(jnp.sum(err * err, axis=-1))
+    grad = X.T @ err / X.shape[0]
+    return loss, grad
+
+
+def _sigmoid(weights: jax.Array, X: jax.Array, y: jax.Array):
+    """Binary logistic; y in {0,1}, weights [F, 1]."""
+    logits = (X @ weights).squeeze(-1)
+    y = y.squeeze(-1) if y.ndim > 1 else y
+    loss = jnp.mean(jnp.logaddexp(0.0, logits) - y * logits)
+    p = jax.nn.sigmoid(logits)
+    grad = X.T @ (p - y)[:, None] / X.shape[0]
+    return loss, grad
+
+
+def _softmax(weights: jax.Array, X: jax.Array, y: jax.Array):
+    """Multinomial; y integer labels, weights [F, C]."""
+    logits = X @ weights
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    y = y.astype(jnp.int32)
+    loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+    p = jnp.exp(logp)
+    onehot = jax.nn.one_hot(y, weights.shape[1], dtype=p.dtype)
+    grad = X.T @ (p - onehot) / X.shape[0]
+    return loss, grad
+
+
+def _predict_linear(weights, X):
+    return X @ weights
+
+
+def _predict_sigmoid(weights, X):
+    return jax.nn.sigmoid((X @ weights).squeeze(-1))
+
+
+def _predict_softmax(weights, X):
+    return jax.nn.softmax(X @ weights, axis=-1)
+
+
+_OBJECTIVES: Dict[str, Tuple[Callable, Callable]] = {
+    "linear": (_linear, _predict_linear),
+    "sigmoid": (_sigmoid, _predict_sigmoid),
+    "softmax": (_softmax, _predict_softmax),
+    "ftrl": (_sigmoid, _predict_sigmoid),  # FTRL = sigmoid loss + ftrl updater
+}
+
+
+def get_objective(name: str) -> Tuple[Callable, Callable]:
+    """Returns (loss_and_grad, predict) — both jit-compatible."""
+    try:
+        return _OBJECTIVES[name]
+    except KeyError:
+        raise ValueError(f"unknown objective '{name}'; "
+                         f"have {sorted(_OBJECTIVES)}") from None
+
+
+def correct_count(objective: str, probs, labels) -> int:
+    """Test-time accuracy counting (ref logreg.cpp:121-173)."""
+    import numpy as np
+    probs = np.asarray(probs)
+    labels = np.asarray(labels)
+    if objective in ("sigmoid", "ftrl"):
+        return int(((probs > 0.5) == (labels > 0.5)).sum())
+    if objective == "softmax":
+        return int((probs.argmax(axis=-1) == labels).sum())
+    return int((np.abs(probs.squeeze() - labels) < 0.5).sum())

@@ -1,0 +1,112 @@
+"""Sample readers: libsvm / dense text formats with async prefetch.
+
+Parity with ``Applications/LogisticRegression/src/reader.cpp`` (async
+``SampleReader`` buffers consumed by the epoch loop, ``logreg.cpp:46-60``) and
+its input formats. TPU-native: minibatches are materialized as **dense
+[B, F] float32 arrays** (sparse indices scattered on host) so each step is
+one MXU matmul; the background thread is the ``ASyncBuffer`` analog.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.utils.async_buffer import ASyncBuffer
+from multiverso_tpu.utils.log import check
+
+
+def parse_libsvm_line(line: str) -> Tuple[float, List[int], List[float]]:
+    parts = line.split()
+    label = float(parts[0])
+    idx, val = [], []
+    for tok in parts[1:]:
+        i, _, v = tok.partition(":")
+        idx.append(int(i))
+        val.append(float(v))
+    return label, idx, val
+
+
+def parse_dense_line(line: str) -> Tuple[float, np.ndarray]:
+    parts = line.split()
+    return float(parts[0]), np.asarray(parts[1:], dtype=np.float32)
+
+
+class SampleReader:
+    """Streams (X, y) minibatches from a file; prefetches in background."""
+
+    def __init__(self, path: str, num_feature: int, minibatch_size: int,
+                 input_format: str = "libsvm", bias: bool = True,
+                 prefetch: bool = True):
+        check(input_format in ("libsvm", "dense"),
+              f"unknown input format '{input_format}'")
+        self.path = path
+        self.num_feature = num_feature
+        self.minibatch_size = minibatch_size
+        self.format = input_format
+        self.bias = bias
+        self.prefetch = prefetch
+        self.width = num_feature + (1 if bias else 0)
+
+    def _batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        with open(self.path) as f:
+            rows_x: List = []
+            rows_y: List[float] = []
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                if self.format == "libsvm":
+                    label, idx, val = parse_libsvm_line(line)
+                    dense = np.zeros(self.width, dtype=np.float32)
+                    for i, v in zip(idx, val):
+                        if i < self.num_feature:
+                            dense[i] = v
+                else:
+                    label, vals = parse_dense_line(line)
+                    dense = np.zeros(self.width, dtype=np.float32)
+                    dense[:min(len(vals), self.num_feature)] = \
+                        vals[:self.num_feature]
+                if self.bias:
+                    dense[-1] = 1.0
+                rows_x.append(dense)
+                rows_y.append(label)
+                if len(rows_x) == self.minibatch_size:
+                    yield np.stack(rows_x), np.asarray(rows_y,
+                                                       dtype=np.float32)
+                    rows_x, rows_y = [], []
+            if rows_x:
+                yield np.stack(rows_x), np.asarray(rows_y, dtype=np.float32)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        if not self.prefetch:
+            yield from self._batches()
+            return
+        it = self._batches()
+        buf: ASyncBuffer = ASyncBuffer(lambda: next(it, None))
+        try:
+            while True:
+                item = buf.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            buf.close()
+
+
+class ArrayBatcher:
+    """In-memory (X, y) minibatch iterator — for tests and synthetic data."""
+
+    def __init__(self, X: np.ndarray, y: np.ndarray, minibatch_size: int,
+                 bias: bool = True):
+        if bias:
+            X = np.concatenate(
+                [X, np.ones((len(X), 1), dtype=X.dtype)], axis=1)
+        self.X = np.asarray(X, dtype=np.float32)
+        self.y = np.asarray(y, dtype=np.float32)
+        self.bs = minibatch_size
+
+    def __iter__(self):
+        for i in range(0, len(self.X), self.bs):
+            yield self.X[i:i + self.bs], self.y[i:i + self.bs]

@@ -46,6 +46,7 @@ class ArrayTable(WorkerTable):
     def get_async(self, option: Optional[GetOption] = None) -> int:
         self._gate_get(option)
         arr = self.store.read()
+        self._commit_get(option)
         return self._register(lambda: np.asarray(arr))
 
     def get(self, option: Optional[GetOption] = None) -> np.ndarray:
@@ -63,6 +64,7 @@ class ArrayTable(WorkerTable):
               f"delta shape {delta.shape} != ({self.size},)")
         self._gate_add(option)
         self.store.apply_dense(delta, option or AddOption())
+        self._commit_add(option)
         return self._register(lambda: self.store.block())
 
     def add(self, delta, option: Optional[AddOption] = None) -> None:

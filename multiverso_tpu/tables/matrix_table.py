@@ -57,6 +57,7 @@ class MatrixTable(WorkerTable):
     def get_async(self, option: Optional[GetOption] = None) -> int:
         self._gate_get(option)
         arr = self.store.read()
+        self._commit_get(option)
         return self._register(lambda: np.asarray(arr))
 
     def get(self, option: Optional[GetOption] = None) -> np.ndarray:
@@ -72,6 +73,7 @@ class MatrixTable(WorkerTable):
               f"delta shape {delta.shape} != {(self.num_row, self.num_col)}")
         self._gate_add(option)
         self.store.apply_dense(delta, option or AddOption())
+        self._commit_add(option)
         return self._register(lambda: self.store.block())
 
     def add(self, delta, option: Optional[AddOption] = None) -> None:
@@ -84,6 +86,7 @@ class MatrixTable(WorkerTable):
         row_ids = np.asarray(row_ids, dtype=np.int32)
         self._gate_get(option)
         arr = self.store.read_rows(row_ids)
+        self._commit_get(option)
         return self._register(lambda: np.asarray(arr))
 
     def get_rows(self, row_ids, option: Optional[GetOption] = None
@@ -103,6 +106,7 @@ class MatrixTable(WorkerTable):
               f"{(len(row_ids), self.num_col)}")
         self._gate_add(option)
         self.store.apply_rows(row_ids, deltas, option or AddOption())
+        self._commit_add(option)
         return self._register(lambda: self.store.block())
 
     def add_rows(self, row_ids, deltas,

@@ -8,11 +8,19 @@ device count so the same sharded code paths compile and execute as on an
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +
                                " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+# The axon PJRT sitecustomize force-sets jax_platforms="axon,cpu" at
+# interpreter start (overriding the env var), which would silently route
+# "CPU" tests onto the real tunneled TPU chip. Forcing the config here —
+# before any backend initializes — pins tests to the 8 virtual CPU devices.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
