@@ -1,0 +1,11 @@
+from multiverso_tpu.models.word2vec.data import (BatchGenerator, BlockStream,
+                                                 CbowBatch, SkipGramBatch,
+                                                 read_corpus)
+from multiverso_tpu.models.word2vec.dictionary import (Dictionary,
+                                                       HuffmanEncoder,
+                                                       Sampler)
+from multiverso_tpu.models.word2vec.model import Word2Vec, Word2VecConfig
+
+__all__ = ["Word2Vec", "Word2VecConfig", "Dictionary", "HuffmanEncoder",
+           "Sampler", "BatchGenerator", "BlockStream", "SkipGramBatch",
+           "CbowBatch", "read_corpus"]

@@ -1,0 +1,347 @@
+"""Word2vec (distributed WordEmbedding) — the flagship workload.
+
+Parity with ``Applications/WordEmbedding/src/`` (SURVEY.md §2.6): CBOW and
+skip-gram, negative sampling and hierarchical softmax, the five parameter
+tables (input/output embedding matrices, two AdaGrad accumulator matrices,
+word-count KV table — ref ``communicator.cpp:17-32``), block-pipelined
+training with a words/sec metric, linear lr decay, and batched rank-0
+embedding export (ref ``distributed_wordembedding.cpp:263-306``).
+
+TPU-native design (the whole point): the reference's hot loop is per-sample
+dot products over ``embedding_size`` (``wordembedding.cpp:57-135``) pushed
+through per-row table RPCs. Here one **fused jitted step** gathers all rows
+for a [B]-pair batch from the vocab-row-sharded embedding tables (TP of the
+vocab axis over ICI), computes every dot product as batched einsums on the
+MXU, applies AdaGrad/SGD, and scatter-adds updates back into HBM — the
+"Get-update-Add round trip fused into a single compiled step" that SURVEY.md
+§7 names as the perf requirement. Tables remain first-class: the step reads
+and writes the same ``ServerStore`` arrays the PS Get/Add API serves, so
+parity semantics (checkpointing, row gets) coexist with fused speed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.options import KVTableOption, MatrixTableOption
+from multiverso_tpu.models.word2vec.data import (BatchGenerator, BlockStream,
+                                                 CbowBatch, SkipGramBatch,
+                                                 read_corpus)
+from multiverso_tpu.models.word2vec.dictionary import (Dictionary,
+                                                       HuffmanEncoder)
+from multiverso_tpu.utils.dashboard import Dashboard, monitor
+from multiverso_tpu.utils.log import check, log
+
+_EPS = 1e-7
+_WORDCOUNT_KEY = 0
+
+
+@dataclasses.dataclass
+class Word2VecConfig:
+    embedding_size: int = 100
+    window: int = 5
+    negative: int = 5
+    min_count: int = 5
+    sample: float = 1e-3
+    batch_size: int = 1024
+    learning_rate: float = 0.05
+    epochs: int = 1
+    sg: bool = True                 # skip-gram vs CBOW
+    hs: bool = False                # hierarchical softmax vs negative sampling
+    optimizer: str = "adagrad"      # adagrad | sgd
+    block_words: int = 100_000
+    pipeline: bool = True
+    max_code_length: int = 40
+    seed: int = 0
+    delta_scale: Optional[float] = None   # 1/num_workers push scaling
+
+
+# ---------------------------------------------------------------------------
+# Fused jitted steps. All take/return the (padded) table arrays.
+# ---------------------------------------------------------------------------
+def _apply_update(w, g2, rows, grad, lr, adagrad: bool):
+    """Scatter an embedding update (+AdaGrad) for possibly-duplicated rows."""
+    if adagrad:
+        g2 = g2.at[rows].add(jnp.square(grad), mode="drop")
+        denom = jnp.sqrt(jnp.take(g2, rows, axis=0, mode="clip") + 1e-6)
+        w = w.at[rows].add(-lr * grad / denom, mode="drop")
+    else:
+        w = w.at[rows].add(-lr * grad, mode="drop")
+    return w, g2
+
+
+def _ns_grads(u, v_pos, v_neg, mask):
+    """Shared negative-sampling math. u:[B,D] v_pos:[B,D] v_neg:[B,K,D]."""
+    s_pos = jax.nn.sigmoid(jnp.sum(u * v_pos, axis=-1))          # [B]
+    s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", u, v_neg))   # [B,K]
+    loss = -(mask * jnp.log(s_pos + _EPS)).sum() \
+           - (mask[:, None] * jnp.log(1.0 - s_neg + _EPS)).sum()
+    g_pos = (s_pos - 1.0) * mask                                 # [B]
+    g_neg = s_neg * mask[:, None]                                # [B,K]
+    grad_u = g_pos[:, None] * v_pos + jnp.einsum("bk,bkd->bd", g_neg, v_neg)
+    grad_vpos = g_pos[:, None] * u                               # [B,D]
+    grad_vneg = g_neg[..., None] * u[:, None, :]                 # [B,K,D]
+    return loss, grad_u, grad_vpos, grad_vneg
+
+
+def _hs_grads(u, v_nodes, codes, lmask):
+    """Hierarchical-softmax math. u:[B,D] v_nodes:[B,L,D] codes:[B,L]."""
+    score = jnp.einsum("bd,bld->bl", u, v_nodes)                 # [B,L]
+    target = 1.0 - codes
+    sign = 2.0 * target - 1.0
+    loss = -(lmask * jnp.log(jax.nn.sigmoid(sign * score) + _EPS)).sum()
+    g = (jax.nn.sigmoid(score) - target) * lmask                 # [B,L]
+    grad_u = jnp.einsum("bl,bld->bd", g, v_nodes)
+    grad_v = g[..., None] * u[:, None, :]                        # [B,L,D]
+    return loss, grad_u, grad_v
+
+
+def build_sg_ns_step(adagrad: bool):
+    def step(w_in, w_out, g_in, g_out, centers, contexts, negatives, mask,
+             lr):
+        u = jnp.take(w_in, centers, axis=0, mode="clip")
+        v_pos = jnp.take(w_out, contexts, axis=0, mode="clip")
+        v_neg = jnp.take(w_out, negatives, axis=0, mode="clip")
+        loss, grad_u, grad_vpos, grad_vneg = _ns_grads(u, v_pos, v_neg, mask)
+        w_in, g_in = _apply_update(w_in, g_in, centers, grad_u, lr, adagrad)
+        B, K, D = grad_vneg.shape
+        rows = jnp.concatenate([contexts, negatives.reshape(B * K)])
+        grads = jnp.concatenate([grad_vpos, grad_vneg.reshape(B * K, D)])
+        w_out, g_out = _apply_update(w_out, g_out, rows, grads, lr, adagrad)
+        return w_in, w_out, g_in, g_out, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+
+def build_sg_hs_step(adagrad: bool):
+    def step(w_in, w_out, g_in, g_out, centers, points, codes, lmask, lr):
+        u = jnp.take(w_in, centers, axis=0, mode="clip")
+        v = jnp.take(w_out, points, axis=0, mode="clip")
+        loss, grad_u, grad_v = _hs_grads(u, v, codes, lmask)
+        w_in, g_in = _apply_update(w_in, g_in, centers, grad_u, lr, adagrad)
+        B, L, D = grad_v.shape
+        w_out, g_out = _apply_update(w_out, g_out, points.reshape(B * L),
+                                     grad_v.reshape(B * L, D), lr, adagrad)
+        return w_in, w_out, g_in, g_out, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+
+def build_cbow_ns_step(adagrad: bool):
+    def step(w_in, w_out, g_in, g_out, centers, contexts, cmask, negatives,
+             mask, lr):
+        ctx = jnp.take(w_in, contexts, axis=0, mode="clip")     # [B,C,D]
+        counts = jnp.maximum(cmask.sum(axis=-1, keepdims=True), 1.0)
+        u = (ctx * cmask[..., None]).sum(axis=1) / counts       # [B,D]
+        v_pos = jnp.take(w_out, centers, axis=0, mode="clip")
+        v_neg = jnp.take(w_out, negatives, axis=0, mode="clip")
+        loss, grad_u, grad_vpos, grad_vneg = _ns_grads(u, v_pos, v_neg, mask)
+        # distribute grad_u to each contributing context row
+        B, C = contexts.shape
+        D = grad_u.shape[-1]
+        gctx = (grad_u[:, None, :] * cmask[..., None] / counts[..., None])
+        w_in, g_in = _apply_update(w_in, g_in, contexts.reshape(B * C),
+                                   gctx.reshape(B * C, D), lr, adagrad)
+        K = negatives.shape[1]
+        rows = jnp.concatenate([centers, negatives.reshape(B * K)])
+        grads = jnp.concatenate([grad_vpos, grad_vneg.reshape(B * K, D)])
+        w_out, g_out = _apply_update(w_out, g_out, rows, grads, lr, adagrad)
+        return w_in, w_out, g_in, g_out, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+
+def build_cbow_hs_step(adagrad: bool):
+    def step(w_in, w_out, g_in, g_out, centers, contexts, cmask, points,
+             codes, lmask, lr):
+        ctx = jnp.take(w_in, contexts, axis=0, mode="clip")
+        counts = jnp.maximum(cmask.sum(axis=-1, keepdims=True), 1.0)
+        u = (ctx * cmask[..., None]).sum(axis=1) / counts
+        v = jnp.take(w_out, points, axis=0, mode="clip")
+        loss, grad_u, grad_v = _hs_grads(u, v, codes, lmask)
+        B, C = contexts.shape
+        D = grad_u.shape[-1]
+        gctx = (grad_u[:, None, :] * cmask[..., None] / counts[..., None])
+        w_in, g_in = _apply_update(w_in, g_in, contexts.reshape(B * C),
+                                   gctx.reshape(B * C, D), lr, adagrad)
+        L = points.shape[1]
+        w_out, g_out = _apply_update(w_out, g_out, points.reshape(B * L),
+                                     grad_v.reshape(B * L, D), lr, adagrad)
+        return w_in, w_out, g_in, g_out, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+
+class Word2Vec:
+    def __init__(self, cfg: Word2VecConfig, dictionary: Dictionary):
+        check(len(dictionary) >= 2, "vocabulary too small")
+        self.cfg = cfg
+        self.dict = dictionary
+        V, D = len(dictionary), cfg.embedding_size
+
+        # The five reference tables (communicator.cpp:17-32): input embed,
+        # output embed, two adagrad accumulators, word-count KV.
+        self.input_table = mv.create_table(MatrixTableOption(
+            V, D, random_init=True, init_low=-0.5 / D, init_high=0.5 / D,
+            seed=cfg.seed, name="w2v_input", updater="default"))
+        out_rows = (V - 1) if cfg.hs else V   # inner nodes for HS
+        self.output_table = mv.create_table(MatrixTableOption(
+            max(out_rows, 1), D, name="w2v_output", updater="default"))
+        self.adagrad_in = mv.create_table(MatrixTableOption(
+            V, D, name="w2v_adagrad_in", updater="default"))
+        self.adagrad_out = mv.create_table(MatrixTableOption(
+            max(out_rows, 1), D, name="w2v_adagrad_out", updater="default"))
+        self.wordcount_table = mv.create_table(
+            KVTableOption(value_dtype=np.int64, name="w2v_wordcount"))
+
+        self.huffman = (HuffmanEncoder(dictionary.counts,
+                                       cfg.max_code_length)
+                        if cfg.hs else None)
+        self.generator = BatchGenerator(
+            dictionary, batch_size=cfg.batch_size, window=cfg.window,
+            negative=cfg.negative, sample=cfg.sample, sg=cfg.sg,
+            seed=cfg.seed)
+
+        adagrad = cfg.optimizer == "adagrad"
+        self._adagrad = adagrad
+        if cfg.sg and not cfg.hs:
+            self._step = build_sg_ns_step(adagrad)
+        elif cfg.sg and cfg.hs:
+            self._step = build_sg_hs_step(adagrad)
+        elif not cfg.sg and not cfg.hs:
+            self._step = build_cbow_ns_step(adagrad)
+        else:
+            self._step = build_cbow_hs_step(adagrad)
+
+        self.total_words = dictionary.total_count * max(cfg.epochs, 1)
+        self.trained_words = 0
+        self.words_per_sec = 0.0
+        scale = cfg.delta_scale
+        if scale is None:
+            scale = 1.0
+        self._push_scale = scale
+
+    # -- lr schedule (ref distributed_wordembedding.cpp:92-134) ------------
+    def _current_lr(self) -> float:
+        if self._adagrad:
+            return self.cfg.learning_rate
+        frac = min(self.trained_words / max(self.total_words, 1), 1.0)
+        return max(self.cfg.learning_rate * (1.0 - frac),
+                   self.cfg.learning_rate * 1e-4)
+
+    # -- one batch through the fused step ----------------------------------
+    def _run_batch(self, batch) -> jax.Array:
+        st_in = self.input_table.store
+        st_out = self.output_table.store
+        st_gin = self.adagrad_in.store
+        st_gout = self.adagrad_out.store
+        lr = np.float32(self._current_lr() * self._push_scale)
+        if isinstance(batch, SkipGramBatch):
+            if self.cfg.hs:
+                points = self.huffman.points[batch.contexts]
+                codes = self.huffman.codes[batch.contexts]
+                lmask = ((np.arange(self.cfg.max_code_length)[None, :] <
+                          self.huffman.lengths[batch.contexts][:, None])
+                         .astype(np.float32) * batch.mask[:, None])
+                args = (batch.centers, points, codes, lmask, lr)
+            else:
+                args = (batch.centers, batch.contexts, batch.negatives,
+                        batch.mask, lr)
+        else:  # CBOW
+            if self.cfg.hs:
+                points = self.huffman.points[batch.centers]
+                codes = self.huffman.codes[batch.centers]
+                lmask = ((np.arange(self.cfg.max_code_length)[None, :] <
+                          self.huffman.lengths[batch.centers][:, None])
+                         .astype(np.float32) * batch.mask[:, None])
+                args = (batch.centers, batch.contexts, batch.context_mask,
+                        points, codes, lmask, lr)
+            else:
+                args = (batch.centers, batch.contexts, batch.context_mask,
+                        batch.negatives, batch.mask, lr)
+        (st_in.data, st_out.data, st_gin.data, st_gout.data,
+         loss) = self._step(st_in.data, st_out.data, st_gin.data,
+                            st_gout.data, *args)
+        return loss
+
+    # -- training loop (ref TrainNeuralNetwork :147-237) -------------------
+    def train(self, sentences: Optional[Iterable[Sequence[int]]] = None,
+              corpus_path: Optional[str] = None,
+              epochs: Optional[int] = None) -> dict:
+        epochs = epochs if epochs is not None else self.cfg.epochs
+        check(sentences is not None or corpus_path is not None,
+              "need sentences or corpus_path")
+        t0 = time.perf_counter()
+        losses: List[jax.Array] = []
+        total_pairs = 0
+        for _ in range(epochs):
+            if corpus_path is not None:
+                sents: Iterable = (self.dict.encode(s)
+                                   for s in read_corpus(corpus_path))
+            else:
+                sents = iter(sentences)
+            for block in BlockStream(sents, self.cfg.block_words,
+                                     prefetch=self.cfg.pipeline):
+                with monitor("W2V_BLOCK"):
+                    block_words = sum(len(s) for s in block)
+                    for batch in self.generator.batches(block):
+                        losses.append(self._run_batch(batch))
+                        total_pairs += batch.n_words
+                    self.trained_words += block_words
+                    # word-count table drives the lr schedule across workers
+                    # (ref distributed_wordembedding.cpp:92-134)
+                    self.wordcount_table.add([_WORDCOUNT_KEY], [block_words])
+        jax.block_until_ready(self.input_table.store.data)
+        elapsed = time.perf_counter() - t0
+        self.words_per_sec = self.trained_words / max(elapsed, 1e-9)
+        mean_loss = (float(np.mean([float(l) for l in losses[-50:]]))
+                     if losses else 0.0)
+        log.info("word2vec: %d words, %d pairs, %.0f words/sec, loss=%.4f",
+                 self.trained_words, total_pairs, self.words_per_sec,
+                 mean_loss)
+        return {"words": self.trained_words, "pairs": total_pairs,
+                "words_per_sec": self.words_per_sec, "loss": mean_loss,
+                "seconds": elapsed}
+
+    # -- embeddings out ----------------------------------------------------
+    def embeddings(self) -> np.ndarray:
+        return self.input_table.get()
+
+    def save(self, path: str, batch_rows: int = 100_000) -> None:
+        """Rank-0 batched text export (ref :263-306 saves in 100K-row
+        batches)."""
+        if not mv.is_master_worker():
+            return
+        with open(path, "w") as f:
+            f.write(f"{len(self.dict)} {self.cfg.embedding_size}\n")
+            for start in range(0, len(self.dict), batch_rows):
+                rows = list(range(start,
+                                  min(start + batch_rows, len(self.dict))))
+                emb = self.input_table.get_rows(rows)
+                for r, vec in zip(rows, emb):
+                    vec_s = " ".join(f"{x:.6f}" for x in vec)
+                    f.write(f"{self.dict.words[r]} {vec_s}\n")
+
+    def most_similar(self, word: str, topk: int = 5) -> List[Tuple[str, float]]:
+        wid = self.dict.word2id.get(word)
+        if wid is None:
+            return []
+        emb = self.embeddings()
+        norms = np.linalg.norm(emb, axis=1) + 1e-12
+        sims = emb @ emb[wid] / (norms * norms[wid])
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            if i != wid:
+                out.append((self.dict.words[i], float(sims[i])))
+            if len(out) == topk:
+                break
+        return out

@@ -1,0 +1,149 @@
+"""WordEmbedding (word2vec) tests — dictionary/huffman/sampler units plus
+end-to-end training signal on a synthetic two-topic corpus."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.word2vec import (BatchGenerator, Dictionary,
+                                            HuffmanEncoder, Sampler,
+                                            SkipGramBatch, Word2Vec,
+                                            Word2VecConfig)
+
+
+def _corpus(n_sentences=300, seed=0):
+    """Two word 'topics' that never co-occur: a0..a4 vs b0..b4."""
+    rng = np.random.default_rng(seed)
+    sentences = []
+    for i in range(n_sentences):
+        topic = "a" if i % 2 == 0 else "b"
+        sentences.append([f"{topic}{rng.integers(0, 5)}" for _ in range(12)])
+    return sentences
+
+
+def test_dictionary_build_and_encode():
+    sents = [["x", "y", "x"], ["x", "z"]]
+    d = Dictionary.build(sents, min_count=1)
+    assert len(d) == 3
+    assert d.words[0] == "x"          # most frequent first
+    assert d.counts[0] == 3
+    assert d.encode(["x", "unknown", "z"]) == [d.word2id["x"],
+                                               d.word2id["z"]]
+    d2 = Dictionary.build(sents, min_count=2)
+    assert len(d2) == 1               # only 'x' survives
+
+
+def test_huffman_codes_valid():
+    counts = [50, 30, 10, 5, 3, 2]
+    enc = HuffmanEncoder(counts)
+    assert enc.num_inner == len(counts) - 1
+    # more frequent words get shorter-or-equal codes
+    assert enc.lengths[0] <= enc.lengths[-1]
+    # prefix property: full (point-path, code) sequences are unique per word
+    paths = set()
+    for w in range(len(counts)):
+        L = enc.lengths[w]
+        key = tuple(enc.points[w, :L]) + tuple(enc.codes[w, :L])
+        assert key not in paths
+        paths.add(key)
+    # all inner-node ids in range
+    assert enc.points.max() < enc.num_inner
+
+
+def test_sampler_follows_unigram_power():
+    counts = [1000, 100, 10]
+    s = Sampler(counts, table_size=1 << 16, seed=0)
+    draws = s.sample(20000)
+    freq = np.bincount(draws, minlength=3) / 20000
+    assert freq[0] > freq[1] > freq[2]
+    expected = np.array(counts, dtype=float) ** 0.75
+    expected /= expected.sum()
+    np.testing.assert_allclose(freq, expected, atol=0.05)
+
+
+def test_batch_generator_shapes():
+    sents = _corpus(50)
+    d = Dictionary.build(sents, min_count=1)
+    gen = BatchGenerator(d, batch_size=64, window=3, negative=4, sample=0,
+                         sg=True)
+    ids = [d.encode(s) for s in sents]
+    batches = list(gen.batches(ids))
+    assert len(batches) >= 2
+    b = batches[0]
+    assert isinstance(b, SkipGramBatch)
+    assert b.centers.shape == (64,)
+    assert b.negatives.shape == (64, 4)
+    assert b.mask.sum() == b.n_words == 64
+    # last batch padded + masked
+    last = batches[-1]
+    assert last.mask.sum() == last.n_words <= 64
+
+
+@pytest.mark.parametrize("sg,hs", [(True, False), (True, True),
+                                   (False, False), (False, True)])
+def test_all_variants_smoke(mv_env, sg, hs):
+    sents = _corpus(40)
+    d = Dictionary.build(sents, min_count=1)
+    cfg = Word2VecConfig(embedding_size=16, batch_size=128, window=3,
+                         negative=3, min_count=1, sample=0, sg=sg, hs=hs,
+                         epochs=1, block_words=2000, pipeline=False)
+    w2v = Word2Vec(cfg, d)
+    stats = w2v.train(sentences=[d.encode(s) for s in sents])
+    assert stats["words"] > 0
+    assert np.isfinite(stats["loss"])
+    emb = w2v.embeddings()
+    assert emb.shape == (len(d), 16)
+    assert np.isfinite(emb).all()
+
+
+def test_training_separates_topics(mv_env):
+    sents = _corpus(400)
+    d = Dictionary.build(sents, min_count=1)
+    cfg = Word2VecConfig(embedding_size=32, batch_size=256, window=4,
+                         negative=5, min_count=1, sample=0, sg=True,
+                         epochs=3, learning_rate=0.1, block_words=5000,
+                         pipeline=True, seed=3)
+    w2v = Word2Vec(cfg, d)
+    w2v.train(sentences=[d.encode(s) for s in sents])
+
+    emb = w2v.embeddings()
+    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
+    a_ids = [d.word2id[w] for w in d.words if w.startswith("a")]
+    b_ids = [d.word2id[w] for w in d.words if w.startswith("b")]
+    intra = np.mean([emb[i] @ emb[j] for i in a_ids for j in a_ids if i != j])
+    inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
+    assert intra > inter + 0.1, f"intra={intra:.3f} inter={inter:.3f}"
+    # most_similar agrees
+    sims = w2v.most_similar(d.words[0], topk=3)
+    topic = d.words[0][0]
+    assert sum(1 for w, _ in sims if w.startswith(topic)) >= 2
+
+
+def test_word_count_table_updated(mv_env):
+    sents = _corpus(40)
+    d = Dictionary.build(sents, min_count=1)
+    cfg = Word2VecConfig(embedding_size=8, batch_size=64, min_count=1,
+                         sample=0, epochs=1, block_words=100,
+                         pipeline=False)
+    w2v = Word2Vec(cfg, d)
+    stats = w2v.train(sentences=[d.encode(s) for s in sents])
+    counted = w2v.wordcount_table.get([0])[0]
+    assert counted == stats["words"]
+
+
+def test_save_embeddings(tmp_path, mv_env):
+    sents = _corpus(30)
+    d = Dictionary.build(sents, min_count=1)
+    cfg = Word2VecConfig(embedding_size=8, batch_size=64, min_count=1,
+                         sample=0, epochs=1, pipeline=False)
+    w2v = Word2Vec(cfg, d)
+    w2v.train(sentences=[d.encode(s) for s in sents])
+    out = tmp_path / "emb.txt"
+    w2v.save(str(out), batch_rows=4)   # force multi-batch export
+    lines = out.read_text().strip().split("\n")
+    header = lines[0].split()
+    assert int(header[0]) == len(d) and int(header[1]) == 8
+    assert len(lines) == len(d) + 1
+    first = lines[1].split()
+    assert first[0] in d.word2id
+    assert len(first) == 9
