@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Headline: WordEmbedding (skip-gram, negative sampling) training throughput in
+words/sec on one TPU chip — the reference's de facto north-star workload
+(``Applications/WordEmbedding``; the reference publishes no updates/sec
+number, BASELINE.md, so ``vs_baseline`` is the ratio against the recorded
+first-round value in BENCH_BASELINE.json when present, else 1.0).
+
+Also measured (reported on stderr): the matrix-table row-update throughput,
+the port of ``Test/test_matrix_perf.cpp:32-80`` (1M x 50 float matrix,
+10%-row Add/Get sweeps).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_word2vec() -> float:
+    """Synthetic-corpus skip-gram training; returns words/sec."""
+    import jax
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.word2vec import (Dictionary, Word2Vec,
+                                                Word2VecConfig)
+
+    rng = np.random.default_rng(0)
+    vocab_size = 50_000
+    n_sent, sent_len = 2_000, 500      # 1M words
+    # Zipfian word frequencies like natural text.
+    zipf = 1.0 / np.arange(1, vocab_size + 1)
+    zipf /= zipf.sum()
+
+    d = Dictionary(min_count=1)
+    d.words = [f"w{i}" for i in range(vocab_size)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    counts = np.maximum((zipf * n_sent * sent_len).astype(int), 1)
+    d.counts = counts.tolist()
+
+    sentences = [rng.choice(vocab_size, size=sent_len, p=zipf)
+                 .astype(np.int32) for _ in range(n_sent)]
+
+    cfg = Word2VecConfig(embedding_size=128, window=5, negative=5,
+                         batch_size=8192, sample=1e-3, sg=True, hs=False,
+                         optimizer="adagrad", epochs=1, pipeline=True,
+                         device_pipeline=True, block_sentences=512,
+                         pad_sentence_length=512, seed=0)
+    w2v = Word2Vec(cfg, d)
+
+    # Warm-up: compile the step (first TPU compile is slow) outside timing.
+    warm = sentences[:4]
+    w2v.train(sentences=warm)
+    w2v.trained_words = 0
+
+    stats = w2v.train(sentences=sentences)
+    _log(f"word2vec: {stats['words']} words in {stats['seconds']:.2f}s "
+         f"-> {stats['words_per_sec']:.0f} words/sec "
+         f"(loss {stats['loss']:.4f})")
+    return stats["words_per_sec"]
+
+
+def bench_matrix_table() -> float:
+    """Port of Test/test_matrix_perf.cpp: 1M x 50 matrix, 100K-row updates.
+    Returns parameter updates/sec (rows x cols / sec) through the jitted
+    sharded update path."""
+    import jax
+    import jax.numpy as jnp
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.core.options import AddOption
+
+    table = mv.create_table(mv.MatrixTableOption(1_000_000, 50,
+                                                 name="perf_matrix"))
+    store = table.store
+    rng = np.random.default_rng(1)
+    n_rows = 100_000
+    rows = jnp.asarray(rng.integers(0, 1_000_000, size=n_rows)
+                       .astype(np.int32))
+    delta = jnp.ones((n_rows, 50), dtype=jnp.float32)
+    opt = AddOption()
+    store.apply_rows(rows, delta, opt)   # compile
+    store.block()
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        store.apply_rows(rows, delta, opt)
+    store.block()
+    dt = time.perf_counter() - t0
+    updates_per_sec = iters * n_rows * 50 / dt
+    _log(f"matrix table: {iters}x{n_rows} row-adds in {dt:.2f}s "
+         f"-> {updates_per_sec:.3g} param updates/sec")
+    # Get-whole sweep (the perf test's Get leg)
+    t0 = time.perf_counter()
+    got = table.get_rows(np.asarray(rng.integers(0, 1_000_000, size=n_rows),
+                                    dtype=np.int32))
+    dt = time.perf_counter() - t0
+    _log(f"matrix table: 100K-row Get in {dt:.2f}s "
+         f"({got.nbytes / dt / 1e6:.0f} MB/s to host)")
+    return updates_per_sec
+
+
+def main() -> None:
+    import multiverso_tpu as mv
+
+    mv.init([])
+    try:
+        updates_per_sec = bench_matrix_table()
+        words_per_sec = bench_word2vec()
+    finally:
+        mv.shutdown()
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                recorded = json.load(f).get("w2v_words_per_sec")
+            if recorded:
+                vs_baseline = words_per_sec / recorded
+        except (OSError, ValueError):
+            pass
+
+    print(json.dumps({
+        "metric": "w2v_words_per_sec",
+        "value": round(words_per_sec, 1),
+        "unit": "words/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "secondary": {"matrix_param_updates_per_sec": round(updates_per_sec)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

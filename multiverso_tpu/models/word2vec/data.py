@@ -73,19 +73,30 @@ class BatchGenerator:
         keep = self._rng.random(len(ids)) < self.keep_prob[ids]
         return ids[keep]
 
-    def _sentence_pairs(self, ids: np.ndarray):
-        """(center, context) with the reference's shrunk dynamic window."""
+    def _sentence_pairs(self, ids: np.ndarray
+                        ) -> tuple[np.ndarray, np.ndarray]:
+        """All (center, context) pairs with the reference's per-center shrunk
+        dynamic window — vectorized: one mask per offset distance instead of
+        a per-position Python loop (the reference's scalar loop shape,
+        wordembedding.cpp:120-135, would bottleneck the TPU feed)."""
         n = len(ids)
         if n < 2:
-            return
+            empty = np.empty(0, dtype=np.int32)
+            return empty, empty
         windows = self._rng.integers(1, self.window + 1, size=n)
-        for pos in range(n):
-            w = windows[pos]
-            lo = max(0, pos - w)
-            hi = min(n, pos + w + 1)
-            for ctx in range(lo, hi):
-                if ctx != pos:
-                    yield ids[pos], ids[ctx]
+        centers: List[np.ndarray] = []
+        contexts: List[np.ndarray] = []
+        for d in range(1, self.window + 1):
+            if d >= n:
+                break
+            keep = windows[:-d] >= d      # center i, context i+d
+            centers.append(ids[:-d][keep])
+            contexts.append(ids[d:][keep])
+            keep = windows[d:] >= d       # center i+d, context i
+            centers.append(ids[d:][keep])
+            contexts.append(ids[:-d][keep])
+        return (np.concatenate(centers).astype(np.int32),
+                np.concatenate(contexts).astype(np.int32))
 
     # -- batches -----------------------------------------------------------
     def batches(self, sentences: Iterable[Sequence[int]]
@@ -96,19 +107,25 @@ class BatchGenerator:
             yield from self._cbow_batches(sentences)
 
     def _skipgram_batches(self, sentences):
-        B, K = self.batch_size, self.negative
-        centers: List[int] = []
-        contexts: List[int] = []
+        B = self.batch_size
+        pending: List[np.ndarray] = []   # interleaved [centers, contexts]
+        buffered = 0
         for sentence in sentences:
             ids = self._subsample(np.asarray(sentence, dtype=np.int32))
-            for c, o in self._sentence_pairs(ids):
-                centers.append(c)
-                contexts.append(o)
-                if len(centers) == B:
-                    yield self._emit_sg(centers, contexts)
-                    centers, contexts = [], []
-        if centers:
-            yield self._emit_sg(centers, contexts)
+            c, o = self._sentence_pairs(ids)
+            if len(c) == 0:
+                continue
+            pending.append(np.stack([c, o]))
+            buffered += len(c)
+            while buffered >= B:
+                stacked = np.concatenate(pending, axis=1)
+                yield self._emit_sg(stacked[0, :B], stacked[1, :B])
+                rest = stacked[:, B:]
+                pending = [rest] if rest.shape[1] else []
+                buffered = rest.shape[1]
+        if buffered:
+            stacked = np.concatenate(pending, axis=1)
+            yield self._emit_sg(stacked[0], stacked[1])
 
     def _emit_sg(self, centers, contexts) -> SkipGramBatch:
         B, K = self.batch_size, self.negative

@@ -36,7 +36,8 @@ from multiverso_tpu.models.word2vec.data import (BatchGenerator, BlockStream,
                                                  CbowBatch, SkipGramBatch,
                                                  read_corpus)
 from multiverso_tpu.models.word2vec.dictionary import (Dictionary,
-                                                       HuffmanEncoder)
+                                                       HuffmanEncoder,
+                                                       Sampler)
 from multiverso_tpu.utils.dashboard import Dashboard, monitor
 from multiverso_tpu.utils.log import check, log
 
@@ -59,6 +60,12 @@ class Word2VecConfig:
     optimizer: str = "adagrad"      # adagrad | sgd
     block_words: int = 100_000
     pipeline: bool = True
+    scan_group: int = 32            # minibatches per jitted scan dispatch
+    # Device pipeline (sg+ns): pair-gen/subsample/negatives on device;
+    # host uploads raw token ids only.
+    device_pipeline: bool = False
+    block_sentences: int = 512      # sentences per device block
+    pad_sentence_length: int = 512  # fixed sentence pad (longer ones split)
     max_code_length: int = 40
     seed: int = 0
     delta_scale: Optional[float] = None   # 1/num_workers push scaling
@@ -104,7 +111,10 @@ def _hs_grads(u, v_nodes, codes, lmask):
     return loss, grad_u, grad_v
 
 
-def build_sg_ns_step(adagrad: bool):
+def raw_sg_ns_step(adagrad: bool):
+    """Unjitted skip-gram/negative-sampling step — callers apply their own
+    jit/shardings (the multi-chip dry run shards vocab rows over a model
+    axis and the batch over a data axis)."""
     def step(w_in, w_out, g_in, g_out, centers, contexts, negatives, mask,
              lr):
         u = jnp.take(w_in, centers, axis=0, mode="clip")
@@ -118,10 +128,14 @@ def build_sg_ns_step(adagrad: bool):
         w_out, g_out = _apply_update(w_out, g_out, rows, grads, lr, adagrad)
         return w_in, w_out, g_in, g_out, loss
 
-    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+    return step
 
 
-def build_sg_hs_step(adagrad: bool):
+def build_sg_ns_step(adagrad: bool):
+    return jax.jit(raw_sg_ns_step(adagrad), donate_argnums=(0, 1, 2, 3))
+
+
+def raw_sg_hs_step(adagrad: bool):
     def step(w_in, w_out, g_in, g_out, centers, points, codes, lmask, lr):
         u = jnp.take(w_in, centers, axis=0, mode="clip")
         v = jnp.take(w_out, points, axis=0, mode="clip")
@@ -132,10 +146,10 @@ def build_sg_hs_step(adagrad: bool):
                                      grad_v.reshape(B * L, D), lr, adagrad)
         return w_in, w_out, g_in, g_out, loss
 
-    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+    return step
 
 
-def build_cbow_ns_step(adagrad: bool):
+def raw_cbow_ns_step(adagrad: bool):
     def step(w_in, w_out, g_in, g_out, centers, contexts, cmask, negatives,
              mask, lr):
         ctx = jnp.take(w_in, contexts, axis=0, mode="clip")     # [B,C,D]
@@ -156,10 +170,10 @@ def build_cbow_ns_step(adagrad: bool):
         w_out, g_out = _apply_update(w_out, g_out, rows, grads, lr, adagrad)
         return w_in, w_out, g_in, g_out, loss
 
-    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+    return step
 
 
-def build_cbow_hs_step(adagrad: bool):
+def raw_cbow_hs_step(adagrad: bool):
     def step(w_in, w_out, g_in, g_out, centers, contexts, cmask, points,
              codes, lmask, lr):
         ctx = jnp.take(w_in, contexts, axis=0, mode="clip")
@@ -177,7 +191,95 @@ def build_cbow_hs_step(adagrad: bool):
                                      grad_v.reshape(B * L, D), lr, adagrad)
         return w_in, w_out, g_in, g_out, loss
 
-    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+    return step
+
+
+def build_device_block_step(window: int, negative: int, chunk: int,
+                            table_size: int, adagrad: bool):
+    """Whole-block training step with ON-DEVICE pair generation.
+
+    The host uploads only raw token ids ([S, L] padded sentences + lengths)
+    — everything the reference does on the worker CPU (subsampling, dynamic
+    window pair extraction, unigram negative sampling,
+    ``wordembedding.cpp:120-135`` / ``sampler.cpp``) happens inside one
+    jitted program: masked offset-shift pairing (static shapes), PRNG-driven
+    subsample/window/negative draws, then a ``lax.scan`` over fixed-size
+    chunks of pairs through the fused update. Host->device traffic per block
+    drops from ~40 bytes/pair to 4 bytes/word.
+    """
+    raw = raw_sg_ns_step(adagrad)
+
+    def block_step(w_in, w_out, g_in, g_out, neg_table, keep_prob, sents,
+                   lengths, key, lr):
+        S, L = sents.shape
+        k_keep, k_win, k_neg = jax.random.split(key, 3)
+        pos = jnp.arange(L)[None, :]
+        valid = (pos < lengths[:, None])
+        keep = jax.random.uniform(k_keep, (S, L)) < keep_prob[sents]
+        valid = valid & keep
+        wpos = jax.random.randint(k_win, (S, L), 1, window + 1)
+
+        centers, contexts, pmask = [], [], []
+        for d in range(1, window + 1):
+            c = sents[:, :-d].reshape(-1)
+            o = sents[:, d:].reshape(-1)
+            m = ((wpos[:, :-d] >= d) & valid[:, :-d] &
+                 valid[:, d:]).reshape(-1)
+            centers += [c, o]
+            contexts += [o, c]
+            pmask += [m, m]
+        centers = jnp.concatenate(centers)
+        contexts = jnp.concatenate(contexts)
+        pmask = jnp.concatenate(pmask)
+
+        P = centers.shape[0]
+        pad = (-P) % chunk
+        centers = jnp.pad(centers, (0, pad))
+        contexts = jnp.pad(contexts, (0, pad))
+        pmask = jnp.pad(pmask, (0, pad))
+        n = (P + pad) // chunk
+        centers = centers.reshape(n, chunk)
+        contexts = contexts.reshape(n, chunk)
+        mask = pmask.reshape(n, chunk).astype(jnp.float32)
+        neg_idx = jax.random.randint(k_neg, (n, chunk, negative), 0,
+                                     table_size)
+        negatives = jnp.take(neg_table, neg_idx, mode="clip")
+
+        def body(carry, xs):
+            c, o, m, neg = xs
+            out = raw(*carry, c, o, neg, m, lr)
+            return out[:4], out[4]
+
+        carry, losses = jax.lax.scan(
+            body, (w_in, w_out, g_in, g_out),
+            (centers, contexts, mask, negatives))
+        return (*carry, losses.sum(), pmask.sum())
+
+    return jax.jit(block_step, donate_argnums=(0, 1, 2, 3))
+
+
+def build_scan_step(raw_step):
+    """Wrap a raw step into a jitted ``lax.scan`` over a GROUP of batches.
+
+    The batch args arrive stacked with a leading [N] group axis; one dispatch
+    trains N minibatches. This is the TPU-idiomatic answer to the
+    reference's per-request dispatch: host round trips amortize N-fold, and
+    the embedding tables stay resident in HBM for the whole group
+    (SURVEY.md §7 hard part (e): fuse Get-update-Add round trips into single
+    compiled steps).
+    """
+    def scan_step(w_in, w_out, g_in, g_out, *batch_args_and_lr):
+        *batch_args, lr = batch_args_and_lr
+
+        def body(carry, xs):
+            out = raw_step(*carry, *xs, lr)
+            return out[:4], out[4]
+
+        carry, losses = jax.lax.scan(
+            body, (w_in, w_out, g_in, g_out), tuple(batch_args))
+        return (*carry, losses.sum())
+
+    return jax.jit(scan_step, donate_argnums=(0, 1, 2, 3))
 
 
 class Word2Vec:
@@ -213,13 +315,27 @@ class Word2Vec:
         adagrad = cfg.optimizer == "adagrad"
         self._adagrad = adagrad
         if cfg.sg and not cfg.hs:
-            self._step = build_sg_ns_step(adagrad)
+            raw = raw_sg_ns_step(adagrad)
         elif cfg.sg and cfg.hs:
-            self._step = build_sg_hs_step(adagrad)
+            raw = raw_sg_hs_step(adagrad)
         elif not cfg.sg and not cfg.hs:
-            self._step = build_cbow_ns_step(adagrad)
+            raw = raw_cbow_ns_step(adagrad)
         else:
-            self._step = build_cbow_hs_step(adagrad)
+            raw = raw_cbow_hs_step(adagrad)
+        self._scan_step = build_scan_step(raw)
+
+        if cfg.device_pipeline:
+            check(cfg.sg and not cfg.hs,
+                  "device_pipeline supports skip-gram + negative sampling")
+            sampler = self.generator.sampler
+            self._neg_table = jnp.asarray(sampler.table)
+            self._keep_prob = jnp.asarray(
+                Sampler.keep_probability(dictionary.counts, cfg.sample)
+                .astype(np.float32))
+            self._block_step = build_device_block_step(
+                cfg.window, cfg.negative, cfg.batch_size,
+                len(sampler.table), adagrad)
+            self._key = jax.random.PRNGKey(cfg.seed)
 
         self.total_words = dictionary.total_count * max(cfg.epochs, 1)
         self.trained_words = 0
@@ -237,13 +353,8 @@ class Word2Vec:
         return max(self.cfg.learning_rate * (1.0 - frac),
                    self.cfg.learning_rate * 1e-4)
 
-    # -- one batch through the fused step ----------------------------------
-    def _run_batch(self, batch) -> jax.Array:
-        st_in = self.input_table.store
-        st_out = self.output_table.store
-        st_gin = self.adagrad_in.store
-        st_gout = self.adagrad_out.store
-        lr = np.float32(self._current_lr() * self._push_scale)
+    # -- batch -> step-arg tuple (order matches the raw step signatures) ---
+    def _batch_args(self, batch) -> Tuple[np.ndarray, ...]:
         if isinstance(batch, SkipGramBatch):
             if self.cfg.hs:
                 points = self.huffman.points[batch.contexts]
@@ -251,34 +362,76 @@ class Word2Vec:
                 lmask = ((np.arange(self.cfg.max_code_length)[None, :] <
                           self.huffman.lengths[batch.contexts][:, None])
                          .astype(np.float32) * batch.mask[:, None])
-                args = (batch.centers, points, codes, lmask, lr)
-            else:
-                args = (batch.centers, batch.contexts, batch.negatives,
-                        batch.mask, lr)
-        else:  # CBOW
-            if self.cfg.hs:
-                points = self.huffman.points[batch.centers]
-                codes = self.huffman.codes[batch.centers]
-                lmask = ((np.arange(self.cfg.max_code_length)[None, :] <
-                          self.huffman.lengths[batch.centers][:, None])
-                         .astype(np.float32) * batch.mask[:, None])
-                args = (batch.centers, batch.contexts, batch.context_mask,
-                        points, codes, lmask, lr)
-            else:
-                args = (batch.centers, batch.contexts, batch.context_mask,
-                        batch.negatives, batch.mask, lr)
+                return (batch.centers, points, codes, lmask)
+            return (batch.centers, batch.contexts, batch.negatives,
+                    batch.mask)
+        if self.cfg.hs:
+            points = self.huffman.points[batch.centers]
+            codes = self.huffman.codes[batch.centers]
+            lmask = ((np.arange(self.cfg.max_code_length)[None, :] <
+                      self.huffman.lengths[batch.centers][:, None])
+                     .astype(np.float32) * batch.mask[:, None])
+            return (batch.centers, batch.contexts, batch.context_mask,
+                    points, codes, lmask)
+        return (batch.centers, batch.contexts, batch.context_mask,
+                batch.negatives, batch.mask)
+
+    # -- group producer: stacked [N, ...] scan inputs ----------------------
+    def _group_iter(self, sentences):
+        """Yields (stacked_args, words, pairs) — one jitted dispatch each.
+        Runs on the prefetch thread in pipeline mode, so host-side batch
+        assembly overlaps device execution (the reference's omp prefetch
+        pipeline, distributed_wordembedding.cpp:203-212)."""
+        N = max(1, self.cfg.scan_group)
+        pending_args: List[Tuple[np.ndarray, ...]] = []
+        pending_words = 0
+        pending_pairs = 0
+
+        def emit():
+            nonlocal pending_args, pending_words, pending_pairs
+            args = pending_args
+            if len(args) < N:   # pad with zero (masked-out) batches
+                zero = tuple(np.zeros_like(a) for a in args[0])
+                args = args + [zero] * (N - len(args))
+            stacked = tuple(np.stack([a[i] for a in args])
+                            for i in range(len(args[0])))
+            out = (stacked, pending_words, pending_pairs)
+            pending_args, pending_words, pending_pairs = [], 0, 0
+            return out
+
+        for block in BlockStream(sentences, self.cfg.block_words,
+                                 prefetch=False):
+            pending_words += sum(len(s) for s in block)
+            for batch in self.generator.batches(block):
+                pending_args.append(self._batch_args(batch))
+                pending_pairs += batch.n_words
+                if len(pending_args) == N:
+                    yield emit()
+        if pending_args:
+            yield emit()
+
+    def _run_group(self, stacked_args) -> jax.Array:
+        st_in = self.input_table.store
+        st_out = self.output_table.store
+        st_gin = self.adagrad_in.store
+        st_gout = self.adagrad_out.store
+        lr = np.float32(self._current_lr() * self._push_scale)
         (st_in.data, st_out.data, st_gin.data, st_gout.data,
-         loss) = self._step(st_in.data, st_out.data, st_gin.data,
-                            st_gout.data, *args)
+         loss) = self._scan_step(st_in.data, st_out.data, st_gin.data,
+                                 st_gout.data, *stacked_args, lr)
         return loss
 
     # -- training loop (ref TrainNeuralNetwork :147-237) -------------------
     def train(self, sentences: Optional[Iterable[Sequence[int]]] = None,
               corpus_path: Optional[str] = None,
               epochs: Optional[int] = None) -> dict:
+        from multiverso_tpu.utils.async_buffer import ASyncBuffer
+
         epochs = epochs if epochs is not None else self.cfg.epochs
         check(sentences is not None or corpus_path is not None,
               "need sentences or corpus_path")
+        if self.cfg.device_pipeline:
+            return self._train_device(sentences, corpus_path, epochs)
         t0 = time.perf_counter()
         losses: List[jax.Array] = []
         total_pairs = 0
@@ -288,17 +441,33 @@ class Word2Vec:
                                    for s in read_corpus(corpus_path))
             else:
                 sents = iter(sentences)
-            for block in BlockStream(sents, self.cfg.block_words,
-                                     prefetch=self.cfg.pipeline):
-                with monitor("W2V_BLOCK"):
-                    block_words = sum(len(s) for s in block)
-                    for batch in self.generator.batches(block):
-                        losses.append(self._run_batch(batch))
-                        total_pairs += batch.n_words
-                    self.trained_words += block_words
-                    # word-count table drives the lr schedule across workers
-                    # (ref distributed_wordembedding.cpp:92-134)
-                    self.wordcount_table.add([_WORDCOUNT_KEY], [block_words])
+            groups = self._group_iter(sents)
+            if self.cfg.pipeline:
+                it = groups
+                buf: ASyncBuffer = ASyncBuffer(lambda: next(it, None))
+                def drain():
+                    while True:
+                        item = buf.get()
+                        if item is None:
+                            return
+                        yield item
+                source: Iterable = drain()
+            else:
+                buf = None
+                source = groups
+            try:
+                for stacked, words, pairs in source:
+                    with monitor("W2V_GROUP"):
+                        losses.append(self._run_group(stacked))
+                    total_pairs += pairs
+                    self.trained_words += words
+                    if words:
+                        # word-count table drives the lr schedule across
+                        # workers (ref distributed_wordembedding.cpp:92-134)
+                        self.wordcount_table.add([_WORDCOUNT_KEY], [words])
+            finally:
+                if buf is not None:
+                    buf.close()
         jax.block_until_ready(self.input_table.store.data)
         elapsed = time.perf_counter() - t0
         self.words_per_sec = self.trained_words / max(elapsed, 1e-9)
@@ -307,6 +476,94 @@ class Word2Vec:
         log.info("word2vec: %d words, %d pairs, %.0f words/sec, loss=%.4f",
                  self.trained_words, total_pairs, self.words_per_sec,
                  mean_loss)
+        return {"words": self.trained_words, "pairs": total_pairs,
+                "words_per_sec": self.words_per_sec, "loss": mean_loss,
+                "seconds": elapsed}
+
+    # -- device-pipeline training loop -------------------------------------
+    def _sentence_blocks(self, sentences):
+        """[S, L] int32 sentence matrix + lengths per block; long sentences
+        split at the pad length, short blocks zero-padded."""
+        S, L = self.cfg.block_sentences, self.cfg.pad_sentence_length
+        mat = np.zeros((S, L), dtype=np.int32)
+        lens = np.zeros(S, dtype=np.int32)
+        row = 0
+        words = 0
+        for sent in sentences:
+            sent = np.asarray(sent, dtype=np.int32)
+            for i in range(0, max(len(sent), 1), L):
+                piece = sent[i:i + L]
+                if len(piece) == 0:
+                    continue
+                mat[row, :len(piece)] = piece
+                lens[row] = len(piece)
+                words += len(piece)
+                row += 1
+                if row == S:
+                    yield mat, lens, words
+                    mat = np.zeros((S, L), dtype=np.int32)
+                    lens = np.zeros(S, dtype=np.int32)
+                    row, words = 0, 0
+        if row:
+            yield mat, lens, words
+
+    def _train_device(self, sentences, corpus_path, epochs) -> dict:
+        from multiverso_tpu.utils.async_buffer import ASyncBuffer
+
+        t0 = time.perf_counter()
+        losses: List[jax.Array] = []
+        pair_counts: List[jax.Array] = []
+        st_in = self.input_table.store
+        st_out = self.output_table.store
+        st_gin = self.adagrad_in.store
+        st_gout = self.adagrad_out.store
+        for _ in range(epochs):
+            if corpus_path is not None:
+                sents: Iterable = (self.dict.encode(s)
+                                   for s in read_corpus(corpus_path))
+            else:
+                sents = iter(sentences)
+            blocks = self._sentence_blocks(sents)
+            if self.cfg.pipeline:
+                it = blocks
+                buf: ASyncBuffer = ASyncBuffer(lambda: next(it, None))
+                def drain():
+                    while True:
+                        item = buf.get()
+                        if item is None:
+                            return
+                        yield item
+                source: Iterable = drain()
+            else:
+                buf = None
+                source = blocks
+            try:
+                for mat, lens, words in source:
+                    with monitor("W2V_DEVICE_BLOCK"):
+                        self._key, sub = jax.random.split(self._key)
+                        lr = np.float32(self._current_lr() *
+                                        self._push_scale)
+                        (st_in.data, st_out.data, st_gin.data, st_gout.data,
+                         loss, pairs) = self._block_step(
+                            st_in.data, st_out.data, st_gin.data,
+                            st_gout.data, self._neg_table, self._keep_prob,
+                            mat, lens, sub, lr)
+                    losses.append(loss)
+                    pair_counts.append(pairs)
+                    self.trained_words += words
+                    self.wordcount_table.add([_WORDCOUNT_KEY], [words])
+            finally:
+                if buf is not None:
+                    buf.close()
+        jax.block_until_ready(st_in.data)
+        elapsed = time.perf_counter() - t0
+        self.words_per_sec = self.trained_words / max(elapsed, 1e-9)
+        total_pairs = int(sum(int(p) for p in pair_counts))
+        mean_loss = (float(np.mean([float(l) for l in losses[-50:]]))
+                     if losses else 0.0)
+        log.info("word2vec[device]: %d words, %d pairs, %.0f words/sec, "
+                 "loss=%.4f", self.trained_words, total_pairs,
+                 self.words_per_sec, mean_loss)
         return {"words": self.trained_words, "pairs": total_pairs,
                 "words_per_sec": self.words_per_sec, "loss": mean_loss,
                 "seconds": elapsed}

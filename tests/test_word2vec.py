@@ -147,3 +147,24 @@ def test_save_embeddings(tmp_path, mv_env):
     first = lines[1].split()
     assert first[0] in d.word2id
     assert len(first) == 9
+
+
+def test_device_pipeline_matches_host_semantics(mv_env):
+    """Device-side pair-gen path must train to the same topic separation."""
+    sents = _corpus(300)
+    d = Dictionary.build(sents, min_count=1)
+    cfg = Word2VecConfig(embedding_size=32, batch_size=512, window=4,
+                         negative=5, min_count=1, sample=0, sg=True,
+                         epochs=3, learning_rate=0.1, seed=3,
+                         device_pipeline=True, block_sentences=128,
+                         pad_sentence_length=16, pipeline=True)
+    w2v = Word2Vec(cfg, d)
+    stats = w2v.train(sentences=[d.encode(s) for s in sents])
+    assert stats["pairs"] > 0
+    emb = w2v.embeddings()
+    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
+    a_ids = [d.word2id[w] for w in d.words if w.startswith("a")]
+    b_ids = [d.word2id[w] for w in d.words if w.startswith("b")]
+    intra = np.mean([emb[i] @ emb[j] for i in a_ids for j in a_ids if i != j])
+    inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
+    assert intra > inter + 0.1, f"intra={intra:.3f} inter={inter:.3f}"
