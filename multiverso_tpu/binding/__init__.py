@@ -1,0 +1,5 @@
+from multiverso_tpu.binding.param_manager import (PyTreeParamManager,
+                                                  SyncCallback,
+                                                  TorchParamManager)
+
+__all__ = ["PyTreeParamManager", "TorchParamManager", "SyncCallback"]

@@ -1,0 +1,144 @@
+"""Framework param managers: sync a model's parameters through one table.
+
+Parity with the reference binding shims:
+
+* ``MVSharedVariable.mv_sync`` (``binding/python/multiverso/theano_ext/
+  sharedvar.py:12-75``): push (current - last_synced) delta, then pull.
+* ``MVModelParamManager`` (``theano_ext/param_manager.py:9-81``): flatten all
+  model params into ONE ArrayTable; per-batch/epoch sync; lasagne/keras
+  subclasses are the framework adapters.
+* ``MVCallback`` (``keras_ext/callbacks.py:8-39``): sync every ``freq``
+  batches.
+
+TPU-era frameworks: a JAX **pytree** manager (flax/optax models are pytrees)
+and a torch ``nn.Module`` adapter (torch-cpu is in the image; the dlpack hop
+stands in for the Lua/Torch binding capability).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import multiverso_tpu as mv
+
+
+class PyTreeParamManager:
+    """Flattens a JAX pytree of arrays into one ArrayTable and syncs it.
+
+    ASGD semantics across workers: each worker pushes its local delta since
+    the last sync and pulls the merged global parameters.
+    """
+
+    def __init__(self, params: Any, name: str = "pytree_params"):
+        import jax
+
+        self._treedef = jax.tree_util.tree_structure(params)
+        leaves = jax.tree_util.tree_leaves(params)
+        self._shapes = [np.shape(l) for l in leaves]
+        self._sizes = [int(np.prod(s)) if s else 1 for s in self._shapes]
+        self._dtypes = [np.asarray(l).dtype for l in leaves]
+        total = sum(self._sizes)
+        self.table = mv.create_table(
+            mv.ArrayTableOption(size=total, name=name))
+        # Master seeds the initial values; everyone else contributes zero
+        # (the reference's master-only init trick, tables.py:58-75).
+        if mv.is_master_worker():
+            self.table.add(self._flatten(params))
+        else:
+            self.table.add(np.zeros(total, dtype=np.float32))
+        mv.barrier()
+        self._last_synced = self.table.get()
+
+    def _flatten(self, params: Any) -> np.ndarray:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(params)
+        return np.concatenate(
+            [np.asarray(l, dtype=np.float32).ravel() for l in leaves])
+
+    def _unflatten(self, flat: np.ndarray) -> Any:
+        import jax
+
+        leaves = []
+        offset = 0
+        for shape, size, dtype in zip(self._shapes, self._sizes,
+                                      self._dtypes):
+            leaves.append(flat[offset:offset + size].reshape(shape)
+                          .astype(dtype))
+            offset += size
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def sync(self, params: Any) -> Any:
+        """Push local delta, pull global params (mv_sync analog)."""
+        current = self._flatten(params)
+        self.table.add(current - self._last_synced)
+        self._last_synced = self.table.get()
+        return self._unflatten(self._last_synced)
+
+    def get(self) -> Any:
+        self._last_synced = self.table.get()
+        return self._unflatten(self._last_synced)
+
+
+class TorchParamManager:
+    """Same contract for a torch ``nn.Module`` (the Lua/Torch binding's
+    ArrayTableHandler role, ``binding/lua/ArrayTableHandler.lua:6-56``)."""
+
+    def __init__(self, module: Any, name: str = "torch_params"):
+        self._module = module
+        self._params = list(module.parameters())
+        self._sizes = [int(p.numel()) for p in self._params]
+        total = sum(self._sizes)
+        self.table = mv.create_table(
+            mv.ArrayTableOption(size=total, name=name))
+        if mv.is_master_worker():
+            self.table.add(self._flatten())
+        else:
+            self.table.add(np.zeros(total, dtype=np.float32))
+        mv.barrier()
+        self._last_synced = self.table.get()
+        self._write_back(self._last_synced)
+
+    def _flatten(self) -> np.ndarray:
+        return np.concatenate(
+            [p.detach().cpu().numpy().astype(np.float32).ravel()
+             for p in self._params])
+
+    def _write_back(self, flat: np.ndarray) -> None:
+        import torch
+
+        offset = 0
+        with torch.no_grad():
+            for p, size in zip(self._params, self._sizes):
+                chunk = flat[offset:offset + size].reshape(tuple(p.shape))
+                p.copy_(torch.from_numpy(np.ascontiguousarray(chunk)))
+                offset += size
+
+    def sync(self) -> None:
+        current = self._flatten()
+        self.table.add(current - self._last_synced)
+        self._last_synced = self.table.get()
+        self._write_back(self._last_synced)
+
+
+class SyncCallback:
+    """Sync every ``freq`` batches (keras MVCallback analog,
+    callbacks.py:8-39)."""
+
+    def __init__(self, manager: Any, freq: int = 1):
+        self.manager = manager
+        self.freq = max(1, freq)
+        self._batch = 0
+        self.latest: Optional[Any] = None
+
+    def on_batch_end(self, params: Optional[Any] = None) -> Optional[Any]:
+        self._batch += 1
+        if self._batch % self.freq == 0:
+            if params is not None:
+                self.latest = self.manager.sync(params)
+            else:
+                self.manager.sync()
+            return self.latest
+        return None

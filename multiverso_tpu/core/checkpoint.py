@@ -1,0 +1,131 @@
+"""Checkpoint / resume for tables.
+
+Parity with the reference's ``ServerTable : Serializable {Store, Load}``
+surface (``include/multiverso/table_interface.h:61-75``; raw dumps at
+``src/table/array_table.cpp:144-151``, ``matrix_table.cpp:457-464``) plus the
+periodic-trigger/restore driver the reference's Docker tests referenced but
+the core had dropped (SURVEY.md §5: "no periodic trigger in-core").
+
+TPU-native: table payloads (parameter array + updater state, already
+device-sharded) serialize as npz through the URI-schemed Stream layer; the
+:class:`CheckpointManager` adds step-interval triggers, retention, and
+latest-checkpoint resume.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from multiverso_tpu.core.zoo import Zoo
+from multiverso_tpu.utils.log import check, log
+from multiverso_tpu.utils.stream import exists, open_stream
+
+
+def save_table(table: Any, uri: str) -> None:
+    """``ServerTable::Store`` analog: table payload -> stream as npz."""
+    payload = table.store_state() if hasattr(table, "store_state") \
+        else table.store.store_state()
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    with open_stream(uri, "w") as s:
+        s.write(buf.getvalue())
+
+
+def load_table(table: Any, uri: str) -> None:
+    """``ServerTable::Load`` analog."""
+    with open_stream(uri, "r") as s:
+        data = np.load(io.BytesIO(s.read()))
+        payload = {k: data[k] for k in data.files}
+    if hasattr(table, "load_state"):
+        table.load_state(payload)
+    else:
+        table.store.load_state(payload)
+
+
+def save_all(directory: str, step: int = 0) -> str:
+    """Checkpoint every registered table into ``directory/ckpt_{step}/``."""
+    zoo = Zoo.get()
+    check(zoo.started, "runtime not started")
+    root = os.path.join(directory, f"ckpt_{step:012d}")
+    names: List[str] = []
+    for i, table in enumerate(zoo.tables):
+        name = getattr(table, "name", f"table_{i}")
+        save_table(table, os.path.join(root, f"{name}.npz"))
+        names.append(name)
+    meta = {"step": step, "time": time.time(), "tables": names}
+    with open_stream(os.path.join(root, "meta.json"), "w") as s:
+        s.write(json.dumps(meta).encode())
+    return root
+
+
+def load_all(checkpoint_dir: str) -> int:
+    """Restore every registered table from a ``ckpt_*`` directory; returns
+    the step."""
+    zoo = Zoo.get()
+    with open_stream(os.path.join(checkpoint_dir, "meta.json"), "r") as s:
+        meta = json.loads(s.read().decode())
+    by_name = {getattr(t, "name", f"table_{i}"): t
+               for i, t in enumerate(zoo.tables)}
+    for name in meta["tables"]:
+        table = by_name.get(name)
+        if table is None:
+            log.error("checkpoint has unknown table '%s'; skipping", name)
+            continue
+        load_table(table, os.path.join(checkpoint_dir, f"{name}.npz"))
+    return int(meta["step"])
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    candidates = sorted(
+        d for d in os.listdir(directory)
+        if re.fullmatch(r"ckpt_\d{12}", d) and
+        os.path.exists(os.path.join(directory, d, "meta.json")))
+    if not candidates:
+        return None
+    return os.path.join(directory, candidates[-1])
+
+
+class CheckpointManager:
+    """Periodic save + retention + resume."""
+
+    def __init__(self, directory: str, save_every_steps: int = 1000,
+                 keep_last: int = 3):
+        self.directory = directory
+        self.save_every_steps = max(1, save_every_steps)
+        self.keep_last = max(1, keep_last)
+        self._last_saved_step = -1
+
+    def maybe_save(self, step: int) -> Optional[str]:
+        if step % self.save_every_steps != 0 or step == self._last_saved_step:
+            return None
+        path = save_all(self.directory, step)
+        self._last_saved_step = step
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        if not os.path.isdir(self.directory):
+            return
+        ckpts = sorted(
+            d for d in os.listdir(self.directory)
+            if re.fullmatch(r"ckpt_\d{12}", d))
+        for stale in ckpts[:-self.keep_last]:
+            full = os.path.join(self.directory, stale)
+            for f in os.listdir(full):
+                os.unlink(os.path.join(full, f))
+            os.rmdir(full)
+
+    def restore_latest(self) -> Optional[int]:
+        path = latest_checkpoint(self.directory)
+        if path is None:
+            return None
+        return load_all(path)

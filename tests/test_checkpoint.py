@@ -1,0 +1,119 @@
+"""Checkpoint/resume + stream IO tests (ref Store/Load surface,
+table_interface.h:61-75; streams io.h:24-132)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.core import checkpoint as ckpt
+from multiverso_tpu.utils.stream import (StreamError, TextReader, exists,
+                                         open_stream, register_scheme)
+
+
+def test_stream_roundtrip(tmp_path):
+    uri = f"file://{tmp_path}/sub/dir/data.bin"
+    with open_stream(uri, "w") as s:
+        s.write(b"hello multiverso")
+    assert exists(uri)
+    with open_stream(uri, "r") as s:
+        assert s.read() == b"hello multiverso"
+
+
+def test_plain_path_is_file_scheme(tmp_path):
+    p = str(tmp_path / "x.bin")
+    with open_stream(p, "w") as s:
+        s.write(b"1")
+    assert exists(p)
+
+
+def test_unknown_and_gated_schemes(tmp_path):
+    with pytest.raises(StreamError):
+        open_stream("weird://x", "r")
+    with pytest.raises(StreamError):
+        open_stream("gs://bucket/obj", "r")
+
+
+def test_register_scheme(tmp_path):
+    calls = []
+
+    def opener(path, mode):
+        calls.append(path)
+        return open(str(tmp_path / "custom.bin"), mode + "b")
+
+    register_scheme("mem", opener)
+    with open_stream("mem://anything", "w") as s:
+        s.write(b"x")
+    assert calls == ["anything"]
+
+
+def test_text_reader(tmp_path):
+    p = tmp_path / "lines.txt"
+    p.write_text("alpha\nbeta\r\ngamma")
+    with TextReader(f"file://{p}") as r:
+        assert list(r) == ["alpha", "beta", "gamma"]
+        assert r.get_line() is None
+
+
+def test_array_table_store_load(tmp_path, mv_env):
+    t = mv.create_table(mv.ArrayTableOption(size=100, updater="adagrad"))
+    t.add(np.ones(100, dtype=np.float32), mv.AddOption(rho=0.1,
+                                                       learning_rate=0.1))
+    before = t.get()
+    uri = f"file://{tmp_path}/array.npz"
+    ckpt.save_table(t, uri)
+    t.add(np.ones(100, dtype=np.float32), mv.AddOption(rho=0.1,
+                                                       learning_rate=0.1))
+    assert not np.allclose(t.get(), before)
+    ckpt.load_table(t, uri)
+    np.testing.assert_allclose(t.get(), before)
+    # adagrad accumulator state restored too: next add matches a replay
+    t.add(np.ones(100, dtype=np.float32), mv.AddOption(rho=0.1,
+                                                       learning_rate=0.1))
+    replay = t.get()
+    ckpt.load_table(t, uri)
+    t.add(np.ones(100, dtype=np.float32), mv.AddOption(rho=0.1,
+                                                       learning_rate=0.1))
+    np.testing.assert_allclose(t.get(), replay)
+
+
+def test_save_all_load_all(tmp_path, mv_env):
+    a = mv.create_table(mv.ArrayTableOption(size=10, name="weights"))
+    m = mv.create_table(mv.MatrixTableOption(num_row=4, num_col=4,
+                                             name="embed"))
+    kv = mv.create_table(mv.KVTableOption(name="counts"))
+    a.add(np.ones(10, dtype=np.float32))
+    m.add(np.full((4, 4), 2.0, dtype=np.float32))
+    kv.add([7], [3.0])
+    path = ckpt.save_all(str(tmp_path), step=42)
+    assert os.path.exists(os.path.join(path, "meta.json"))
+    a.add(np.ones(10, dtype=np.float32))
+    kv.add([7], [10.0])
+    step = ckpt.load_all(path)
+    assert step == 42
+    np.testing.assert_allclose(a.get(), np.ones(10))
+    np.testing.assert_allclose(m.get(), np.full((4, 4), 2.0))
+    np.testing.assert_allclose(kv.get([7]), [3.0])
+
+
+def test_checkpoint_manager_periodic_and_resume(tmp_path, mv_env):
+    t = mv.create_table(mv.ArrayTableOption(size=4, name="w"))
+    mgr = ckpt.CheckpointManager(str(tmp_path), save_every_steps=10,
+                                 keep_last=2)
+    for step in range(1, 41):
+        t.add(np.ones(4, dtype=np.float32))
+        mgr.maybe_save(step)
+    # retention: only 2 newest kept
+    kept = sorted(d for d in os.listdir(tmp_path) if d.startswith("ckpt_"))
+    assert kept == ["ckpt_000000000030", "ckpt_000000000040"]
+    # resume restores the newest
+    t.add(np.full(4, 100.0, dtype=np.float32))
+    step = mgr.restore_latest()
+    assert step == 40
+    np.testing.assert_allclose(t.get(), np.full(4, 40.0))
+
+
+def test_restore_latest_empty_dir(tmp_path, mv_env):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "nope"))
+    assert mgr.restore_latest() is None
