@@ -1,0 +1,67 @@
+"""LogisticRegression CLI.
+
+Parity with ``Applications/LogisticRegression/src/main.cpp``: train/test from
+a key=value config file (ref ``configure.h:9-115``) or flags.
+
+Usage:
+    python -m multiverso_tpu.apps.logreg_main -config_file=lr.conf \
+        -train_file=train.libsvm -test_file=test.libsvm
+"""
+
+from __future__ import annotations
+
+import sys
+
+import multiverso_tpu as mv
+from multiverso_tpu.utils import configure
+from multiverso_tpu.utils.dashboard import Dashboard
+from multiverso_tpu.utils.log import log
+
+configure.define_string("config_file", "", "key=value config file")
+configure.define_string("lr_train_file", "", "training data")
+configure.define_string("lr_test_file", "", "test data")
+configure.define_string("output_file", "", "prediction output path")
+
+
+def main(argv=None) -> int:
+    argv = mv.init(argv if argv is not None else sys.argv[1:])
+    try:
+        from multiverso_tpu.models.logreg import (LogReg, LogRegConfig,
+                                                  SampleReader)
+
+        config_file = configure.get_flag("config_file")
+        cfg = (LogRegConfig.from_file(config_file) if config_file
+               else LogRegConfig())
+        train_file = configure.get_flag("lr_train_file")
+        test_file = configure.get_flag("lr_test_file")
+        if not train_file:
+            log.error("missing -lr_train_file")
+            return 1
+        if cfg.num_feature <= 0:
+            log.error("config must set num_feature")
+            return 1
+
+        lr = LogReg(cfg)
+        reader = SampleReader(train_file, cfg.num_feature,
+                              cfg.minibatch_size,
+                              input_format=cfg.input_format, bias=cfg.bias)
+        losses = lr.train(reader)
+        log.info("train losses per epoch: %s",
+                 ", ".join(f"{l:.5f}" for l in losses))
+        if test_file:
+            test_reader = SampleReader(test_file, cfg.num_feature,
+                                       cfg.minibatch_size,
+                                       input_format=cfg.input_format,
+                                       bias=cfg.bias)
+            acc = lr.test(test_reader,
+                          output_path=configure.get_flag("output_file")
+                          or None)
+            log.info("test accuracy: %.4f", acc)
+        Dashboard.display()
+        return 0
+    finally:
+        mv.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Distributed WordEmbedding CLI.
+
+Parity with ``Applications/WordEmbedding/src/main.cpp`` +
+``distributed_wordembedding.cpp``: train word vectors from a text corpus,
+flags named after the reference/word2vec conventions (``util.h:20-44``),
+rank-0 embedding export.
+
+Usage:
+    python -m multiverso_tpu.apps.word2vec_main \
+        -train_file=corpus.txt -output_file=vectors.txt \
+        -size=100 -window=5 -negative=5 -min_count=5 -epoch=1
+"""
+
+from __future__ import annotations
+
+import sys
+
+import multiverso_tpu as mv
+from multiverso_tpu.utils import configure
+from multiverso_tpu.utils.dashboard import Dashboard
+from multiverso_tpu.utils.log import log
+
+configure.define_string("train_file", "", "input corpus (text)")
+configure.define_string("output_file", "vectors.txt", "embedding output")
+configure.define_int("size", 100, "embedding dimension")
+configure.define_int("window", 5, "context window")
+configure.define_int("negative", 5, "negative samples (0 -> use -hs)")
+configure.define_int("min_count", 5, "vocab frequency cutoff")
+configure.define_int("epoch", 1, "training epochs")
+configure.define_double("alpha", 0.05, "learning rate")
+configure.define_double("sample", 1e-3, "frequent-word subsample rate")
+configure.define_bool("cbow", False, "CBOW instead of skip-gram")
+configure.define_bool("hs", False, "hierarchical softmax")
+configure.define_int("batch_size", 8192, "pairs per device minibatch")
+configure.define_bool("is_pipeline", True, "prefetch pipeline")
+configure.define_int("data_block_size", 100000, "words per block")
+configure.define_string("w2v_optimizer", "adagrad", "adagrad|sgd")
+configure.define_bool("use_device_pipeline", True,
+                      "on-device pair generation (sg+ns only)")
+configure.define_int("block_sentences", 512,
+                     "sentences per device block (device pipeline)")
+configure.define_int("pad_sentence_length", 512,
+                     "sentence pad length (device pipeline)")
+
+
+def main(argv=None) -> int:
+    argv = mv.init(argv if argv is not None else sys.argv[1:])
+    try:
+        from multiverso_tpu.models.word2vec import (Dictionary, Word2Vec,
+                                                    Word2VecConfig,
+                                                    read_corpus)
+
+        train_file = configure.get_flag("train_file")
+        if not train_file:
+            log.error("missing -train_file")
+            return 1
+        sg = not configure.get_flag("cbow")
+        hs = configure.get_flag("hs")
+        log.info("building vocabulary from %s", train_file)
+        dictionary = Dictionary.build(
+            read_corpus(train_file),
+            min_count=configure.get_flag("min_count"))
+        log.info("vocab=%d total_words=%d", len(dictionary),
+                 dictionary.total_count)
+
+        cfg = Word2VecConfig(
+            embedding_size=configure.get_flag("size"),
+            window=configure.get_flag("window"),
+            negative=configure.get_flag("negative"),
+            min_count=configure.get_flag("min_count"),
+            sample=configure.get_flag("sample"),
+            batch_size=configure.get_flag("batch_size"),
+            learning_rate=configure.get_flag("alpha"),
+            epochs=configure.get_flag("epoch"),
+            sg=sg, hs=hs,
+            optimizer=configure.get_flag("w2v_optimizer"),
+            block_words=configure.get_flag("data_block_size"),
+            pipeline=configure.get_flag("is_pipeline"),
+            device_pipeline=(configure.get_flag("use_device_pipeline")
+                             and sg and not hs),
+            block_sentences=configure.get_flag("block_sentences"),
+            pad_sentence_length=configure.get_flag("pad_sentence_length"),
+        )
+        w2v = Word2Vec(cfg, dictionary)
+        stats = w2v.train(corpus_path=train_file)
+        log.info("trained: %.0f words/sec", stats["words_per_sec"])
+        w2v.save(configure.get_flag("output_file"))
+        Dashboard.display()
+        return 0
+    finally:
+        mv.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

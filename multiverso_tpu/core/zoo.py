@@ -99,6 +99,15 @@ class Zoo:
         self.ma_mode = configure.get_flag("ma")
         self.sync_mode = configure.get_flag("sync")
         self._num_local_workers = max(1, int(num_local_workers))
+        # Multi-controller bring-up: the RegisterNode/Controller handshake
+        # (ref src/controller.cpp:38-80) maps to jax.distributed's
+        # coordination service — rank 0 hosts it, everyone registers.
+        coordinator = configure.get_flag("coordinator")
+        if coordinator:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=configure.get_flag("world_size"),
+                process_id=configure.get_flag("rank"))
         # Mesh = the server set (unless ma mode, which is allreduce-only —
         # still build the mesh: aggregate uses it).
         self.mesh = mesh_lib.build_mesh(devices=devices)

@@ -191,3 +191,9 @@ define_int("port", 55555, "transport port (ref zmq_net.h:21)")
 define_string("mesh_shape", "", "comma 'axis:size' list, e.g. 'server:8'; "
               "empty = one axis over all devices")
 define_bool("deterministic", False, "force deterministic reductions")
+# Multi-controller bring-up (the Controller/RegisterNode analog,
+# ref src/controller.cpp:38-80 -> jax.distributed coordination service).
+define_string("coordinator", "", "host:port of the jax.distributed "
+              "coordinator; empty = single-process")
+define_int("world_size", 1, "number of processes (ranks)")
+define_int("rank", 0, "this process's rank")

@@ -1,0 +1,38 @@
+"""Tracing/profiling hooks.
+
+The reference's observability is Dashboard counters around hot spots
+(SURVEY.md §5 "Tracing / profiling"). On TPU the equivalent first-class tool
+is the XLA profiler: :func:`trace` wraps ``jax.profiler`` so a training span
+can be captured and inspected (TensorBoard / xprof), and
+:func:`annotate` marks named regions that show up both in the device trace
+and the host Dashboard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+from multiverso_tpu.utils.dashboard import monitor
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device+host profile for the enclosed span."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region: device trace annotation + Dashboard counter."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        with monitor(name):
+            yield

@@ -1,0 +1,74 @@
+"""Wire compression filters for host<->device / cross-process transfer.
+
+Parity with ``include/multiverso/util/quantization_util.h:10-164``:
+
+* ``SparseFilter``: sparsify a buffer to (index, value) pairs when more than
+  half the entries are within a clip threshold of zero; a side-channel marks
+  whether the payload is compressed (-1 = raw there; a bool here).
+* ``OneBitsFilter``: 1-bit quantization with per-buffer scale + error
+  feedback — an empty stub in the reference (``:160-161``), implemented here.
+
+Used where bytes actually cross a slow link (host staging drains, DCN
+transfers, checkpoint streams); on-chip traffic needs no filtering — ICI
+collectives are XLA's business.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class SparseFilter:
+    """(index, value) compaction for mostly-small buffers
+    (ref quantization_util.h FilterIn/FilterOut)."""
+
+    def __init__(self, clip: float = 0.0):
+        self.clip = clip
+
+    def filter_in(self, values: np.ndarray
+                  ) -> Tuple[bool, np.ndarray, Optional[np.ndarray]]:
+        """Returns (compressed, payload, indices). Compresses only when >50%
+        of entries are within the clip threshold (the reference's rule)."""
+        flat = np.asarray(values).ravel()
+        small = np.abs(flat) <= self.clip
+        if small.sum() * 2 <= len(flat):
+            return False, flat, None
+        idx = np.flatnonzero(~small).astype(np.int32)
+        return True, flat[idx], idx
+
+    def filter_out(self, compressed: bool, payload: np.ndarray,
+                   indices: Optional[np.ndarray], size: int,
+                   dtype=np.float32) -> np.ndarray:
+        if not compressed:
+            return payload.astype(dtype, copy=False).reshape(size)
+        out = np.zeros(size, dtype=dtype)
+        out[indices] = payload
+        return out
+
+
+class OneBitsFilter:
+    """1-bit SGD quantization with error feedback (stateful per link)."""
+
+    def __init__(self, size: int):
+        self._residual = np.zeros(size, dtype=np.float32)
+
+    def encode(self, values: np.ndarray
+               ) -> Tuple[np.ndarray, float, float]:
+        """Returns (bits packed as uint8, pos_scale, neg_scale); adds the
+        carried quantization error before encoding."""
+        v = np.asarray(values, dtype=np.float32).ravel() + self._residual
+        pos = v > 0
+        pos_scale = float(v[pos].mean()) if pos.any() else 0.0
+        neg_scale = float(v[~pos].mean()) if (~pos).any() else 0.0
+        decoded = np.where(pos, pos_scale, neg_scale).astype(np.float32)
+        self._residual = v - decoded
+        return np.packbits(pos), pos_scale, neg_scale
+
+    @staticmethod
+    def decode(bits: np.ndarray, pos_scale: float, neg_scale: float,
+               size: int) -> np.ndarray:
+        pos = np.unpackbits(bits, count=size).astype(bool)
+        return np.where(pos, np.float32(pos_scale),
+                        np.float32(neg_scale))

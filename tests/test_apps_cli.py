@@ -1,0 +1,74 @@
+"""End-to-end CLI tests for the two reference applications."""
+
+import numpy as np
+import pytest
+
+
+def _write_corpus(path, n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(n):
+            topic = "a" if i % 2 == 0 else "b"
+            words = [f"{topic}{rng.integers(0, 5)}" for _ in range(15)]
+            f.write(" ".join(words) + "\n")
+
+
+def test_word2vec_cli(tmp_path):
+    from multiverso_tpu.apps.word2vec_main import main
+
+    corpus = tmp_path / "corpus.txt"
+    out = tmp_path / "vectors.txt"
+    _write_corpus(str(corpus))
+    rc = main([f"-train_file={corpus}", f"-output_file={out}",
+               "-size=16", "-window=3", "-negative=3", "-min_count=1",
+               "-epoch=1", "-batch_size=256",
+               "-use_device_pipeline=false"])
+    assert rc == 0
+    lines = out.read_text().strip().split("\n")
+    v, d = lines[0].split()
+    assert int(v) == 10 and int(d) == 16
+    assert len(lines) == 11
+
+
+def test_word2vec_cli_device_pipeline(tmp_path):
+    from multiverso_tpu.apps.word2vec_main import main
+
+    corpus = tmp_path / "corpus.txt"
+    out = tmp_path / "vectors.txt"
+    _write_corpus(str(corpus))
+    rc = main([f"-train_file={corpus}", f"-output_file={out}",
+               "-size=16", "-min_count=1", "-epoch=1", "-batch_size=256",
+               "-use_device_pipeline=true", "-block_sentences=64",
+               "-pad_sentence_length=16"])
+    assert rc == 0
+    assert out.exists()
+
+
+def test_word2vec_cli_missing_file():
+    from multiverso_tpu.apps.word2vec_main import main
+
+    assert main([]) == 1
+
+
+def test_logreg_cli(tmp_path):
+    from multiverso_tpu.apps.logreg_main import main
+
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=8)
+    train = tmp_path / "train.libsvm"
+    test = tmp_path / "test.libsvm"
+    for path, n in ((train, 300), (test, 100)):
+        with open(path, "w") as f:
+            for _ in range(n):
+                x = rng.normal(size=8)
+                y = int(x @ w > 0)
+                feats = " ".join(f"{i}:{x[i]:.4f}" for i in range(8))
+                f.write(f"{y} {feats}\n")
+    conf = tmp_path / "lr.conf"
+    conf.write_text("objective=sigmoid\nnum_feature=8\nlearning_rate=1.0\n"
+                    "minibatch_size=32\nepochs=10\n")
+    preds = tmp_path / "preds.txt"
+    rc = main([f"-config_file={conf}", f"-lr_train_file={train}",
+               f"-lr_test_file={test}", f"-output_file={preds}"])
+    assert rc == 0
+    assert len(preds.read_text().strip().split("\n")) == 100

@@ -1,0 +1,78 @@
+"""SparseFilter / OneBitsFilter / DC-ASGD tests."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.utils.quantization import OneBitsFilter, SparseFilter
+
+
+def test_sparse_filter_compresses_sparse():
+    f = SparseFilter(clip=0.01)
+    v = np.zeros(100, dtype=np.float32)
+    v[[3, 50, 99]] = [1.0, -2.0, 0.5]
+    compressed, payload, idx = f.filter_in(v)
+    assert compressed
+    assert len(payload) == 3
+    out = f.filter_out(compressed, payload, idx, 100)
+    np.testing.assert_allclose(out, v)
+
+
+def test_sparse_filter_passes_dense():
+    f = SparseFilter(clip=0.01)
+    v = np.ones(100, dtype=np.float32)
+    compressed, payload, idx = f.filter_in(v)
+    assert not compressed and idx is None
+    np.testing.assert_allclose(f.filter_out(compressed, payload, None, 100),
+                               v)
+
+
+def test_one_bit_error_feedback_converges():
+    """With error feedback, the running sum of decoded values tracks the
+    running sum of true values."""
+    f = OneBitsFilter(size=64)
+    rng = np.random.default_rng(0)
+    true_sum = np.zeros(64)
+    decoded_sum = np.zeros(64)
+    for _ in range(200):
+        v = rng.normal(size=64).astype(np.float32)
+        bits, ps, ns = f.encode(v)
+        decoded = OneBitsFilter.decode(bits, ps, ns, 64)
+        true_sum += v
+        decoded_sum += decoded
+    drift = np.abs(decoded_sum - true_sum).mean()
+    assert drift < 3.0, drift  # bounded residual, not diverging
+
+
+def test_dcasgd_updater(mv_env):
+    """data -= lr*(g + lambda*g^2*(data - backup)); backup refreshed."""
+    lr, lam = 0.1, 0.5
+    t = mv.create_table(mv.ArrayTableOption(size=3, updater="dcasgd"))
+    g = np.array([1.0, -1.0, 2.0], dtype=np.float32)
+    opt = mv.AddOption(worker_id=0, learning_rate=lr, lambda_=lam)
+    # step 1: backup == data == 0 -> plain sgd step
+    t.add(g, opt)
+    d1 = -lr * g
+    np.testing.assert_allclose(t.get(), d1, rtol=1e-6)
+    # step 2: backup was refreshed to d1, so again staleness term is zero
+    t.add(g, opt)
+    d2 = d1 - lr * g
+    np.testing.assert_allclose(t.get(), d2, rtol=1e-6)
+
+
+def test_dcasgd_compensates_stale_worker():
+    """A second worker whose backup is stale gets the compensation term
+    (needs a 2-worker world for the per-worker backup axis)."""
+    lr, lam = 0.1, 0.5
+    mv.init([], num_local_workers=2)
+    try:
+        t = mv.create_table(mv.ArrayTableOption(size=1, updater="dcasgd"))
+        g = np.array([1.0], dtype=np.float32)
+        t.add(g, mv.AddOption(worker_id=0, learning_rate=lr, lambda_=lam))
+        d1 = float(t.get()[0])
+        # worker 1 backup is still 0 -> compensated step != plain sgd
+        t.add(g, mv.AddOption(worker_id=1, learning_rate=lr, lambda_=lam))
+        expected = d1 - lr * (1.0 + lam * 1.0 * (d1 - 0.0))
+        np.testing.assert_allclose(t.get(), [expected], rtol=1e-6)
+    finally:
+        mv.shutdown()
