@@ -1,0 +1,181 @@
+"""Pallas TPU kernels for the table hot ops: dynamic row gather and sorted
+row scatter-add.
+
+These are the framework's per-row data-plane primitives — the role the
+OpenMP updater loop plays in the reference (``src/updater/updater.cpp:22-29``)
+— written as Mosaic kernels so row traffic streams HBM->VMEM via manual
+per-row DMA with scalar-prefetched indices.
+
+Mosaic constrains mapped block shapes to (8k, 128k) tiles, so arbitrary
+single rows cannot be block-mapped; instead the table stays unmapped
+(``pl.ANY`` -> HBM) and each grid step DMAs a GROUP of 8 rows addressed by
+the prefetched id array. For scatter:
+
+* ids must be SORTED ascending (callers argsort — XLA does that well), so
+  duplicates are consecutive *runs*;
+* within a group, run deltas are folded by an unrolled prefix pass and only
+  the LAST row of each run is written back — no lost updates;
+* a run spanning a group boundary is safe because the grid is sequential and
+  each step waits for its write DMAs before finishing, so the next group
+  re-reads the updated row.
+
+In-place via ``input_output_aliases`` (the table buffer is donated). The
+jitted XLA paths remain the default; these kernels are opt-in and are
+exercised in interpret mode on CPU plus numerically on the real chip.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+GROUP = 8   # rows per grid step (the float32 sublane tile)
+
+
+def _pad_ids_deltas(ids: jax.Array, deltas: jax.Array
+                    ) -> Tuple[jax.Array, jax.Array, int]:
+    """Pad to a multiple of GROUP. Padding repeats the last id with a zero
+    delta — harmless accumulate, keeps runs contiguous."""
+    n = ids.shape[0]
+    pad = (-n) % GROUP
+    if pad:
+        ids = jnp.concatenate([ids, jnp.broadcast_to(ids[-1], (pad,))])
+        deltas = jnp.concatenate(
+            [deltas, jnp.zeros((pad,) + deltas.shape[1:], deltas.dtype)])
+    return ids, deltas, n
+
+
+# ---------------------------------------------------------------------------
+# gather
+# ---------------------------------------------------------------------------
+def _gather_kernel(ids_ref, table_ref, out_ref, rows, sems):
+    g = pl.program_id(0)
+    for k in range(GROUP):
+        pltpu.make_async_copy(
+            table_ref.at[ids_ref[g * GROUP + k]],
+            rows.at[k], sems.at[k]).start()
+    for k in range(GROUP):
+        pltpu.make_async_copy(
+            table_ref.at[ids_ref[g * GROUP + k]],
+            rows.at[k], sems.at[k]).wait()
+    out_ref[:] = rows[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gather_rows(table: jax.Array, ids: jax.Array,
+                interpret: bool = False) -> jax.Array:
+    """out[i] = table[ids[i]] — GROUP-row DMA batches per grid step."""
+    n = ids.shape[0]
+    d = table.shape[1]
+    pad = (-n) % GROUP
+    if pad:
+        ids = jnp.concatenate([ids, jnp.zeros(pad, ids.dtype)])
+    n_padded = n + pad
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_padded // GROUP,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((GROUP, d), lambda g, ids_ref: (g, 0)),
+        scratch_shapes=[pltpu.VMEM((GROUP, d), table.dtype),
+                        pltpu.SemaphoreType.DMA((GROUP,))],
+    )
+    out = pl.pallas_call(
+        _gather_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_padded, d), table.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(ids.astype(jnp.int32), table)
+    return out[:n]
+
+
+# ---------------------------------------------------------------------------
+# scatter-add (ids must be sorted ascending)
+# ---------------------------------------------------------------------------
+def _scatter_kernel(ids_ref, delta_ref, table_in_ref, table_ref, rows, sems):
+    del table_in_ref  # aliased with table_ref (the output)
+    g = pl.program_id(0)
+    n_groups = pl.num_programs(0)
+    base = g * GROUP
+
+    # Load the group's rows.
+    for k in range(GROUP):
+        pltpu.make_async_copy(table_ref.at[ids_ref[base + k]],
+                              rows.at[k], sems.at[k]).start()
+    for k in range(GROUP):
+        pltpu.make_async_copy(table_ref.at[ids_ref[base + k]],
+                              rows.at[k], sems.at[k]).wait()
+
+    # Fold duplicate-id runs: acc[k] = delta[k] (+ acc[k-1] if same id).
+    acc = [None] * GROUP
+    acc[0] = delta_ref[0, :]
+    for k in range(1, GROUP):
+        same = ids_ref[base + k] == ids_ref[base + k - 1]
+        acc[k] = delta_ref[k, :] + jnp.where(same, acc[k - 1],
+                                             jnp.zeros_like(acc[k - 1]))
+
+    # Write back only the LAST row of each run (run end = id changes next,
+    # or this is the very last element overall).
+    last_group = g == n_groups - 1
+    for k in range(GROUP):
+        if k < GROUP - 1:
+            is_run_end = ids_ref[base + k] != ids_ref[base + k + 1]
+        else:
+            # Last lane: run end unless the run continues into next group.
+            nxt = jnp.minimum(base + GROUP,
+                              n_groups * GROUP - 1)
+            is_run_end = jnp.logical_or(
+                last_group, ids_ref[base + k] != ids_ref[nxt])
+
+        @pl.when(is_run_end)
+        def _(k=k):
+            rows[k, :] = rows[k, :] + acc[k]
+            pltpu.make_async_copy(rows.at[k],
+                                  table_ref.at[ids_ref[base + k]],
+                                  sems.at[k]).start()
+            pltpu.make_async_copy(rows.at[k],
+                                  table_ref.at[ids_ref[base + k]],
+                                  sems.at[k]).wait()
+
+        # Run continues into the next lane/group: carry, write nothing.
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def scatter_add_sorted_rows(table: jax.Array, sorted_ids: jax.Array,
+                            sorted_deltas: jax.Array,
+                            interpret: bool = False) -> jax.Array:
+    """table[ids[i]] += deltas[i] for SORTED ids; in-place (donated)."""
+    sorted_ids, sorted_deltas, _ = _pad_ids_deltas(sorted_ids, sorted_deltas)
+    n = sorted_ids.shape[0]
+    d = table.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // GROUP,),
+        in_specs=[pl.BlockSpec((GROUP, d), lambda g, ids_ref: (g, 0)),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((GROUP, d), table.dtype),
+                        pltpu.SemaphoreType.DMA((GROUP,))],
+    )
+    return pl.pallas_call(
+        _scatter_kernel,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},   # table (after ids, deltas) -> out
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(sorted_ids.astype(jnp.int32), sorted_deltas, table)
+
+
+def scatter_add_rows(table: jax.Array, ids: jax.Array, deltas: jax.Array,
+                     interpret: bool = False) -> jax.Array:
+    """Unsorted convenience wrapper: argsort (XLA), then the kernel."""
+    order = jnp.argsort(ids)
+    return scatter_add_sorted_rows(table, jnp.take(ids, order),
+                                   jnp.take(deltas, order, axis=0),
+                                   interpret=interpret)
