@@ -1,0 +1,160 @@
+"""Actor runtime: mailbox threads + typed message dispatch.
+
+Parity with the reference actor layer (``include/multiverso/actor.h:18-58``,
+``src/actor.cpp:14-55``; ``Message`` at ``include/multiverso/message.h``):
+each Actor owns a blocking mailbox (the native C++ MtQueue) drained by a
+dedicated thread that dispatches on registered per-``MsgType`` handlers;
+``create_reply`` negates the message type (``message.h:51-59``); the MsgType
+sign/range encodes the destination actor class
+(``src/communicator.cpp:15-27``).
+
+Role in the TPU build: device-side traffic needs no actors (XLA owns it),
+but the HOST side — async ASGD request routing, cross-process DCN services,
+IO pipelines — benefits from the same structured concurrency the reference
+used. The mailbox is the native MtQueue, so enqueue/dequeue never contend on
+the GIL.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from multiverso_tpu.runtime.ffi import MtQueue
+from multiverso_tpu.utils.log import check, log
+
+
+class MsgType(enum.IntEnum):
+    """Wire types (ref message.h:13-24). Sign encodes request/reply; range
+    encodes the destination actor class (communicator.cpp:15-27)."""
+    Request_Get = 1
+    Request_Add = 2
+    Reply_Get = -1
+    Reply_Add = -2
+    Server_Finish_Train = 31
+    Control_Barrier = 33
+    Control_Register = 34
+    Exit = 99
+
+
+class Message:
+    """Header + payload (ref message.h:26-68)."""
+
+    __slots__ = ("src", "dst", "type", "table_id", "msg_id", "data")
+
+    def __init__(self, src: int = -1, dst: int = -1,
+                 type: int = MsgType.Request_Get, table_id: int = -1,
+                 msg_id: int = -1, data: Optional[List[Any]] = None):
+        self.src = src
+        self.dst = dst
+        self.type = int(type)
+        self.table_id = table_id
+        self.msg_id = msg_id
+        self.data = data if data is not None else []
+
+    def create_reply(self) -> "Message":
+        """Reply inverts src/dst and negates the type (ref message.h:51-59)."""
+        return Message(src=self.dst, dst=self.src, type=-self.type,
+                       table_id=self.table_id, msg_id=self.msg_id)
+
+    # destination routing (ref communicator.cpp:15-27)
+    def to_server(self) -> bool:
+        return 0 < self.type < 32
+
+    def to_worker(self) -> bool:
+        return -32 < self.type < 0
+
+    def to_controller(self) -> bool:
+        return self.type > 32
+
+
+class Actor:
+    """Mailbox + dispatch thread (ref actor.h:18-58)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mailbox = MtQueue()
+        self._handles = itertools.count(1)
+        self._messages: Dict[int, Message] = {}
+        self._msg_lock = threading.Lock()
+        self._handlers: Dict[int, Callable[[Message], None]] = {}
+        self._thread: Optional[threading.Thread] = None
+        _registry_register(self)
+
+    # -- handler registration (ref actor.h RegisterHandler) ----------------
+    def register_handler(self, msg_type: int,
+                         handler: Callable[[Message], None]) -> None:
+        self._handlers[int(msg_type)] = handler
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        check(self._thread is None, f"actor '{self.name}' already started")
+        self._thread = threading.Thread(target=self._main,
+                                        name=f"actor-{self.name}",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self.receive(Message(type=MsgType.Exit))
+        self._thread.join(timeout=30)
+        self._mailbox.exit()
+        self._thread = None
+
+    # -- messaging -----------------------------------------------------------
+    def receive(self, msg: Message) -> None:
+        """Enqueue into this actor's mailbox (ref actor.h Receive)."""
+        handle = next(self._handles)
+        with self._msg_lock:
+            self._messages[handle] = msg
+        self._mailbox.push(handle)
+
+    def send_to(self, dst: str, msg: Message) -> None:
+        actor = _registry_get(dst)
+        check(actor is not None, f"unknown actor '{dst}'")
+        actor.receive(msg)
+
+    # -- dispatch loop (ref actor.cpp Main) -----------------------------------
+    def _main(self) -> None:
+        while True:
+            handle = self._mailbox.pop(-1)
+            if handle is None:
+                return
+            with self._msg_lock:
+                msg = self._messages.pop(handle)
+            if msg.type == MsgType.Exit:
+                return
+            handler = self._handlers.get(msg.type)
+            if handler is None:
+                log.error("actor '%s': no handler for msg type %d",
+                          self.name, msg.type)
+                continue
+            try:
+                handler(msg)
+            except Exception as e:  # noqa: BLE001 - actor must not die
+                log.error("actor '%s' handler error: %s", self.name, e)
+
+
+_actors: Dict[str, Actor] = {}
+_actors_lock = threading.Lock()
+
+
+def _registry_register(actor: Actor) -> None:
+    with _actors_lock:
+        _actors[actor.name] = actor
+
+
+def _registry_get(name: str) -> Optional[Actor]:
+    with _actors_lock:
+        return _actors.get(name)
+
+
+def stop_all_actors() -> None:
+    with _actors_lock:
+        actors = list(_actors.values())
+        _actors.clear()
+    for actor in actors:
+        actor.stop()

@@ -1,0 +1,142 @@
+"""Latent Dirichlet Allocation via blocked collapsed Gibbs sampling on the
+parameter-server tables — the lightLDA-style workload.
+
+The reference README lists lightLDA as a Multiverso-based system
+(``README.md:29-34``): topic-count tables live in the parameter server,
+workers sample locally and push count deltas. This module reproduces that
+pattern TPU-first:
+
+* ``word_topic`` counts: a row-sharded :class:`MatrixTable` [V, K] — the
+  analog of lightLDA's word-topic table.
+* ``topic`` totals: an :class:`ArrayTable` [K].
+* Workers hold doc-topic counts locally (as lightLDA does) and run a
+  **blocked** Gibbs step as ONE jitted program per token block: gather
+  word-topic rows, form the collapsed posterior
+  p(k | w, d) ∝ (n_wk + β)(n_dk + α)/(n_k + Vβ), sample categorically on
+  the VPU, and emit count deltas that scatter back into the tables. Counts
+  refresh per block, not per token — exactly the staleness model a
+  distributed PS LDA runs with.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.options import ArrayTableOption, MatrixTableOption
+from multiverso_tpu.utils.log import check, log
+
+
+@dataclasses.dataclass
+class LDAConfig:
+    num_topics: int = 16
+    alpha: float = 0.1        # doc-topic prior
+    beta: float = 0.01        # topic-word prior
+    iterations: int = 50
+    block_tokens: int = 1 << 14
+    seed: int = 0
+
+
+def _build_gibbs_step(K: int, V: int, alpha: float, beta: float):
+    def step(n_wk_rows, n_k, n_dk_rows, topics, key):
+        """One blocked Gibbs sweep over a token block.
+
+        n_wk_rows: [N, K] gathered word rows; n_k: [K]; n_dk_rows: [N, K]
+        gathered doc rows; topics: [N] current assignments.
+        Returns new topics.
+        """
+        N = topics.shape[0]
+        onehot_old = jax.nn.one_hot(topics, K, dtype=jnp.float32)
+        # Exclude the current token's own count (collapsed sampler).
+        nw = n_wk_rows - onehot_old
+        nd = n_dk_rows - onehot_old
+        nk = n_k[None, :] - onehot_old
+        logits = (jnp.log(jnp.maximum(nw + beta, 1e-10))
+                  + jnp.log(jnp.maximum(nd + alpha, 1e-10))
+                  - jnp.log(jnp.maximum(nk + V * beta, 1e-10)))
+        return jax.random.categorical(key, logits, axis=-1)
+
+    return jax.jit(step)
+
+
+class LDA:
+    def __init__(self, cfg: LDAConfig, num_docs: int, vocab_size: int):
+        check(vocab_size >= 2 and cfg.num_topics >= 2, "degenerate LDA")
+        self.cfg = cfg
+        self.V = vocab_size
+        self.D = num_docs
+        K = cfg.num_topics
+        self.word_topic = mv.create_table(MatrixTableOption(
+            vocab_size, K, name="lda_word_topic"))
+        self.topic = mv.create_table(ArrayTableOption(K, name="lda_topic"))
+        # doc-topic counts are worker-local (lightLDA keeps them local too)
+        self.doc_topic = np.zeros((num_docs, K), dtype=np.float32)
+        self._step = _build_gibbs_step(K, vocab_size, cfg.alpha, cfg.beta)
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self._rng = np.random.default_rng(cfg.seed)
+
+    # -- data layout ---------------------------------------------------------
+    def _init_assignments(self, words: np.ndarray, docs: np.ndarray
+                          ) -> np.ndarray:
+        K = self.cfg.num_topics
+        topics = self._rng.integers(0, K, size=len(words)).astype(np.int32)
+        # Seed the global tables with the initial counts.
+        wt = np.zeros((self.V, K), dtype=np.float32)
+        np.add.at(wt, (words, topics), 1.0)
+        self.word_topic.add(wt)
+        tk = np.bincount(topics, minlength=K).astype(np.float32)
+        self.topic.add(tk)
+        np.add.at(self.doc_topic, (docs, topics), 1.0)
+        return topics
+
+    # -- training -------------------------------------------------------------
+    def train(self, words, docs, iterations: Optional[int] = None) -> dict:
+        """words/docs: flat int arrays, one entry per token occurrence."""
+        words = np.asarray(words, dtype=np.int32)
+        docs = np.asarray(docs, dtype=np.int32)
+        check(len(words) == len(docs), "words/docs length mismatch")
+        iterations = iterations or self.cfg.iterations
+        topics = self._init_assignments(words, docs)
+        B = self.cfg.block_tokens
+        K = self.cfg.num_topics
+
+        for it in range(iterations):
+            for start in range(0, len(words), B):
+                w = words[start:start + B]
+                d = docs[start:start + B]
+                t = topics[start:start + B]
+                # Pull fresh global counts for this block's words.
+                n_wk = self.word_topic.get_rows(w)
+                n_k = self.topic.get()
+                n_dk = self.doc_topic[d]
+                self._key, sub = jax.random.split(self._key)
+                new_t = np.asarray(self._step(
+                    jnp.asarray(n_wk), jnp.asarray(n_k), jnp.asarray(n_dk),
+                    jnp.asarray(t), sub))
+                # Push count deltas (new - old) to the tables.
+                delta_w = np.zeros((self.V, K), dtype=np.float32)
+                np.add.at(delta_w, (w, new_t), 1.0)
+                np.add.at(delta_w, (w, t), -1.0)
+                self.word_topic.add(delta_w)
+                delta_k = (np.bincount(new_t, minlength=K)
+                           - np.bincount(t, minlength=K)).astype(np.float32)
+                self.topic.add(delta_k)
+                np.add.at(self.doc_topic, (d, new_t), 1.0)
+                np.add.at(self.doc_topic, (d, t), -1.0)
+                topics[start:start + B] = new_t
+        return {"topics": topics}
+
+    # -- inspection ------------------------------------------------------------
+    def topic_word(self) -> np.ndarray:
+        """[K, V] topic-word distribution (normalized counts + beta)."""
+        counts = self.word_topic.get().T + self.cfg.beta
+        return counts / counts.sum(axis=1, keepdims=True)
+
+    def top_words(self, topic_id: int, topn: int = 10) -> List[int]:
+        dist = self.topic_word()[topic_id]
+        return list(np.argsort(-dist)[:topn])

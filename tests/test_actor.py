@@ -1,0 +1,102 @@
+"""Actor/Message runtime tests (ports of unittests/test_message.cpp and the
+actor dispatch altitude)."""
+
+import threading
+import time
+
+import pytest
+
+from multiverso_tpu.core.actor import (Actor, Message, MsgType,
+                                       stop_all_actors)
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    stop_all_actors()
+
+
+def test_message_reply_inversion():
+    """ref test_message.cpp:9-41: reply negates type, swaps src/dst."""
+    msg = Message(src=3, dst=7, type=MsgType.Request_Get, table_id=2,
+                  msg_id=11)
+    reply = msg.create_reply()
+    assert reply.src == 7 and reply.dst == 3
+    assert reply.type == MsgType.Reply_Get
+    assert reply.table_id == 2 and reply.msg_id == 11
+
+
+def test_msgtype_routing():
+    """ref communicator.cpp:15-27 sign/range routing."""
+    assert Message(type=MsgType.Request_Add).to_server()
+    assert Message(type=MsgType.Reply_Get).to_worker()
+    assert Message(type=MsgType.Control_Barrier).to_controller()
+    assert not Message(type=MsgType.Request_Add).to_worker()
+
+
+def test_actor_dispatch():
+    got = []
+    done = threading.Event()
+    a = Actor("echo")
+    a.register_handler(MsgType.Request_Get,
+                       lambda m: (got.append(m.data[0]), done.set()))
+    a.start()
+    a.receive(Message(type=MsgType.Request_Get, data=["hello"]))
+    assert done.wait(5)
+    assert got == ["hello"]
+
+
+def test_actor_send_to_and_reply():
+    reply_done = threading.Event()
+    replies = []
+
+    server = Actor("server")
+    client = Actor("client")
+
+    def on_get(msg):
+        reply = msg.create_reply()
+        reply.data = [sum(msg.data)]
+        server.send_to("client", reply)
+
+    def on_reply(msg):
+        replies.append(msg.data[0])
+        reply_done.set()
+
+    server.register_handler(MsgType.Request_Get, on_get)
+    client.register_handler(MsgType.Reply_Get, on_reply)
+    server.start()
+    client.start()
+    client.send_to("server", Message(src=0, dst=1,
+                                     type=MsgType.Request_Get,
+                                     data=[1, 2, 3]))
+    assert reply_done.wait(5)
+    assert replies == [6]
+
+
+def test_actor_survives_handler_error():
+    done = threading.Event()
+    a = Actor("flaky")
+    calls = []
+
+    def handler(msg):
+        calls.append(msg.msg_id)
+        if msg.msg_id == 1:
+            raise ValueError("boom")
+        done.set()
+
+    a.register_handler(MsgType.Request_Add, handler)
+    a.start()
+    a.receive(Message(type=MsgType.Request_Add, msg_id=1))
+    a.receive(Message(type=MsgType.Request_Add, msg_id=2))
+    assert done.wait(5)
+    assert calls == [1, 2]
+
+
+def test_actor_stop_drains():
+    a = Actor("stopper")
+    a.register_handler(MsgType.Request_Add, lambda m: time.sleep(0.01))
+    a.start()
+    for i in range(5):
+        a.receive(Message(type=MsgType.Request_Add, msg_id=i))
+    a.stop()
+    assert a._thread is None

@@ -1,0 +1,52 @@
+"""LDA (lightLDA-style PS workload) tests."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.lda import LDA, LDAConfig
+
+
+def _planted_corpus(n_docs=60, doc_len=50, seed=0):
+    """Two planted topics: words 0-9 vs words 10-19; each doc draws from
+    one topic only."""
+    rng = np.random.default_rng(seed)
+    words, docs = [], []
+    for d in range(n_docs):
+        lo = 0 if d % 2 == 0 else 10
+        w = rng.integers(lo, lo + 10, size=doc_len)
+        words.extend(w.tolist())
+        docs.extend([d] * doc_len)
+    return np.asarray(words), np.asarray(docs), n_docs, 20
+
+
+def test_lda_recovers_planted_topics(mv_env):
+    words, docs, D, V = _planted_corpus()
+    cfg = LDAConfig(num_topics=2, iterations=30, alpha=0.5, beta=0.1,
+                    block_tokens=1 << 12, seed=1)
+    lda = LDA(cfg, num_docs=D, vocab_size=V)
+    lda.train(words, docs)
+    dist = lda.topic_word()        # [2, 20]
+    # Each topic should concentrate on one of the two word groups.
+    mass_low = dist[:, :10].sum(axis=1)    # P(words 0-9 | topic)
+    # one topic mostly low words, the other mostly high words
+    lo_topic = int(np.argmax(mass_low))
+    hi_topic = 1 - lo_topic
+    assert mass_low[lo_topic] > 0.85
+    assert mass_low[hi_topic] < 0.15
+    # top words agree
+    top_lo = set(lda.top_words(lo_topic, 10))
+    assert len(top_lo & set(range(10))) >= 8
+
+
+def test_lda_count_conservation(mv_env):
+    """Total counts in the tables must equal the number of tokens after any
+    number of sweeps (deltas conserve mass)."""
+    words, docs, D, V = _planted_corpus(n_docs=20, doc_len=30)
+    cfg = LDAConfig(num_topics=4, iterations=5, block_tokens=256, seed=2)
+    lda = LDA(cfg, num_docs=D, vocab_size=V)
+    lda.train(words, docs)
+    n_tokens = len(words)
+    assert lda.word_topic.get().sum() == pytest.approx(n_tokens)
+    assert lda.topic.get().sum() == pytest.approx(n_tokens)
+    assert lda.doc_topic.sum() == pytest.approx(n_tokens)
