@@ -1,0 +1,126 @@
+"""Sequence / context parallelism: ring attention and all-to-all (Ulysses).
+
+The reference predates transformers — it scales the *model* dimension by
+row-sharding huge embedding tables (SURVEY.md §5 "Long-context"). This module
+supplies the sequence-dimension counterpart as first-class mesh primitives so
+the framework covers long-context training:
+
+* :func:`ring_attention` — blockwise attention with K/V shards rotated
+  around the ICI ring via ``jax.lax.ppermute``, accumulating in the
+  numerically-stable streaming-softmax form. Memory per device is O(S/n);
+  the full S x S score matrix never materializes.
+* :func:`ulysses_attention` — the all-to-all alternative: resharding
+  sequence-parallel activations to head-parallel via two
+  ``jax.lax.all_to_all`` hops so each device runs dense attention on full
+  sequences for a subset of heads.
+
+Both are pure shard_map programs over a named mesh axis: XLA lowers the
+permutes/all-to-alls onto ICI neighbors, which is the entire point of the
+design (no host involvement per step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+
+def _block_attn(q, k, v, scale):
+    """Scores for one (q-block, kv-block) pair plus streaming-softmax stats.
+    q: [B, H, Sq, D]; k, v: [B, H, Sk, D]."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    m = jnp.max(s, axis=-1, keepdims=True)                     # [B,H,Sq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)                     # [B,H,Sq,1]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   axis: str = SEQ_AXIS) -> jax.Array:
+    """Attention over a sequence sharded across ``axis``.
+
+    Inputs are [B, H, S, D] logically, sharded on S. Each of the n steps
+    attends the local queries against the currently-held K/V shard, then
+    rotates K/V one neighbor around the ring. Streaming-softmax merging
+    keeps exact softmax semantics.
+    """
+    n = mesh.shape[axis]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def local(q_blk, k_blk, v_blk):
+        def body(carry, _):
+            o_acc, m_acc, l_acc, k_cur, v_cur = carry
+            o, m, l = _block_attn(q_blk, k_cur, v_cur, scale)
+            m_new = jnp.maximum(m_acc, m)
+            alpha = jnp.exp(m_acc - m_new)
+            beta = jnp.exp(m - m_new)
+            o_acc = o_acc * alpha + o * beta
+            l_acc = l_acc * alpha + l * beta
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return (o_acc, m_new, l_acc, k_nxt, v_nxt), None
+
+        B, H, Sq, D = q_blk.shape
+        # Fresh accumulators are "unvarying" over the mesh axis until marked;
+        # the carry must match the ppermute outputs' varying type.
+        init = (jax.lax.pvary(jnp.zeros((B, H, Sq, D), q_blk.dtype), axis),
+                jax.lax.pvary(jnp.full((B, H, Sq, 1), -jnp.inf,
+                                       q_blk.dtype), axis),
+                jax.lax.pvary(jnp.zeros((B, H, Sq, 1), q_blk.dtype), axis),
+                k_blk, v_blk)
+        (o, _, l, _, _), _ = jax.lax.scan(body, init, None, length=n)
+        return o / jnp.maximum(l, 1e-20)
+
+    spec = P(None, None, axis, None)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                      axis: str = SEQ_AXIS) -> jax.Array:
+    """All-to-all sequence parallelism (the Ulysses layout swap).
+
+    Inputs [B, H, S, D] sharded on S with H divisible by the axis size.
+    First all-to-all: seq-sharded -> head-sharded (full sequence per
+    device); dense attention; second all-to-all: back to seq-sharded.
+    """
+    n = mesh.shape[axis]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def local(q_blk, k_blk, v_blk):
+        # [B, H, S/n, D] -> [B, H/n, S, D]
+        def seq_to_head(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        def head_to_seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        qh, kh, vh = seq_to_head(q_blk), seq_to_head(k_blk), seq_to_head(v_blk)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        return head_to_seq(o)
+
+    spec = P(None, None, axis, None)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v):
+    """Dense single-device reference for testing."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
