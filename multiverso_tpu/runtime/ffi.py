@@ -29,8 +29,10 @@ class NativeRuntimeUnavailable(RuntimeError):
 
 
 def _build() -> None:
+    # No -ffast-math: it links crtfastmath.o, which flips FTZ/DAZ for the
+    # whole process at dlopen and silently changes numpy/JAX numerics.
     cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread",
-           "-ffast-math", "-shared", "-o", _SO, _SRC]
+           "-fno-math-errno", "-shared", "-o", _SO, _SRC]
     result = subprocess.run(cmd, capture_output=True, text=True)
     if result.returncode != 0:
         raise NativeRuntimeUnavailable(
