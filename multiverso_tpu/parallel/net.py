@@ -1,0 +1,96 @@
+"""Binary wire protocol for the host-side DCN table service.
+
+Parity with the reference's single-buffer message framing
+(``mpi_net.h:289-317``: header ints + size-prefixed blobs + terminator):
+a fixed header {type, table_id, msg_id, src, n_blobs} followed by
+length-prefixed numpy blobs (dtype tag + shape + raw bytes), over TCP.
+
+This is deliberately a *host* protocol: it carries async-PS request traffic
+between processes over DCN. On-chip/ICI traffic never touches it — that is
+XLA's job.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.core.actor import Message
+
+_HEADER = struct.Struct("<iiqii")   # type, table_id, msg_id, src, n_blobs
+_BLOB_HEADER = struct.Struct("<16sI")  # dtype string, ndim
+_MAGIC = struct.Struct("<I")
+_MAGIC_VALUE = 0x4D565450  # "MVTP"
+
+
+def _pack_blob(arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    dtype_tag = arr.dtype.str.encode().ljust(16, b"\0")
+    parts = [_BLOB_HEADER.pack(dtype_tag, arr.ndim)]
+    parts.append(struct.pack(f"<{arr.ndim}q", *arr.shape)
+                 if arr.ndim else b"")
+    raw = arr.tobytes()
+    parts.append(struct.pack("<q", len(raw)))
+    parts.append(raw)
+    return b"".join(parts)
+
+
+def pack_message(msg: Message) -> bytes:
+    blobs = [np.asarray(b) for b in msg.data]
+    parts = [_MAGIC.pack(_MAGIC_VALUE),
+             _HEADER.pack(msg.type, msg.table_id, msg.msg_id, msg.src,
+                          len(blobs))]
+    parts.extend(_pack_blob(b) for b in blobs)
+    return b"".join(parts)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_message(sock: socket.socket, msg: Message) -> None:
+    sock.sendall(pack_message(msg))
+
+
+def recv_message(sock: socket.socket) -> Optional[Message]:
+    """Blocking read of one framed message; None on clean EOF."""
+    magic = _recv_exact(sock, _MAGIC.size)
+    if magic is None:
+        return None
+    (value,) = _MAGIC.unpack(magic)
+    if value != _MAGIC_VALUE:
+        raise IOError("bad frame magic")
+    header = _recv_exact(sock, _HEADER.size)
+    if header is None:
+        return None
+    mtype, table_id, msg_id, src, n_blobs = _HEADER.unpack(header)
+    data: List[np.ndarray] = []
+    for _ in range(n_blobs):
+        bh = _recv_exact(sock, _BLOB_HEADER.size)
+        if bh is None:
+            return None
+        dtype_tag, ndim = _BLOB_HEADER.unpack(bh)
+        shape: Tuple[int, ...] = ()
+        if ndim:
+            dims = _recv_exact(sock, 8 * ndim)
+            if dims is None:
+                return None
+            shape = struct.unpack(f"<{ndim}q", dims)
+        (nbytes,) = struct.unpack("<q", _recv_exact(sock, 8))
+        raw = _recv_exact(sock, nbytes)
+        if raw is None:
+            return None
+        arr = np.frombuffer(raw, dtype=np.dtype(dtype_tag.rstrip(b"\0")
+                                                .decode()))
+        data.append(arr.reshape(shape))
+    return Message(src=src, type=mtype, table_id=table_id, msg_id=msg_id,
+                   data=data)

@@ -1,0 +1,361 @@
+"""Cross-process async parameter server over DCN (host TCP service).
+
+This is the reference's core architecture at multi-node scale — SURVEY.md §7
+"hard part (a)": N worker processes push deltas / pull parameters against
+tables sharded across server processes, per-request, asynchronously. Roles:
+
+* :class:`PSService` — the Server+Communicator analog: a listener thread
+  accepts peer connections; per-connection reader threads deserialize
+  requests and dispatch to the owning shard (which applies the jitted
+  updater on the local device), then reply on the same connection.
+* :class:`PeerClient` — the Worker-side Communicator: one persistent
+  connection per server process, a reader thread routing replies to
+  waiters by msg_id (the reference's Waiter contract: a request completes
+  when ALL touched servers replied).
+* :class:`DistributedArrayTable` / :class:`DistributedMatrixTable` — worker
+  handles that partition requests with the reference's offset arithmetic
+  (contiguous / row ranges), serve the local shard directly (LocalForward),
+  and fan out the rest over the wire.
+
+Consistency contract = the reference's async mode: adds are applied by the
+owning server in arrival order; gets see whatever has been applied (no
+clocks). BSP across processes should use the collective path instead.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.actor import Message, MsgType
+from multiverso_tpu.core.options import AddOption
+from multiverso_tpu.core.table import ServerStore
+from multiverso_tpu.core.updater import get_updater
+from multiverso_tpu.core.zoo import Zoo
+from multiverso_tpu.parallel.mesh import reference_server_offsets
+from multiverso_tpu.parallel.net import recv_message, send_message
+from multiverso_tpu.utils.log import check, log
+
+
+class PSService:
+    """Owns local table shards; serves Get/Add requests from peers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._tables: Dict[int, Tuple[ServerStore, int]] = {}
+        self._lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.address = self._listener.getsockname()
+        self._running = True
+        self._threads: List[threading.Thread] = []
+        accept = threading.Thread(target=self._accept_loop, daemon=True)
+        accept.start()
+        self._threads.append(accept)
+
+    # -- shard registry -----------------------------------------------------
+    def register_shard(self, table_id: int, store: ServerStore,
+                       row_offset: int = 0) -> None:
+        with self._lock:
+            self._tables[table_id] = (store, row_offset)
+
+    # -- server loops ---------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while self._running:
+                msg = recv_message(conn)
+                if msg is None:
+                    return
+                reply = self._dispatch(msg)
+                if reply is not None:
+                    send_message(conn, reply)
+        except OSError:
+            return
+        finally:
+            conn.close()
+
+    def _dispatch(self, msg: Message) -> Optional[Message]:
+        with self._lock:
+            entry = self._tables.get(msg.table_id)
+        if entry is None:
+            log.error("ps_service: unknown table %d", msg.table_id)
+            return None
+        store, row_offset = entry
+        if msg.type == MsgType.Request_Add:
+            # payload: [keys(int32, may be empty = whole shard), delta,
+            #           opt scalars(float32[5])]
+            keys, delta, opt_arr = msg.data
+            opt = _opt_from_array(opt_arr)
+            if keys.size == 0:
+                store.apply_dense(delta, opt)
+            else:
+                store.apply_rows(keys.astype(np.int32) - row_offset,
+                                 delta, opt)
+            return msg.create_reply()
+        if msg.type == MsgType.Request_Get:
+            keys = msg.data[0]
+            if keys.size == 0:
+                values = np.asarray(store.read())
+            else:
+                values = np.asarray(store.read_rows(
+                    keys.astype(np.int32) - row_offset))
+            reply = msg.create_reply()
+            reply.data = [values]
+            return reply
+        log.error("ps_service: unhandled type %d", msg.type)
+        return None
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def _opt_to_array(opt: AddOption) -> np.ndarray:
+    return np.asarray([opt.worker_id, opt.momentum, opt.learning_rate,
+                       opt.rho, opt.lambda_], dtype=np.float32)
+
+
+def _opt_from_array(arr: np.ndarray) -> AddOption:
+    return AddOption(worker_id=int(arr[0]), momentum=float(arr[1]),
+                     learning_rate=float(arr[2]), rho=float(arr[3]),
+                     lambda_=float(arr[4]))
+
+
+class PeerClient:
+    """Persistent connection to one server process; reply routing by msg_id
+    (the Worker-side Communicator + Waiter contract)."""
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port), timeout=60)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._waiters: Dict[int, Tuple[threading.Event, List]] = {}
+        self._waiters_lock = threading.Lock()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def request(self, msg: Message) -> Tuple[threading.Event, List]:
+        event = threading.Event()
+        slot: List = []
+        with self._waiters_lock:
+            self._waiters[msg.msg_id] = (event, slot)
+        with self._send_lock:
+            send_message(self._sock, msg)
+        return event, slot
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = recv_message(self._sock)
+                if msg is None:
+                    break
+                with self._waiters_lock:
+                    entry = self._waiters.pop(msg.msg_id, None)
+                if entry is not None:
+                    event, slot = entry
+                    slot.append(msg)
+                    event.set()
+        except OSError:
+            pass
+        # Peer went away: release every pending waiter with an empty slot so
+        # callers fail fast instead of timing out.
+        with self._waiters_lock:
+            pending = list(self._waiters.values())
+            self._waiters.clear()
+        for event, _ in pending:
+            event.set()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class DistributedTableBase:
+    """Shared plumbing: shard ownership, local forward, remote fan-out."""
+
+    _msg_counter = 0
+    _counter_lock = threading.Lock()
+
+    def __init__(self, table_id: int, service: PSService,
+                 peers: List[Tuple[str, int]], rank: int):
+        self.table_id = table_id
+        self.rank = rank
+        self.world = len(peers)
+        self._service = service
+        self._clients: Dict[int, PeerClient] = {}
+        self._peers = peers
+
+    def _client(self, server: int) -> PeerClient:
+        client = self._clients.get(server)
+        if client is None:
+            host, port = self._peers[server]
+            client = self._clients[server] = PeerClient(host, port)
+        return client
+
+    @classmethod
+    def _next_msg_id(cls) -> int:
+        with cls._counter_lock:
+            cls._msg_counter += 1
+            return cls._msg_counter
+
+    def close(self) -> None:
+        for client in self._clients.values():
+            client.close()
+
+
+class DistributedArrayTable(DistributedTableBase):
+    """1-D table contiguously sharded across PROCESSES (the reference's
+    server set), each process's shard device-resident via ServerStore."""
+
+    def __init__(self, table_id: int, size: int,
+                 service: PSService, peers: List[Tuple[str, int]],
+                 rank: int, dtype=np.float32, updater: str = "default"):
+        super().__init__(table_id, service, peers, rank)
+        self.size = size
+        self.offsets = reference_server_offsets(size, self.world)
+        zoo = Zoo.get()
+        local_size = self.offsets[rank + 1] - self.offsets[rank]
+        self.local_store = ServerStore(
+            f"dist_array_{table_id}", (max(local_size, 1),), dtype,
+            get_updater(dtype, updater), zoo.mesh, zoo.num_workers())
+        service.register_shard(table_id, self.local_store)
+
+    # -- ops ------------------------------------------------------------------
+    def add(self, delta: np.ndarray,
+            option: Optional[AddOption] = None) -> None:
+        delta = np.asarray(delta, dtype=np.float32)
+        check(delta.shape == (self.size,), "bad delta shape")
+        option = option or AddOption()
+        pending = []
+        for s in range(self.world):
+            lo, hi = self.offsets[s], self.offsets[s + 1]
+            if hi <= lo:
+                continue
+            piece = delta[lo:hi]
+            if s == self.rank:
+                self.local_store.apply_dense(piece, option)  # LocalForward
+                continue
+            msg = Message(src=self.rank, type=MsgType.Request_Add,
+                          table_id=self.table_id,
+                          msg_id=self._next_msg_id(),
+                          data=[np.empty(0, np.int32), piece,
+                                _opt_to_array(option)])
+            pending.append(self._client(s).request(msg))
+        for event, slot in pending:
+            check(event.wait(60), "remote add timed out")
+            check(slot, "peer connection lost during add")
+        self.local_store.block()
+
+    def get(self) -> np.ndarray:
+        out = np.zeros(self.size, dtype=np.float32)
+        pending = []
+        for s in range(self.world):
+            lo, hi = self.offsets[s], self.offsets[s + 1]
+            if hi <= lo:
+                continue
+            if s == self.rank:
+                out[lo:hi] = np.asarray(self.local_store.read())[:hi - lo]
+                continue
+            msg = Message(src=self.rank, type=MsgType.Request_Get,
+                          table_id=self.table_id,
+                          msg_id=self._next_msg_id(),
+                          data=[np.empty(0, np.int32)])
+            pending.append((s, self._client(s).request(msg)))
+        for s, (event, slot) in pending:
+            check(event.wait(60), "remote get timed out")
+            check(slot, "peer connection lost during get")
+            lo, hi = self.offsets[s], self.offsets[s + 1]
+            out[lo:hi] = slot[0].data[0][:hi - lo]
+        return out
+
+
+class DistributedMatrixTable(DistributedTableBase):
+    """2-D table row-sharded across processes; row-granular Get/Add."""
+
+    def __init__(self, table_id: int, num_row: int, num_col: int,
+                 service: PSService, peers: List[Tuple[str, int]],
+                 rank: int, dtype=np.float32, updater: str = "default"):
+        super().__init__(table_id, service, peers, rank)
+        self.num_row = num_row
+        self.num_col = num_col
+        self.row_offsets = reference_server_offsets(num_row, self.world)
+        zoo = Zoo.get()
+        local_rows = self.row_offsets[rank + 1] - self.row_offsets[rank]
+        self.local_store = ServerStore(
+            f"dist_matrix_{table_id}", (max(local_rows, 1), num_col), dtype,
+            get_updater(dtype, updater), zoo.mesh, zoo.num_workers())
+        service.register_shard(table_id, self.local_store,
+                               row_offset=self.row_offsets[rank])
+
+    def _route(self, rows: np.ndarray) -> Dict[int, np.ndarray]:
+        out: Dict[int, List[int]] = {}
+        bounds = self.row_offsets
+        for i, r in enumerate(rows.tolist()):
+            s = min(np.searchsorted(bounds, r, side="right") - 1,
+                    self.world - 1)
+            out.setdefault(int(s), []).append(i)
+        return {s: np.asarray(ix, dtype=np.int64) for s, ix in out.items()}
+
+    def add_rows(self, row_ids, deltas,
+                 option: Optional[AddOption] = None) -> None:
+        rows = np.asarray(row_ids, dtype=np.int32)
+        deltas = np.asarray(deltas, dtype=np.float32)
+        option = option or AddOption()
+        pending = []
+        for s, ix in self._route(rows).items():
+            keys, piece = rows[ix], deltas[ix]
+            if s == self.rank:
+                self.local_store.apply_rows(
+                    keys - self.row_offsets[s], piece, option)
+                continue
+            msg = Message(src=self.rank, type=MsgType.Request_Add,
+                          table_id=self.table_id,
+                          msg_id=self._next_msg_id(),
+                          data=[keys, piece, _opt_to_array(option)])
+            pending.append(self._client(s).request(msg))
+        for event, slot in pending:
+            check(event.wait(60), "remote add timed out")
+            check(slot, "peer connection lost during add")
+        self.local_store.block()
+
+    def get_rows(self, row_ids) -> np.ndarray:
+        rows = np.asarray(row_ids, dtype=np.int32)
+        out = np.zeros((len(rows), self.num_col), dtype=np.float32)
+        pending = []
+        for s, ix in self._route(rows).items():
+            keys = rows[ix]
+            if s == self.rank:
+                out[ix] = np.asarray(self.local_store.read_rows(
+                    keys - self.row_offsets[s]))
+                continue
+            msg = Message(src=self.rank, type=MsgType.Request_Get,
+                          table_id=self.table_id,
+                          msg_id=self._next_msg_id(), data=[keys])
+            pending.append((ix, self._client(s).request(msg)))
+        for ix, (event, slot) in pending:
+            check(event.wait(60), "remote get timed out")
+            check(slot, "peer connection lost during get")
+            out[ix] = slot[0].data[0]
+        return out
