@@ -1,0 +1,148 @@
+"""Distributed word2vec: multiple worker processes against PS-sharded tables.
+
+This is the reference's actual deployment
+(``Applications/WordEmbedding/src/distributed_wordembedding.cpp`` +
+``communicator.cpp``): the embedding matrices live row-sharded across server
+processes; for each data block a worker
+
+1. generates the block's training pairs AND its negative samples up front so
+   the touched row set is known (ref ``data_block`` fills negatives at load),
+2. pulls exactly those rows (``RequestParameter``, communicator.cpp:117-155),
+3. trains locally on the pulled sub-matrix — here with the fused jitted
+   scan step on device, not scalar loops —
+4. pushes ``(new - old) / num_workers`` back (``AddDeltaParameter``,
+   communicator.cpp:157-202).
+
+SGD with the linear lr decay (the reference default) so the PS applies plain
+delta adds; AdaGrad state stays server-side in single-process mode
+(model.py) where it's exact.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.models.word2vec.data import BatchGenerator, BlockStream
+from multiverso_tpu.models.word2vec.dictionary import Dictionary
+from multiverso_tpu.models.word2vec.model import (Word2VecConfig,
+                                                  build_scan_step,
+                                                  raw_sg_ns_step)
+from multiverso_tpu.parallel.ps_service import (DistributedMatrixTable,
+                                                PSService)
+from multiverso_tpu.utils.log import check, log
+
+
+class DistributedWord2Vec:
+    """Skip-gram + negative sampling over process-sharded tables."""
+
+    TABLE_IN = 100
+    TABLE_OUT = 101
+
+    def __init__(self, cfg: Word2VecConfig, dictionary: Dictionary,
+                 service: PSService, peers: List[Tuple[str, int]],
+                 rank: int, num_workers: Optional[int] = None):
+        check(cfg.sg and not cfg.hs,
+              "distributed mode implements skip-gram + negative sampling")
+        self.cfg = cfg
+        self.dict = dictionary
+        self.rank = rank
+        self.num_workers = num_workers or len(peers)
+        V, D = len(dictionary), cfg.embedding_size
+        self.w_in = DistributedMatrixTable(self.TABLE_IN, V, D, service,
+                                           peers, rank)
+        self.w_out = DistributedMatrixTable(self.TABLE_OUT, V, D, service,
+                                            peers, rank)
+        self._initialized = False
+        self.generator = BatchGenerator(
+            dictionary, batch_size=cfg.batch_size, window=cfg.window,
+            negative=cfg.negative, sample=cfg.sample, sg=True,
+            seed=cfg.seed + rank)
+        self._scan_step = build_scan_step(raw_sg_ns_step(adagrad=False))
+        self.trained_words = 0
+        self.total_words = dictionary.total_count * max(cfg.epochs, 1)
+        self.words_per_sec = 0.0
+
+    def _current_lr(self) -> float:
+        frac = min(self.trained_words / max(self.total_words, 1), 1.0)
+        return max(self.cfg.learning_rate * (1.0 - frac),
+                   self.cfg.learning_rate * 1e-4)
+
+    # -- one data block -------------------------------------------------------
+    def _train_block(self, block: List[Sequence[int]]) -> int:
+        batches = list(self.generator.batches(block))
+        if not batches:
+            return 0
+        # The touched row set: centers + contexts + negatives. Pad the id
+        # list and the batch-group count to powers of two so the jitted
+        # scan step compiles once per bucket, not once per block.
+        ids = np.unique(np.concatenate(
+            [np.concatenate([b.centers, b.contexts,
+                             b.negatives.reshape(-1)]) for b in batches]))
+        bucket = 1 << int(np.ceil(np.log2(max(len(ids), 1))))
+        ids = np.concatenate(
+            [ids, np.full(bucket - len(ids), ids[-1], ids.dtype)])
+        # Pull (RequestParameter analog).
+        local_in = self.w_in.get_rows(ids)
+        local_out = self.w_out.get_rows(ids)
+        old_in, old_out = local_in.copy(), local_out.copy()
+
+        # Remap vocabulary ids -> local row indices.
+        def rm(x):
+            return np.searchsorted(ids, x).astype(np.int32)
+
+        group = [(rm(b.centers), rm(b.contexts), rm(b.negatives), b.mask)
+                 for b in batches]
+        n_groups = 1 << int(np.ceil(np.log2(len(group))))
+        zero_batch = tuple(np.zeros_like(a) for a in group[0])
+        group = group + [zero_batch] * (n_groups - len(group))
+        stacked = tuple(np.stack([g[i] for g in group])
+                        for i in range(4))
+        zeros = {"g_in": jnp.zeros_like(local_in),
+                 "g_out": jnp.zeros_like(local_out)}
+        lr = np.float32(self._current_lr())
+        new_in, new_out, _, _, _ = self._scan_step(
+            jnp.asarray(local_in), jnp.asarray(local_out),
+            zeros["g_in"], zeros["g_out"], *stacked, lr)
+
+        # Push averaged delta (AddDeltaParameter analog).
+        scale = 1.0 / self.num_workers
+        self.w_in.add_rows(ids, (np.asarray(new_in) - old_in) * scale)
+        self.w_out.add_rows(ids, (np.asarray(new_out) - old_out) * scale)
+        return sum(len(s) for s in block)
+
+    # -- training ---------------------------------------------------------------
+    def _maybe_master_init(self) -> None:
+        """Master-only random init (the binding trick: everyone else adds
+        zero). Deferred to train() so construction never requires a remote
+        peer to exist yet (peers' dispatch waits on table registration)."""
+        if self._initialized:
+            return
+        self._initialized = True
+        if self.rank == 0:
+            V, D = len(self.dict), self.cfg.embedding_size
+            rng = np.random.default_rng(self.cfg.seed)
+            init = rng.uniform(-0.5 / D, 0.5 / D, size=(V, D)) \
+                .astype(np.float32)
+            self.w_in.add_rows(np.arange(V, dtype=np.int32), init)
+
+    def train(self, sentences: Iterable[Sequence[int]],
+              epochs: Optional[int] = None) -> dict:
+        epochs = epochs if epochs is not None else self.cfg.epochs
+        self._maybe_master_init()
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            for block in BlockStream(iter(sentences), self.cfg.block_words,
+                                     prefetch=self.cfg.pipeline):
+                self.trained_words += self._train_block(block)
+        elapsed = time.perf_counter() - t0
+        self.words_per_sec = self.trained_words / max(elapsed, 1e-9)
+        return {"words": self.trained_words,
+                "words_per_sec": self.words_per_sec, "seconds": elapsed}
+
+    def embeddings(self) -> np.ndarray:
+        return self.w_in.get_rows(np.arange(len(self.dict), dtype=np.int32))

@@ -44,9 +44,12 @@ from multiverso_tpu.utils.log import check, log
 class PSService:
     """Owns local table shards; serves Get/Add requests from peers."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 register_timeout: float = 30.0):
         self._tables: Dict[int, Tuple[ServerStore, int]] = {}
         self._lock = threading.Lock()
+        self._registered = threading.Condition(self._lock)
+        self._register_timeout = register_timeout
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -63,6 +66,7 @@ class PSService:
                        row_offset: int = 0) -> None:
         with self._lock:
             self._tables[table_id] = (store, row_offset)
+            self._registered.notify_all()
 
     # -- server loops ---------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -92,8 +96,14 @@ class PSService:
             conn.close()
 
     def _dispatch(self, msg: Message) -> Optional[Message]:
+        # Peers may send traffic before this process has registered the
+        # table (the reference serializes this with a barrier after
+        # MV_CreateTable); wait briefly for registration instead.
         with self._lock:
-            entry = self._tables.get(msg.table_id)
+            ok = self._registered.wait_for(
+                lambda: msg.table_id in self._tables,
+                self._register_timeout)
+            entry = self._tables.get(msg.table_id) if ok else None
         if entry is None:
             log.error("ps_service: unknown table %d", msg.table_id)
             return None
@@ -147,6 +157,9 @@ class PeerClient:
 
     def __init__(self, host: str, port: int):
         self._sock = socket.create_connection((host, port), timeout=60)
+        # The connect timeout must not become a recv timeout: this is a
+        # persistent connection that legitimately sits idle.
+        self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = threading.Lock()
         self._waiters: Dict[int, Tuple[threading.Event, List]] = {}
