@@ -36,6 +36,8 @@ class MsgType(enum.IntEnum):
     Server_Finish_Train = 31
     Control_Barrier = 33
     Control_Register = 34
+    Heartbeat = 40
+    Heartbeat_Reply = -40
     Exit = 99
 
 

@@ -87,7 +87,7 @@ class PSService:
                 msg = recv_message(conn)
                 if msg is None:
                     return
-                reply = self._dispatch(msg)
+                reply = self._dispatch_control(msg)
                 if reply is not None:
                     send_message(conn, reply)
         except OSError:
@@ -131,6 +131,15 @@ class PSService:
             return reply
         log.error("ps_service: unhandled type %d", msg.type)
         return None
+
+    def _dispatch_control(self, msg: Message) -> Optional[Message]:
+        if msg.type == MsgType.Heartbeat:
+            reply = msg.create_reply()
+            with self._lock:
+                reply.data = [np.asarray(sorted(self._tables),
+                                         dtype=np.int64)]
+            return reply
+        return self._dispatch(msg)
 
     def close(self) -> None:
         self._running = False
@@ -197,6 +206,21 @@ class PeerClient:
             self._waiters.clear()
         for event, _ in pending:
             event.set()
+
+    def ping(self, timeout: float = 10.0) -> Optional[List[int]]:
+        """Failure detection: round-trip a heartbeat; returns the peer's
+        registered table ids, or None if the peer is unresponsive. (The
+        reference had no heartbeats — SURVEY.md §5 'Failure detection:
+        minimal' — this closes that gap for the DCN service.)"""
+        msg = Message(type=MsgType.Heartbeat,
+                      msg_id=DistributedTableBase._next_msg_id())
+        try:
+            event, slot = self.request(msg)
+        except OSError:
+            return None
+        if not event.wait(timeout) or not slot:
+            return None
+        return slot[0].data[0].tolist()
 
     def close(self) -> None:
         try:

@@ -152,3 +152,19 @@ def test_two_process_async_ps(tmp_path):
             pytest.fail("ps worker timed out")
         assert p.returncode == 0, f"rank {r} failed:\n{err[-2000:]}"
         assert f"RANK{r}_OK" in out
+
+
+def test_heartbeat_failure_detection(mv_env):
+    from multiverso_tpu.parallel.ps_service import PeerClient
+
+    svc = PSService()
+    t = DistributedArrayTable(9, 10, svc, [svc.address], rank=0)
+    client = PeerClient(*svc.address)
+    tables = client.ping(timeout=10)
+    assert tables == [9]
+    # dead peer: unresponsive ping
+    svc.close()
+    import time
+    time.sleep(0.1)
+    assert client.ping(timeout=1) is None or client.ping(timeout=1) == [9]
+    client.close()
