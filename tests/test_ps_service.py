@@ -162,9 +162,12 @@ def test_heartbeat_failure_detection(mv_env):
     client = PeerClient(*svc.address)
     tables = client.ping(timeout=10)
     assert tables == [9]
-    # dead peer: unresponsive ping
+    # dead peer: pings eventually come back None (the conn thread may serve
+    # one last in-flight message before noticing shutdown)
     svc.close()
-    import time
-    time.sleep(0.1)
-    assert client.ping(timeout=1) is None or client.ping(timeout=1) == [9]
+    for _ in range(10):
+        if client.ping(timeout=1) is None:
+            break
+    else:
+        pytest.fail("dead peer never detected")
     client.close()
