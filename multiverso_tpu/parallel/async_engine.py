@@ -39,7 +39,8 @@ class AsyncTableEngine:
     """Wraps an ArrayTable or MatrixTable with staged async adds."""
 
     def __init__(self, table: Any, flush_pending: int = 64,
-                 sparse_drain_max: int = 4096):
+                 sparse_drain_max: int = 4096,
+                 flush_interval: Optional[float] = None):
         self.table = table
         store = table.store
         check(store.dtype == np.float32,
@@ -55,6 +56,22 @@ class AsyncTableEngine:
         self.flush_pending = flush_pending
         self.sparse_drain_max = sparse_drain_max
         self._flush_lock = threading.Lock()
+        # Optional background flusher: bounds the staging window by TIME as
+        # well as by count (ASGD staleness bound).
+        self._stop_flusher = threading.Event()
+        self._flusher: Optional[threading.Thread] = None
+        if flush_interval and self._staged:
+            def _loop():
+                while not self._stop_flusher.wait(flush_interval):
+                    self.flush()
+            self._flusher = threading.Thread(target=_loop, daemon=True)
+            self._flusher.start()
+
+    def close(self) -> None:
+        self._stop_flusher.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=5)
+        self.flush()
 
     # -- async ops ---------------------------------------------------------
     def add_async(self, delta, option: Optional[AddOption] = None) -> None:

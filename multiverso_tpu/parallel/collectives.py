@@ -42,6 +42,38 @@ def device_allreduce(x: jax.Array, mesh: Mesh,
     return fn(x)
 
 
+def device_allgather(x: jax.Array, mesh: Mesh,
+                     axis: str = SERVER_AXIS) -> jax.Array:
+    """``AllreduceEngine::Allgather`` analog (ref allreduce_engine.h:80-147):
+    each device contributes its shard along dim 0; every device gets the
+    concatenation. XLA's all_gather over ICI replaces the Bruck schedule."""
+    def _gather(v):
+        return jax.lax.all_gather(v, axis, tiled=True)
+
+    fn = jax.shard_map(_gather, mesh=mesh,
+                       in_specs=P(*([axis] + [None] * (x.ndim - 1))),
+                       out_specs=P(*([None] * x.ndim)),
+                       check_vma=False)
+    return fn(x)
+
+
+def device_reduce_scatter(x: jax.Array, mesh: Mesh,
+                          axis: str = SERVER_AXIS) -> jax.Array:
+    """``AllreduceEngine::ReduceScatter`` analog: sum contributions, each
+    device keeps its scattered slice of dim 0. XLA's psum_scatter over ICI
+    replaces the recursive-halving schedule (ref allreduce_engine.cpp:120-172).
+    Input is replicated [n*k, ...]; output is sharded [n*k, ...] where each
+    device holds its reduced k-slice."""
+    def _rs(v):
+        return jax.lax.psum_scatter(v, axis, scatter_dimension=0,
+                                    tiled=True)
+
+    fn = jax.shard_map(_rs, mesh=mesh,
+                       in_specs=P(*([None] * x.ndim)),
+                       out_specs=P(*([axis] + [None] * (x.ndim - 1))))
+    return fn(x)
+
+
 def aggregate(data) -> np.ndarray:
     """``MV_Aggregate`` analog: elementwise SUM across all JAX processes.
 

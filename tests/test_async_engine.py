@@ -116,3 +116,20 @@ def test_worker_pool_propagates_errors(mv_env):
     pool = WorkerPool(2)
     with pytest.raises(ValueError):
         pool.run(lambda wid: (_ for _ in ()).throw(ValueError("boom")))
+
+
+def test_background_flusher(mv_env):
+    import time
+    table = mv.create_table(mv.ArrayTableOption(size=8))
+    eng = AsyncTableEngine(table, flush_pending=10_000,
+                           flush_interval=0.05)
+    d = np.ones(8, dtype=np.float32)
+    eng.add_async(d)
+    # below the count threshold, but the timer must flush it
+    for _ in range(100):
+        if eng.pending == 0:
+            break
+        time.sleep(0.02)
+    assert eng.pending == 0
+    np.testing.assert_allclose(table.get(), d)
+    eng.close()

@@ -93,3 +93,33 @@ def test_device_allreduce(mv_env):
     x = np.ones((n, 4), dtype=np.float32)
     out = device_allreduce(jax.numpy.asarray(x), mesh)
     np.testing.assert_allclose(np.asarray(out), np.ones((1, 4)) * n)
+
+
+def test_device_allgather(mv_env):
+    import jax
+    import jax.numpy as jnp
+    from multiverso_tpu.core.zoo import Zoo
+    from multiverso_tpu.parallel.collectives import device_allgather
+
+    mesh = Zoo.get().mesh
+    n = mv.num_servers()
+    x = jax.device_put(
+        np.arange(n * 2, dtype=np.float32).reshape(n * 2, 1),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("server")))
+    out = device_allgather(x, mesh)
+    np.testing.assert_allclose(
+        np.asarray(out), np.arange(n * 2, dtype=np.float32).reshape(n * 2, 1))
+
+
+def test_device_reduce_scatter(mv_env):
+    import jax
+    import jax.numpy as jnp
+    from multiverso_tpu.core.zoo import Zoo
+    from multiverso_tpu.parallel.collectives import device_reduce_scatter
+
+    mesh = Zoo.get().mesh
+    n = mv.num_servers()
+    x = jnp.ones((n * 2, 3), dtype=jnp.float32)
+    out = device_reduce_scatter(x, mesh)
+    # every element reduced over n contributors
+    np.testing.assert_allclose(np.asarray(out), np.full((n * 2, 3), n))
