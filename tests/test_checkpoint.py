@@ -117,3 +117,30 @@ def test_checkpoint_manager_periodic_and_resume(tmp_path, mv_env):
 def test_restore_latest_empty_dir(tmp_path, mv_env):
     mgr = ckpt.CheckpointManager(str(tmp_path / "nope"))
     assert mgr.restore_latest() is None
+
+
+def test_orbax_backend_roundtrip(tmp_path, mv_env):
+    from multiverso_tpu.core import checkpoint_orbax as co
+
+    a = mv.create_table(mv.ArrayTableOption(size=64, updater="adagrad",
+                                            name="ow"))
+    m = mv.create_table(mv.MatrixTableOption(num_row=16, num_col=4,
+                                             name="om"))
+    kv = mv.create_table(mv.KVTableOption(name="okv"))
+    a.add(np.ones(64, dtype=np.float32), mv.AddOption(rho=0.1,
+                                                      learning_rate=0.1))
+    m.add(np.full((16, 4), 2.0, dtype=np.float32))
+    kv.add([5], [1.5])
+    before_a, before_m = a.get(), m.get()
+    path = co.save_all(str(tmp_path), step=7)
+    a.add(np.ones(64, dtype=np.float32), mv.AddOption(rho=0.1,
+                                                      learning_rate=0.1))
+    m.add(np.ones((16, 4), dtype=np.float32))
+    kv.add([5], [10.0])
+    co.load_all(path)
+    np.testing.assert_allclose(a.get(), before_a)
+    np.testing.assert_allclose(m.get(), before_m)
+    np.testing.assert_allclose(kv.get([5]), [1.5])
+    # shardings restored intact
+    import jax
+    assert len(a.store.data.sharding.device_set) == mv.num_servers()
