@@ -61,6 +61,9 @@ class Word2VecConfig:
     block_words: int = 100_000
     pipeline: bool = True
     scan_group: int = 32            # minibatches per jitted scan dispatch
+    # Embedding storage dtype: "float32" or "bfloat16" (math stays f32;
+    # bf16 halves HBM bytes per gather/scatter — the dominant cost).
+    param_dtype: str = "float32"
     # Device pipeline (sg+ns): pair-gen/subsample/negatives on device;
     # host uploads raw token ids only.
     device_pipeline: bool = False
@@ -75,18 +78,26 @@ class Word2VecConfig:
 # Fused jitted steps. All take/return the (padded) table arrays.
 # ---------------------------------------------------------------------------
 def _apply_update(w, g2, rows, grad, lr, adagrad: bool):
-    """Scatter an embedding update (+AdaGrad) for possibly-duplicated rows."""
+    """Scatter an embedding update (+AdaGrad) for possibly-duplicated rows.
+    Gradients arrive f32; the step is cast to the storage dtype (bf16
+    tables keep f32 math)."""
     if adagrad:
-        g2 = g2.at[rows].add(jnp.square(grad), mode="drop")
-        denom = jnp.sqrt(jnp.take(g2, rows, axis=0, mode="clip") + 1e-6)
-        w = w.at[rows].add(-lr * grad / denom, mode="drop")
+        g2 = g2.at[rows].add(jnp.square(grad).astype(g2.dtype), mode="drop")
+        denom = jnp.sqrt(jnp.take(g2, rows, axis=0, mode="clip")
+                         .astype(jnp.float32) + 1e-6)
+        step = (-lr * grad / denom).astype(w.dtype)
     else:
-        w = w.at[rows].add(-lr * grad, mode="drop")
+        step = (-lr * grad).astype(w.dtype)
+    w = w.at[rows].add(step, mode="drop")
     return w, g2
 
 
 def _ns_grads(u, v_pos, v_neg, mask):
-    """Shared negative-sampling math. u:[B,D] v_pos:[B,D] v_neg:[B,K,D]."""
+    """Shared negative-sampling math (f32). u:[B,D] v_pos:[B,D]
+    v_neg:[B,K,D]."""
+    u = u.astype(jnp.float32)
+    v_pos = v_pos.astype(jnp.float32)
+    v_neg = v_neg.astype(jnp.float32)
     s_pos = jax.nn.sigmoid(jnp.sum(u * v_pos, axis=-1))          # [B]
     s_neg = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", u, v_neg))   # [B,K]
     loss = -(mask * jnp.log(s_pos + _EPS)).sum() \
@@ -100,7 +111,10 @@ def _ns_grads(u, v_pos, v_neg, mask):
 
 
 def _hs_grads(u, v_nodes, codes, lmask):
-    """Hierarchical-softmax math. u:[B,D] v_nodes:[B,L,D] codes:[B,L]."""
+    """Hierarchical-softmax math (f32). u:[B,D] v_nodes:[B,L,D]
+    codes:[B,L]."""
+    u = u.astype(jnp.float32)
+    v_nodes = v_nodes.astype(jnp.float32)
     score = jnp.einsum("bd,bld->bl", u, v_nodes)                 # [B,L]
     target = 1.0 - codes
     sign = 2.0 * target - 1.0
@@ -152,7 +166,8 @@ def raw_sg_hs_step(adagrad: bool):
 def raw_cbow_ns_step(adagrad: bool):
     def step(w_in, w_out, g_in, g_out, centers, contexts, cmask, negatives,
              mask, lr):
-        ctx = jnp.take(w_in, contexts, axis=0, mode="clip")     # [B,C,D]
+        ctx = jnp.take(w_in, contexts, axis=0,
+                       mode="clip").astype(jnp.float32)         # [B,C,D]
         counts = jnp.maximum(cmask.sum(axis=-1, keepdims=True), 1.0)
         u = (ctx * cmask[..., None]).sum(axis=1) / counts       # [B,D]
         v_pos = jnp.take(w_out, centers, axis=0, mode="clip")
@@ -176,7 +191,8 @@ def raw_cbow_ns_step(adagrad: bool):
 def raw_cbow_hs_step(adagrad: bool):
     def step(w_in, w_out, g_in, g_out, centers, contexts, cmask, points,
              codes, lmask, lr):
-        ctx = jnp.take(w_in, contexts, axis=0, mode="clip")
+        ctx = jnp.take(w_in, contexts, axis=0,
+                       mode="clip").astype(jnp.float32)
         counts = jnp.maximum(cmask.sum(axis=-1, keepdims=True), 1.0)
         u = (ctx * cmask[..., None]).sum(axis=1) / counts
         v = jnp.take(w_out, points, axis=0, mode="clip")
@@ -290,13 +306,17 @@ class Word2Vec:
         V, D = len(dictionary), cfg.embedding_size
 
         # The five reference tables (communicator.cpp:17-32): input embed,
-        # output embed, two adagrad accumulators, word-count KV.
+        # output embed, two adagrad accumulators, word-count KV. Embeddings
+        # may store bf16 (param_dtype); accumulators stay f32.
+        pdtype = np.dtype(cfg.param_dtype)
         self.input_table = mv.create_table(MatrixTableOption(
-            V, D, random_init=True, init_low=-0.5 / D, init_high=0.5 / D,
-            seed=cfg.seed, name="w2v_input", updater="default"))
+            V, D, dtype=pdtype, random_init=True, init_low=-0.5 / D,
+            init_high=0.5 / D, seed=cfg.seed, name="w2v_input",
+            updater="default"))
         out_rows = (V - 1) if cfg.hs else V   # inner nodes for HS
         self.output_table = mv.create_table(MatrixTableOption(
-            max(out_rows, 1), D, name="w2v_output", updater="default"))
+            max(out_rows, 1), D, dtype=pdtype, name="w2v_output",
+            updater="default"))
         self.adagrad_in = mv.create_table(MatrixTableOption(
             V, D, name="w2v_adagrad_in", updater="default"))
         self.adagrad_out = mv.create_table(MatrixTableOption(

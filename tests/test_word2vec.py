@@ -168,3 +168,25 @@ def test_device_pipeline_matches_host_semantics(mv_env):
     intra = np.mean([emb[i] @ emb[j] for i in a_ids for j in a_ids if i != j])
     inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
     assert intra > inter + 0.1, f"intra={intra:.3f} inter={inter:.3f}"
+
+
+def test_bfloat16_params_train(mv_env):
+    """bf16 embedding storage with f32 math still separates topics."""
+    sents = _corpus(300)
+    d = Dictionary.build(sents, min_count=1)
+    cfg = Word2VecConfig(embedding_size=32, batch_size=256, window=4,
+                         negative=5, min_count=1, sample=0, sg=True,
+                         epochs=3, learning_rate=0.1, block_words=5000,
+                         param_dtype="bfloat16", seed=3,
+                         device_pipeline=True, block_sentences=128,
+                         pad_sentence_length=16)
+    w2v = Word2Vec(cfg, d)
+    w2v.train(sentences=[d.encode(s) for s in sents])
+    emb = w2v.embeddings().astype(np.float32)
+    assert str(w2v.input_table.store.dtype) == "bfloat16"
+    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
+    a_ids = [d.word2id[w] for w in d.words if w.startswith("a")]
+    b_ids = [d.word2id[w] for w in d.words if w.startswith("b")]
+    intra = np.mean([emb[i] @ emb[j] for i in a_ids for j in a_ids if i != j])
+    inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
+    assert intra > inter + 0.1, f"intra={intra:.3f} inter={inter:.3f}"
