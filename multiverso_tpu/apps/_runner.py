@@ -1,0 +1,34 @@
+"""Shared CLI app runner: init -> body -> shutdown with clean exits.
+
+User-facing errors (bad flag values, fatal checks, IO) log one line and
+return exit code 1 instead of a traceback.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, List, Optional
+
+import multiverso_tpu as mv
+from multiverso_tpu.utils.configure import FlagError
+from multiverso_tpu.utils.log import FatalError, log
+
+_USER_ERRORS = (FlagError, FatalError, OSError)
+
+
+def run_app(body: Callable[[List[str]], int],
+            argv: Optional[List[str]] = None) -> int:
+    """Parse flags + start the runtime, run ``body(remaining_argv)``,
+    always shut down. Returns a process exit code."""
+    try:
+        remaining = mv.init(argv if argv is not None else sys.argv[1:])
+    except _USER_ERRORS as e:
+        log.error("%s", e)
+        return 1
+    try:
+        return body(remaining)
+    except _USER_ERRORS as e:
+        log.error("%s", e)
+        return 1
+    finally:
+        mv.shutdown()

@@ -11,8 +11,8 @@ Usage:
 from __future__ import annotations
 
 import sys
+from typing import List
 
-import multiverso_tpu as mv
 from multiverso_tpu.utils import configure
 from multiverso_tpu.utils.dashboard import Dashboard
 from multiverso_tpu.utils.log import log
@@ -23,44 +23,44 @@ configure.define_string("lr_test_file", "", "test data")
 configure.define_string("output_file", "", "prediction output path")
 
 
+def _body(argv: List[str]) -> int:
+    del argv
+    from multiverso_tpu.models.logreg import (LogReg, LogRegConfig,
+                                              SampleReader)
+
+    config_file = configure.get_flag("config_file")
+    cfg = (LogRegConfig.from_file(config_file) if config_file
+           else LogRegConfig())
+    train_file = configure.get_flag("lr_train_file")
+    test_file = configure.get_flag("lr_test_file")
+    if not train_file:
+        log.error("missing -lr_train_file")
+        return 1
+    if cfg.num_feature <= 0:
+        log.error("config must set num_feature")
+        return 1
+
+    lr = LogReg(cfg)
+    reader = SampleReader(train_file, cfg.num_feature, cfg.minibatch_size,
+                          input_format=cfg.input_format, bias=cfg.bias)
+    losses = lr.train(reader)
+    log.info("train losses per epoch: %s",
+             ", ".join(f"{l:.5f}" for l in losses))
+    if test_file:
+        test_reader = SampleReader(test_file, cfg.num_feature,
+                                   cfg.minibatch_size,
+                                   input_format=cfg.input_format,
+                                   bias=cfg.bias)
+        acc = lr.test(test_reader,
+                      output_path=configure.get_flag("output_file") or None)
+        log.info("test accuracy: %.4f", acc)
+    Dashboard.display()
+    return 0
+
+
 def main(argv=None) -> int:
-    argv = mv.init(argv if argv is not None else sys.argv[1:])
-    try:
-        from multiverso_tpu.models.logreg import (LogReg, LogRegConfig,
-                                                  SampleReader)
-
-        config_file = configure.get_flag("config_file")
-        cfg = (LogRegConfig.from_file(config_file) if config_file
-               else LogRegConfig())
-        train_file = configure.get_flag("lr_train_file")
-        test_file = configure.get_flag("lr_test_file")
-        if not train_file:
-            log.error("missing -lr_train_file")
-            return 1
-        if cfg.num_feature <= 0:
-            log.error("config must set num_feature")
-            return 1
-
-        lr = LogReg(cfg)
-        reader = SampleReader(train_file, cfg.num_feature,
-                              cfg.minibatch_size,
-                              input_format=cfg.input_format, bias=cfg.bias)
-        losses = lr.train(reader)
-        log.info("train losses per epoch: %s",
-                 ", ".join(f"{l:.5f}" for l in losses))
-        if test_file:
-            test_reader = SampleReader(test_file, cfg.num_feature,
-                                       cfg.minibatch_size,
-                                       input_format=cfg.input_format,
-                                       bias=cfg.bias)
-            acc = lr.test(test_reader,
-                          output_path=configure.get_flag("output_file")
-                          or None)
-            log.info("test accuracy: %.4f", acc)
-        Dashboard.display()
-        return 0
-    finally:
-        mv.shutdown()
+    from multiverso_tpu.apps._runner import run_app
+    return run_app(_body, argv)
 
 
 if __name__ == "__main__":

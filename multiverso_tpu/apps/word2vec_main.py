@@ -14,8 +14,8 @@ Usage:
 from __future__ import annotations
 
 import sys
+from typing import List
 
-import multiverso_tpu as mv
 from multiverso_tpu.utils import configure
 from multiverso_tpu.utils.dashboard import Dashboard
 from multiverso_tpu.utils.log import log
@@ -43,52 +43,52 @@ configure.define_int("pad_sentence_length", 512,
                      "sentence pad length (device pipeline)")
 
 
+def _body(argv: List[str]) -> int:
+    del argv
+    from multiverso_tpu.models.word2vec import (Dictionary, Word2Vec,
+                                                Word2VecConfig, read_corpus)
+
+    train_file = configure.get_flag("train_file")
+    if not train_file:
+        log.error("missing -train_file")
+        return 1
+    sg = not configure.get_flag("cbow")
+    hs = configure.get_flag("hs")
+    log.info("building vocabulary from %s", train_file)
+    dictionary = Dictionary.build(read_corpus(train_file),
+                                  min_count=configure.get_flag("min_count"))
+    log.info("vocab=%d total_words=%d", len(dictionary),
+             dictionary.total_count)
+
+    cfg = Word2VecConfig(
+        embedding_size=configure.get_flag("size"),
+        window=configure.get_flag("window"),
+        negative=configure.get_flag("negative"),
+        min_count=configure.get_flag("min_count"),
+        sample=configure.get_flag("sample"),
+        batch_size=configure.get_flag("batch_size"),
+        learning_rate=configure.get_flag("alpha"),
+        epochs=configure.get_flag("epoch"),
+        sg=sg, hs=hs,
+        optimizer=configure.get_flag("w2v_optimizer"),
+        block_words=configure.get_flag("data_block_size"),
+        pipeline=configure.get_flag("is_pipeline"),
+        device_pipeline=(configure.get_flag("use_device_pipeline")
+                         and sg and not hs),
+        block_sentences=configure.get_flag("block_sentences"),
+        pad_sentence_length=configure.get_flag("pad_sentence_length"),
+    )
+    w2v = Word2Vec(cfg, dictionary)
+    stats = w2v.train(corpus_path=train_file)
+    log.info("trained: %.0f words/sec", stats["words_per_sec"])
+    w2v.save(configure.get_flag("output_file"))
+    Dashboard.display()
+    return 0
+
+
 def main(argv=None) -> int:
-    argv = mv.init(argv if argv is not None else sys.argv[1:])
-    try:
-        from multiverso_tpu.models.word2vec import (Dictionary, Word2Vec,
-                                                    Word2VecConfig,
-                                                    read_corpus)
-
-        train_file = configure.get_flag("train_file")
-        if not train_file:
-            log.error("missing -train_file")
-            return 1
-        sg = not configure.get_flag("cbow")
-        hs = configure.get_flag("hs")
-        log.info("building vocabulary from %s", train_file)
-        dictionary = Dictionary.build(
-            read_corpus(train_file),
-            min_count=configure.get_flag("min_count"))
-        log.info("vocab=%d total_words=%d", len(dictionary),
-                 dictionary.total_count)
-
-        cfg = Word2VecConfig(
-            embedding_size=configure.get_flag("size"),
-            window=configure.get_flag("window"),
-            negative=configure.get_flag("negative"),
-            min_count=configure.get_flag("min_count"),
-            sample=configure.get_flag("sample"),
-            batch_size=configure.get_flag("batch_size"),
-            learning_rate=configure.get_flag("alpha"),
-            epochs=configure.get_flag("epoch"),
-            sg=sg, hs=hs,
-            optimizer=configure.get_flag("w2v_optimizer"),
-            block_words=configure.get_flag("data_block_size"),
-            pipeline=configure.get_flag("is_pipeline"),
-            device_pipeline=(configure.get_flag("use_device_pipeline")
-                             and sg and not hs),
-            block_sentences=configure.get_flag("block_sentences"),
-            pad_sentence_length=configure.get_flag("pad_sentence_length"),
-        )
-        w2v = Word2Vec(cfg, dictionary)
-        stats = w2v.train(corpus_path=train_file)
-        log.info("trained: %.0f words/sec", stats["words_per_sec"])
-        w2v.save(configure.get_flag("output_file"))
-        Dashboard.display()
-        return 0
-    finally:
-        mv.shutdown()
+    from multiverso_tpu.apps._runner import run_app
+    return run_app(_body, argv)
 
 
 if __name__ == "__main__":

@@ -28,12 +28,29 @@ from multiverso_tpu.utils.log import check, log
 from multiverso_tpu.utils.stream import exists, open_stream
 
 
+_DTYPE_TAG_KEY = "__extension_dtypes__"
+
+
 def save_table(table: Any, uri: str) -> None:
     """``ServerTable::Store`` analog: table payload -> stream as npz."""
     payload = table.store_state() if hasattr(table, "store_state") \
         else table.store.store_state()
+    # npz can't round-trip extension dtypes (bf16 saves as raw void and
+    # fails to cast on load). Store them as same-width uint views plus a
+    # dtype tag so the checkpoint stays 2 bytes/element for bf16.
+    out: Dict[str, np.ndarray] = {}
+    tags: List[str] = []
+    for k, v in payload.items():
+        dt = np.dtype(v.dtype)
+        if dt.isbuiltin != 1:
+            out[k] = v.view(np.dtype(f"u{dt.itemsize}"))
+            tags.append(f"{k}={dt.name}")
+        else:
+            out[k] = v
+    if tags:
+        out[_DTYPE_TAG_KEY] = np.asarray(tags)
     buf = io.BytesIO()
-    np.savez(buf, **payload)
+    np.savez(buf, **out)
     with open_stream(uri, "w") as s:
         s.write(buf.getvalue())
 
@@ -42,7 +59,11 @@ def load_table(table: Any, uri: str) -> None:
     """``ServerTable::Load`` analog."""
     with open_stream(uri, "r") as s:
         data = np.load(io.BytesIO(s.read()))
-        payload = {k: data[k] for k in data.files}
+        payload = {k: data[k] for k in data.files if k != _DTYPE_TAG_KEY}
+    if _DTYPE_TAG_KEY in data.files:
+        for tag in data[_DTYPE_TAG_KEY].tolist():
+            key, _, dtype_name = tag.partition("=")
+            payload[key] = payload[key].view(np.dtype(dtype_name))
     if hasattr(table, "load_state"):
         table.load_state(payload)
     else:

@@ -166,8 +166,11 @@ class ServerStore:
         for key in list(self.state):
             saved = payload.get(f"state/{key}")
             if saved is not None:
+                # Checkpoint backends may widen extension dtypes (bf16) to
+                # f32 for serialization; restore the live leaf's dtype.
+                leaf = self.state[key]
                 self.state[key] = jax.device_put(
-                    saved, self.state[key].sharding)
+                    np.asarray(saved).astype(leaf.dtype), leaf.sharding)
 
 
 class WorkerTable:

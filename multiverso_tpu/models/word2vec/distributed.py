@@ -48,6 +48,9 @@ class DistributedWord2Vec:
                  rank: int, num_workers: Optional[int] = None):
         check(cfg.sg and not cfg.hs,
               "distributed mode implements skip-gram + negative sampling")
+        check(cfg.param_dtype == "float32",
+              "distributed mode stores float32 tables; param_dtype="
+              f"'{cfg.param_dtype}' is not supported here yet")
         self.cfg = cfg
         self.dict = dictionary
         self.rank = rank

@@ -602,7 +602,8 @@ class Word2Vec:
             for start in range(0, len(self.dict), batch_rows):
                 rows = list(range(start,
                                   min(start + batch_rows, len(self.dict))))
-                emb = self.input_table.get_rows(rows)
+                # astype: bf16 scalars don't support the 'f' format code
+                emb = self.input_table.get_rows(rows).astype(np.float32)
                 for r, vec in zip(rows, emb):
                     vec_s = " ".join(f"{x:.6f}" for x in vec)
                     f.write(f"{self.dict.words[r]} {vec_s}\n")

@@ -144,3 +144,20 @@ def test_orbax_backend_roundtrip(tmp_path, mv_env):
     # shardings restored intact
     import jax
     assert len(a.store.data.sharding.device_set) == mv.num_servers()
+
+
+def test_bf16_momentum_state_dtype_roundtrip(tmp_path, mv_env):
+    """Regression: widened-to-f32 updater state must restore to the live
+    leaf dtype (momentum 'smooth' is bf16 for bf16 tables)."""
+    t = mv.create_table(mv.MatrixTableOption(
+        num_row=8, num_col=4, dtype=np.dtype("bfloat16"),
+        updater="momentum_sgd"))
+    t.add(np.ones((8, 4), dtype=np.float32), mv.AddOption(momentum=0.5))
+    uri = f"file://{tmp_path}/bf16m.npz"
+    ckpt.save_table(t, uri)
+    ckpt.load_table(t, uri)
+    assert str(t.store.state["smooth"].dtype) == "bfloat16"
+    assert str(t.store.data.dtype) == "bfloat16"
+    # next update must not retrace to f32 nor change table dtype
+    t.add(np.ones((8, 4), dtype=np.float32), mv.AddOption(momentum=0.5))
+    assert str(t.store.data.dtype) == "bfloat16"

@@ -21,6 +21,17 @@ def _corpus(n_sentences=300, seed=0):
     return sentences
 
 
+
+def _assert_topic_separation(w2v, d, margin=0.1):
+    emb = w2v.embeddings().astype(np.float32)
+    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
+    a_ids = [d.word2id[w] for w in d.words if w.startswith("a")]
+    b_ids = [d.word2id[w] for w in d.words if w.startswith("b")]
+    intra = np.mean([emb[i] @ emb[j] for i in a_ids for j in a_ids if i != j])
+    inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
+    assert intra > inter + margin, f"intra={intra:.3f} inter={inter:.3f}"
+
+
 def test_dictionary_build_and_encode():
     sents = [["x", "y", "x"], ["x", "z"]]
     d = Dictionary.build(sents, min_count=1)
@@ -105,14 +116,7 @@ def test_training_separates_topics(mv_env):
                          pipeline=True, seed=3)
     w2v = Word2Vec(cfg, d)
     w2v.train(sentences=[d.encode(s) for s in sents])
-
-    emb = w2v.embeddings()
-    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
-    a_ids = [d.word2id[w] for w in d.words if w.startswith("a")]
-    b_ids = [d.word2id[w] for w in d.words if w.startswith("b")]
-    intra = np.mean([emb[i] @ emb[j] for i in a_ids for j in a_ids if i != j])
-    inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
-    assert intra > inter + 0.1, f"intra={intra:.3f} inter={inter:.3f}"
+    _assert_topic_separation(w2v, d)
     # most_similar agrees
     sims = w2v.most_similar(d.words[0], topk=3)
     topic = d.words[0][0]
@@ -161,13 +165,7 @@ def test_device_pipeline_matches_host_semantics(mv_env):
     w2v = Word2Vec(cfg, d)
     stats = w2v.train(sentences=[d.encode(s) for s in sents])
     assert stats["pairs"] > 0
-    emb = w2v.embeddings()
-    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
-    a_ids = [d.word2id[w] for w in d.words if w.startswith("a")]
-    b_ids = [d.word2id[w] for w in d.words if w.startswith("b")]
-    intra = np.mean([emb[i] @ emb[j] for i in a_ids for j in a_ids if i != j])
-    inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
-    assert intra > inter + 0.1, f"intra={intra:.3f} inter={inter:.3f}"
+    _assert_topic_separation(w2v, d)
 
 
 def test_bfloat16_params_train(mv_env):
@@ -182,11 +180,31 @@ def test_bfloat16_params_train(mv_env):
                          pad_sentence_length=16)
     w2v = Word2Vec(cfg, d)
     w2v.train(sentences=[d.encode(s) for s in sents])
-    emb = w2v.embeddings().astype(np.float32)
     assert str(w2v.input_table.store.dtype) == "bfloat16"
-    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
-    a_ids = [d.word2id[w] for w in d.words if w.startswith("a")]
-    b_ids = [d.word2id[w] for w in d.words if w.startswith("b")]
-    intra = np.mean([emb[i] @ emb[j] for i in a_ids for j in a_ids if i != j])
-    inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
-    assert intra > inter + 0.1, f"intra={intra:.3f} inter={inter:.3f}"
+    _assert_topic_separation(w2v, d)
+
+
+def test_bfloat16_save_and_checkpoint(tmp_path, mv_env):
+    """bf16 tables must export text embeddings and round-trip the npz
+    checkpoint (regression: bf16 scalars break 'f' formatting; npz stores
+    bf16 as raw void)."""
+    from multiverso_tpu.core import checkpoint as ckpt
+
+    sents = _corpus(30)
+    d = Dictionary.build(sents, min_count=1)
+    cfg = Word2VecConfig(embedding_size=8, batch_size=64, min_count=1,
+                         sample=0, epochs=1, pipeline=False,
+                         param_dtype="bfloat16")
+    w2v = Word2Vec(cfg, d)
+    w2v.train(sentences=[d.encode(s) for s in sents])
+    out = tmp_path / "emb.txt"
+    w2v.save(str(out))
+    assert len(out.read_text().strip().split("\n")) == len(d) + 1
+    uri = f"file://{tmp_path}/bf16_table.npz"
+    before = w2v.input_table.get().astype(np.float32)
+    ckpt.save_table(w2v.input_table, uri)
+    w2v.input_table.add(np.ones((len(d), 8), dtype=np.float32))
+    ckpt.load_table(w2v.input_table, uri)
+    np.testing.assert_allclose(
+        w2v.input_table.get().astype(np.float32), before)
+    assert str(w2v.input_table.store.dtype) == "bfloat16"
