@@ -38,6 +38,7 @@ from multiverso_tpu.core.updater import get_updater
 from multiverso_tpu.core.zoo import Zoo
 from multiverso_tpu.parallel.mesh import reference_server_offsets
 from multiverso_tpu.parallel.net import recv_message, send_message
+from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check, log
 
 
@@ -111,21 +112,23 @@ class PSService:
         if msg.type == MsgType.Request_Add:
             # payload: [keys(int32, may be empty = whole shard), delta,
             #           opt scalars(float32[5])]
-            keys, delta, opt_arr = msg.data
-            opt = _opt_from_array(opt_arr)
-            if keys.size == 0:
-                store.apply_dense(delta, opt)
-            else:
-                store.apply_rows(keys.astype(np.int32) - row_offset,
-                                 delta, opt)
+            with monitor("PS_SERVICE_ADD"):   # ref server.cpp:49 monitor
+                keys, delta, opt_arr = msg.data
+                opt = _opt_from_array(opt_arr)
+                if keys.size == 0:
+                    store.apply_dense(delta, opt)
+                else:
+                    store.apply_rows(keys.astype(np.int32) - row_offset,
+                                     delta, opt)
             return msg.create_reply()
         if msg.type == MsgType.Request_Get:
-            keys = msg.data[0]
-            if keys.size == 0:
-                values = np.asarray(store.read())
-            else:
-                values = np.asarray(store.read_rows(
-                    keys.astype(np.int32) - row_offset))
+            with monitor("PS_SERVICE_GET"):   # ref server.cpp:37 monitor
+                keys = msg.data[0]
+                if keys.size == 0:
+                    values = np.asarray(store.read())
+                else:
+                    values = np.asarray(store.read_rows(
+                        keys.astype(np.int32) - row_offset))
             reply = msg.create_reply()
             reply.data = [values]
             return reply
