@@ -14,9 +14,8 @@ from typing import Any, List, Optional
 
 import jax
 
-from multiverso_tpu.core.options import (AddOption, ArrayTableOption, GetOption,
-                                         KVTableOption, MatrixTableOption,
-                                         TableOption)
+from multiverso_tpu.core.options import (ArrayTableOption, KVTableOption,
+                                         MatrixTableOption, TableOption)
 from multiverso_tpu.core.zoo import Zoo
 from multiverso_tpu.parallel import collectives
 from multiverso_tpu.utils import configure

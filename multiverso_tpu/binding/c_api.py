@@ -17,8 +17,6 @@ from typing import Dict, List, Optional
 import numpy as np
 
 import multiverso_tpu as mv
-from multiverso_tpu.core.options import AddOption
-
 _tables: Dict[int, object] = {}
 _next_handle = [0]
 

@@ -17,7 +17,7 @@ stands in for the Lua/Torch binding capability).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Optional
 
 import numpy as np
 

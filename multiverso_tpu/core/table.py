@@ -24,7 +24,6 @@ need it.
 
 from __future__ import annotations
 
-import functools
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -35,7 +34,6 @@ import numpy as np
 from multiverso_tpu.core.options import AddOption, GetOption
 from multiverso_tpu.core.updater import Updater
 from multiverso_tpu.parallel import mesh as mesh_lib
-from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check
 
 

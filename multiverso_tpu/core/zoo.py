@@ -16,7 +16,7 @@ service, ref ``src/zoo.cpp:49``).
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, List, Optional
 
 import jax
 

@@ -14,7 +14,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-import multiverso_tpu as mv
 from multiverso_tpu.models.logreg.model import (LogRegConfig, make_model)
 from multiverso_tpu.models.logreg.objective import (correct_count,
                                                     get_objective)

@@ -18,7 +18,6 @@ FTRL updater owns {z, n} and recomputes weights (the reference's FTRL table).
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Optional
 
 import jax
@@ -29,7 +28,6 @@ import multiverso_tpu as mv
 from multiverso_tpu.core.options import AddOption, ArrayTableOption
 from multiverso_tpu.models.logreg.objective import get_objective
 from multiverso_tpu.utils.dashboard import monitor
-from multiverso_tpu.utils.log import check
 
 
 @dataclasses.dataclass

@@ -9,7 +9,7 @@ one MXU matmul; the background thread is the ``ASyncBuffer`` analog.
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Tuple
+from typing import Iterator, List, Tuple
 
 import numpy as np
 

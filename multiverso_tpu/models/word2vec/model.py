@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from functools import partial
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 import jax
@@ -38,7 +37,7 @@ from multiverso_tpu.models.word2vec.data import (BatchGenerator, BlockStream,
 from multiverso_tpu.models.word2vec.dictionary import (Dictionary,
                                                        HuffmanEncoder,
                                                        Sampler)
-from multiverso_tpu.utils.dashboard import Dashboard, monitor
+from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check, log
 
 _EPS = 1e-7

@@ -20,11 +20,11 @@ exactly.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional
 
 import numpy as np
 
-from multiverso_tpu.core.options import AddOption, GetOption
+from multiverso_tpu.core.options import AddOption
 from multiverso_tpu.core.updater import SGDUpdater, Updater
 from multiverso_tpu.runtime.ffi import DeltaBuffer
 from multiverso_tpu.utils.dashboard import monitor

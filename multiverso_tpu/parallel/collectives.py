@@ -17,13 +17,10 @@ hand-written Bruck/halving schedules entirely (SURVEY.md §2.3). Two surfaces:
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from multiverso_tpu.parallel.mesh import SERVER_AXIS
 

@@ -30,7 +30,6 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-import multiverso_tpu as mv
 from multiverso_tpu.core.actor import Message, MsgType
 from multiverso_tpu.core.options import AddOption
 from multiverso_tpu.core.table import ServerStore

@@ -21,9 +21,6 @@ design (no host involvement per step).
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -17,7 +17,7 @@ engine.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 

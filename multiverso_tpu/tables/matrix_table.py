@@ -16,7 +16,7 @@ survives as a ``partition`` parity helper for the host async engine.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence
 
 import jax
 import numpy as np

@@ -11,7 +11,7 @@ and the host Dashboard.
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+from typing import Iterator
 
 from multiverso_tpu.utils.dashboard import monitor
 
