@@ -175,10 +175,15 @@ class PeerClient:
         self._send_lock = threading.Lock()
         self._waiters: Dict[int, Tuple[threading.Event, List]] = {}
         self._waiters_lock = threading.Lock()
+        self._dead = False
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
     def request(self, msg: Message) -> Tuple[threading.Event, List]:
+        # A dead reader can never deliver a reply: fail immediately instead
+        # of letting the caller ride out its waiter timeout.
+        if self._dead:
+            raise OSError("connection to peer is closed")
         event = threading.Event()
         slot: List = []
         with self._waiters_lock:
@@ -201,8 +206,10 @@ class PeerClient:
                     event.set()
         except OSError:
             pass
-        # Peer went away: release every pending waiter with an empty slot so
-        # callers fail fast instead of timing out.
+        # Peer went away: mark dead (future requests fail immediately) and
+        # release every pending waiter with an empty slot so callers fail
+        # fast instead of timing out.
+        self._dead = True
         with self._waiters_lock:
             pending = list(self._waiters.values())
             self._waiters.clear()

@@ -171,3 +171,25 @@ def test_heartbeat_failure_detection(mv_env):
     else:
         pytest.fail("dead peer never detected")
     client.close()
+
+
+def test_peer_death_fails_fast_not_hangs(mv_env):
+    """Failure semantics: a worker whose peer dies mid-training gets a
+    prompt FatalError (fail-fast waiter release), never a hang."""
+    import time as _time
+    from multiverso_tpu.utils.log import FatalError
+
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    t0 = DistributedArrayTable(4, 20, svc0, peers, rank=0)
+    DistributedArrayTable(4, 20, svc1, peers, rank=1)
+    t0.add(np.ones(20, dtype=np.float32))        # healthy round trip
+    svc1.close()                                  # peer dies
+    _time.sleep(0.2)
+    start = _time.perf_counter()
+    with pytest.raises((FatalError, OSError)):
+        for _ in range(50):                       # conn may die lazily
+            t0.add(np.ones(20, dtype=np.float32))
+            _time.sleep(0.05)
+    assert _time.perf_counter() - start < 30      # fail-fast, not timeout
+    svc0.close()
