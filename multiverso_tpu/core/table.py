@@ -24,6 +24,7 @@ need it.
 
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -179,10 +180,17 @@ class WorkerTable:
     server) is subsumed by a single sharded computation touching all shards.
     """
 
+    # Bound on unwaited async requests kept resolvable. Fire-and-forget
+    # adds don't need an entry at all (see _register_add); gets beyond the
+    # cap are evicted oldest-first — an abandoned get was never going to be
+    # fetched (the reference frees waiters on reply; ours resolve lazily).
+    MAX_PENDING = 1 << 16
+
     def __init__(self, store: ServerStore):
         self.store = store
         self._msg_id = 0
-        self._pending: Dict[int, Callable[[], Any]] = {}
+        self._pending: "collections.OrderedDict[int, Callable[[], Any]]" = \
+            collections.OrderedDict()
         self._lock = threading.Lock()
         from multiverso_tpu.core.zoo import Zoo
         zoo = Zoo.get()
@@ -223,12 +231,26 @@ class WorkerTable:
             self._msg_id += 1
             msg_id = self._msg_id
             self._pending[msg_id] = resolve
+            while len(self._pending) > self.MAX_PENDING:
+                self._pending.popitem(last=False)
         return msg_id
+
+    def _register_add(self) -> int:
+        """Adds need no stored state: waiting for ANY add means waiting for
+        the store's update stream — so fire-and-forget add_async doesn't
+        grow the pending map."""
+        with self._lock:
+            self._msg_id += 1
+            return self._msg_id
 
     def wait(self, msg_id: int) -> Any:
         with self._lock:
             resolve = self._pending.pop(msg_id, None)
-        check(resolve is not None, f"unknown msg_id {msg_id}")
+        if resolve is None:
+            # Not a registered get: either an add handle (resolve = drain
+            # the update stream) or an evicted/unknown id.
+            check(0 < msg_id <= self._msg_id, f"unknown msg_id {msg_id}")
+            return self.store.block()
         return resolve()
 
     @property

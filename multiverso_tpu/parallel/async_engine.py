@@ -41,10 +41,16 @@ class AsyncTableEngine:
     def __init__(self, table: Any, flush_pending: int = 64,
                  sparse_drain_max: int = 4096,
                  flush_interval: Optional[float] = None):
+        from multiverso_tpu.tables.sparse_matrix_table import \
+            SparseMatrixTable
+
         self.table = table
         store = table.store
         check(store.dtype == np.float32,
               "async staging supports float32 tables")
+        check(not isinstance(table, SparseMatrixTable),
+              "async staging bypasses per-worker staleness bookkeeping; "
+              "use the SparseMatrixTable API directly")
         shape = store.logical_shape
         rows = shape[0]
         cols = shape[1] if len(shape) > 1 else 1

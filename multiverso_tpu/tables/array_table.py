@@ -65,7 +65,7 @@ class ArrayTable(WorkerTable):
         self._gate_add(option)
         self.store.apply_dense(delta, option or AddOption())
         self._commit_add(option)
-        return self._register(lambda: self.store.block())
+        return self._register_add()
 
     def add(self, delta, option: Optional[AddOption] = None) -> None:
         with monitor("WORKER_TABLE_SYNC_ADD"):

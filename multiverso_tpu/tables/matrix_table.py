@@ -74,7 +74,7 @@ class MatrixTable(WorkerTable):
         self._gate_add(option)
         self.store.apply_dense(delta, option or AddOption())
         self._commit_add(option)
-        return self._register(lambda: self.store.block())
+        return self._register_add()
 
     def add(self, delta, option: Optional[AddOption] = None) -> None:
         with monitor("WORKER_TABLE_SYNC_ADD"):
@@ -107,7 +107,7 @@ class MatrixTable(WorkerTable):
         self._gate_add(option)
         self.store.apply_rows(row_ids, deltas, option or AddOption())
         self._commit_add(option)
-        return self._register(lambda: self.store.block())
+        return self._register_add()
 
     def add_rows(self, row_ids, deltas,
                  option: Optional[AddOption] = None) -> None:

@@ -133,3 +133,24 @@ def test_background_flusher(mv_env):
     assert eng.pending == 0
     np.testing.assert_allclose(table.get(), d)
     eng.close()
+
+
+def test_fire_and_forget_adds_do_not_leak(mv_env):
+    """Unwaited add_async must not grow the pending waiter map."""
+    table = mv.create_table(mv.ArrayTableOption(size=8))
+    d = np.ones(8, dtype=np.float32)
+    for _ in range(1000):
+        table.add_async(d)
+    assert len(table._pending) == 0
+    # an add handle still waits correctly
+    msg_id = table.add_async(d)
+    table.wait(msg_id)
+    np.testing.assert_allclose(table.get(), d * 1001)
+
+
+def test_async_engine_rejects_sparse_tables(mv_env):
+    from multiverso_tpu.utils.log import FatalError
+    t = mv.create_table(mv.MatrixTableOption(num_row=4, num_col=2,
+                                             is_sparse=True))
+    with pytest.raises(FatalError):
+        AsyncTableEngine(t)
