@@ -225,6 +225,19 @@ class WorkerTable:
         if self._sync is not None:
             self._sync.finish_train(worker_id)
 
+    # -- cross-process BSP -------------------------------------------------
+    def add_synced(self, delta, option: Optional[AddOption] = None) -> None:
+        """BSP across PROCESSES: allreduce the delta over all JAX processes,
+        then every process applies the identical merged delta to its
+        replica — the collective form of the SyncServer guarantee (every
+        worker's i-th view identical). All processes must call this the
+        same number of times (it is a collective)."""
+        from multiverso_tpu.parallel import collectives
+
+        merged = collectives.aggregate(
+            np.asarray(delta, dtype=self.store.dtype))
+        self.add(merged, option)
+
     # -- waiter bookkeeping ------------------------------------------------
     def _register(self, resolve: Callable[[], Any]) -> int:
         with self._lock:

@@ -11,11 +11,12 @@ processes; for each data block a worker
 3. trains locally on the pulled sub-matrix — here with the fused jitted
    scan step on device, not scalar loops —
 4. pushes ``(new - old) / num_workers`` back (``AddDeltaParameter``,
-   communicator.cpp:157-202).
+   communicator.cpp:157-202 — the 1/N scaling applies to every table).
 
-SGD with the linear lr decay (the reference default) so the PS applies plain
-delta adds; AdaGrad state stays server-side in single-process mode
-(model.py) where it's exact.
+Optimizers: SGD with the linear lr decay (the reference default, plain
+delta adds) or AdaGrad with the accumulators in their own PS tables
+(``TABLE_G_IN``/``TABLE_G_OUT`` — the reference's two adagrad gradient
+matrices), pulled and pushed alongside the embeddings.
 """
 
 from __future__ import annotations
@@ -42,6 +43,8 @@ class DistributedWord2Vec:
 
     TABLE_IN = 100
     TABLE_OUT = 101
+    TABLE_G_IN = 102
+    TABLE_G_OUT = 103
 
     def __init__(self, cfg: Word2VecConfig, dictionary: Dictionary,
                  service: PSService, peers: List[Tuple[str, int]],
@@ -55,22 +58,35 @@ class DistributedWord2Vec:
         self.dict = dictionary
         self.rank = rank
         self.num_workers = num_workers or len(peers)
+        self._adagrad = cfg.optimizer == "adagrad"
         V, D = len(dictionary), cfg.embedding_size
         self.w_in = DistributedMatrixTable(self.TABLE_IN, V, D, service,
                                            peers, rank)
         self.w_out = DistributedMatrixTable(self.TABLE_OUT, V, D, service,
                                             peers, rank)
+        # AdaGrad accumulators as their own PS tables — the reference's two
+        # adagrad gradient matrices (communicator.cpp:17-32). Workers pull
+        # rows, accumulate locally, and push back the delta scaled by
+        # 1/num_workers, the same scaling the reference applies to every
+        # table's delta (GetDeltaLoop, communicator.cpp:167).
+        if self._adagrad:
+            self.g_in = DistributedMatrixTable(self.TABLE_G_IN, V, D,
+                                               service, peers, rank)
+            self.g_out = DistributedMatrixTable(self.TABLE_G_OUT, V, D,
+                                                service, peers, rank)
         self._initialized = False
         self.generator = BatchGenerator(
             dictionary, batch_size=cfg.batch_size, window=cfg.window,
             negative=cfg.negative, sample=cfg.sample, sg=True,
             seed=cfg.seed + rank)
-        self._scan_step = build_scan_step(raw_sg_ns_step(adagrad=False))
+        self._scan_step = build_scan_step(raw_sg_ns_step(self._adagrad))
         self.trained_words = 0
         self.total_words = dictionary.total_count * max(cfg.epochs, 1)
         self.words_per_sec = 0.0
 
     def _current_lr(self) -> float:
+        if self._adagrad:
+            return self.cfg.learning_rate
         frac = min(self.trained_words / max(self.total_words, 1), 1.0)
         return max(self.cfg.learning_rate * (1.0 - frac),
                    self.cfg.learning_rate * 1e-4)
@@ -93,6 +109,13 @@ class DistributedWord2Vec:
         local_in = self.w_in.get_rows(ids)
         local_out = self.w_out.get_rows(ids)
         old_in, old_out = local_in.copy(), local_out.copy()
+        if self._adagrad:
+            local_gin = self.g_in.get_rows(ids)
+            local_gout = self.g_out.get_rows(ids)
+            old_gin, old_gout = local_gin.copy(), local_gout.copy()
+        else:
+            local_gin = jnp.zeros_like(local_in)
+            local_gout = jnp.zeros_like(local_out)
 
         # Remap vocabulary ids -> local row indices.
         def rm(x):
@@ -105,17 +128,21 @@ class DistributedWord2Vec:
         group = group + [zero_batch] * (n_groups - len(group))
         stacked = tuple(np.stack([g[i] for g in group])
                         for i in range(4))
-        zeros = {"g_in": jnp.zeros_like(local_in),
-                 "g_out": jnp.zeros_like(local_out)}
         lr = np.float32(self._current_lr())
-        new_in, new_out, _, _, _ = self._scan_step(
+        new_in, new_out, new_gin, new_gout, _ = self._scan_step(
             jnp.asarray(local_in), jnp.asarray(local_out),
-            zeros["g_in"], zeros["g_out"], *stacked, lr)
+            jnp.asarray(local_gin), jnp.asarray(local_gout), *stacked, lr)
 
-        # Push averaged delta (AddDeltaParameter analog).
+        # Push averaged deltas (AddDeltaParameter analog): the reference
+        # divides EVERY table's delta by the worker count, accumulators
+        # included (communicator.cpp:167).
         scale = 1.0 / self.num_workers
         self.w_in.add_rows(ids, (np.asarray(new_in) - old_in) * scale)
         self.w_out.add_rows(ids, (np.asarray(new_out) - old_out) * scale)
+        if self._adagrad:
+            self.g_in.add_rows(ids, (np.asarray(new_gin) - old_gin) * scale)
+            self.g_out.add_rows(ids,
+                                (np.asarray(new_gout) - old_gout) * scale)
         return sum(len(s) for s in block)
 
     # -- training ---------------------------------------------------------------

@@ -79,3 +79,10 @@ def test_odd_size_not_divisible_by_servers(mv_env):
     delta = np.random.default_rng(0).normal(size=101).astype(np.float32)
     table.add(delta)
     np.testing.assert_allclose(table.get(), delta, rtol=1e-6)
+
+
+def test_add_synced_single_process(mv_env):
+    """add_synced == add at world size 1 (aggregate over one contributor)."""
+    t = mv.create_table(mv.ArrayTableOption(size=16))
+    t.add_synced(np.ones(16, dtype=np.float32))
+    np.testing.assert_allclose(t.get(), np.ones(16))

@@ -26,12 +26,12 @@ def test_two_rank_distributed_training(mv_env):
     d = Dictionary.build(sents, min_count=1)
     ids = [d.encode(s) for s in sents]
     # SGD path: with a 10-word toy vocab each word recurs ~30x per batch,
-    # so the summed per-batch gradient needs a small lr (adagrad, used by
-    # the single-process tests, self-normalizes this away).
+    # so the summed per-batch gradient needs a small lr (adagrad
+    # self-normalizes this away; see the adagrad test below).
     cfg = Word2VecConfig(embedding_size=32, batch_size=256, window=4,
                          negative=5, min_count=1, sample=0, sg=True,
                          epochs=4, learning_rate=0.005, block_words=2000,
-                         pipeline=False, seed=3)
+                         pipeline=False, seed=3, optimizer="sgd")
 
     svc0, svc1 = PSService(), PSService()
     peers = [svc0.address, svc1.address]
@@ -62,6 +62,47 @@ def test_two_rank_distributed_training(mv_env):
         # Both ranks see the same global table.
         np.testing.assert_allclose(w1.embeddings(), w0.embeddings(),
                                    rtol=1e-5, atol=1e-6)
+    finally:
+        svc0.close()
+        svc1.close()
+
+
+def test_two_rank_distributed_adagrad(mv_env):
+    """AdaGrad mode: accumulators live in their own PS tables (the
+    reference's two adagrad matrices) and workers push unscaled squared
+    gradients."""
+    sents = _corpus(300)
+    d = Dictionary.build(sents, min_count=1)
+    ids = [d.encode(s) for s in sents]
+    cfg = Word2VecConfig(embedding_size=32, batch_size=256, window=4,
+                         negative=5, min_count=1, sample=0, sg=True,
+                         epochs=3, learning_rate=0.1, block_words=2000,
+                         pipeline=False, seed=3, optimizer="adagrad")
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    try:
+        w0 = DistributedWord2Vec(cfg, d, svc0, peers, rank=0)
+        w1 = DistributedWord2Vec(cfg, d, svc1, peers, rank=1)
+        threads = [
+            threading.Thread(target=w0.train, args=(ids[0::2],)),
+            threading.Thread(target=w1.train, args=(ids[1::2],)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive()
+        emb = w0.embeddings()
+        emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
+        a_ids = [d.word2id[w] for w in d.words if w.startswith("a")]
+        b_ids = [d.word2id[w] for w in d.words if w.startswith("b")]
+        intra = np.mean([emb[i] @ emb[j]
+                         for i in a_ids for j in a_ids if i != j])
+        inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
+        assert intra > inter + 0.1, f"intra={intra:.3f} inter={inter:.3f}"
+        # accumulators actually accumulated on the PS
+        g = w0.g_in.get_rows(np.arange(len(d), dtype=np.int32))
+        assert g.sum() > 0
     finally:
         svc0.close()
         svc1.close()
