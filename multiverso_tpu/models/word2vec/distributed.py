@@ -28,10 +28,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from multiverso_tpu.models.word2vec.data import BatchGenerator, BlockStream
-from multiverso_tpu.models.word2vec.dictionary import Dictionary
+from multiverso_tpu.models.word2vec.data import (BatchGenerator,
+                                                 BlockStream, SkipGramBatch)
+from multiverso_tpu.models.word2vec.dictionary import (Dictionary,
+                                                       HuffmanEncoder)
 from multiverso_tpu.models.word2vec.model import (Word2VecConfig,
                                                   build_scan_step,
+                                                  raw_cbow_hs_step,
+                                                  raw_cbow_ns_step,
+                                                  raw_sg_hs_step,
                                                   raw_sg_ns_step)
 from multiverso_tpu.parallel.ps_service import (DistributedMatrixTable,
                                                 PSService)
@@ -39,7 +44,10 @@ from multiverso_tpu.utils.log import check, log
 
 
 class DistributedWord2Vec:
-    """Skip-gram + negative sampling over process-sharded tables."""
+    """All four word2vec variants (sg/cbow x ns/hs) over process-sharded
+    tables. Input and output tables have separate id spaces (HS output rows
+    are Huffman inner nodes), so each is pulled/remapped/pushed with its own
+    touched-row set."""
 
     TABLE_IN = 100
     TABLE_OUT = 101
@@ -49,8 +57,6 @@ class DistributedWord2Vec:
     def __init__(self, cfg: Word2VecConfig, dictionary: Dictionary,
                  service: PSService, peers: List[Tuple[str, int]],
                  rank: int, num_workers: Optional[int] = None):
-        check(cfg.sg and not cfg.hs,
-              "distributed mode implements skip-gram + negative sampling")
         check(cfg.param_dtype == "float32",
               "distributed mode stores float32 tables; param_dtype="
               f"'{cfg.param_dtype}' is not supported here yet")
@@ -60,10 +66,11 @@ class DistributedWord2Vec:
         self.num_workers = num_workers or len(peers)
         self._adagrad = cfg.optimizer == "adagrad"
         V, D = len(dictionary), cfg.embedding_size
+        out_rows = max((V - 1) if cfg.hs else V, 1)  # HS: inner nodes
         self.w_in = DistributedMatrixTable(self.TABLE_IN, V, D, service,
                                            peers, rank)
-        self.w_out = DistributedMatrixTable(self.TABLE_OUT, V, D, service,
-                                            peers, rank)
+        self.w_out = DistributedMatrixTable(self.TABLE_OUT, out_rows, D,
+                                            service, peers, rank)
         # AdaGrad accumulators as their own PS tables — the reference's two
         # adagrad gradient matrices (communicator.cpp:17-32). Workers pull
         # rows, accumulate locally, and push back the delta scaled by
@@ -72,14 +79,25 @@ class DistributedWord2Vec:
         if self._adagrad:
             self.g_in = DistributedMatrixTable(self.TABLE_G_IN, V, D,
                                                service, peers, rank)
-            self.g_out = DistributedMatrixTable(self.TABLE_G_OUT, V, D,
-                                                service, peers, rank)
+            self.g_out = DistributedMatrixTable(self.TABLE_G_OUT, out_rows,
+                                                D, service, peers, rank)
         self._initialized = False
         self.generator = BatchGenerator(
             dictionary, batch_size=cfg.batch_size, window=cfg.window,
-            negative=cfg.negative, sample=cfg.sample, sg=True,
+            negative=cfg.negative, sample=cfg.sample, sg=cfg.sg,
             seed=cfg.seed + rank)
-        self._scan_step = build_scan_step(raw_sg_ns_step(self._adagrad))
+        self.huffman = (HuffmanEncoder(dictionary.counts,
+                                       cfg.max_code_length)
+                        if cfg.hs else None)
+        if cfg.sg and not cfg.hs:
+            raw = raw_sg_ns_step(self._adagrad)
+        elif cfg.sg and cfg.hs:
+            raw = raw_sg_hs_step(self._adagrad)
+        elif not cfg.sg and not cfg.hs:
+            raw = raw_cbow_ns_step(self._adagrad)
+        else:
+            raw = raw_cbow_hs_step(self._adagrad)
+        self._scan_step = build_scan_step(raw)
         self.trained_words = 0
         self.total_words = dictionary.total_count * max(cfg.epochs, 1)
         self.words_per_sec = 0.0
@@ -92,42 +110,96 @@ class DistributedWord2Vec:
                    self.cfg.learning_rate * 1e-4)
 
     # -- one data block -------------------------------------------------------
+    @staticmethod
+    def _bucketed_unique(values: np.ndarray) -> np.ndarray:
+        """Unique ids padded to a power of two (repeat-last padding) so the
+        jitted scan step compiles once per bucket, not once per block."""
+        ids = np.unique(values)
+        bucket = 1 << int(np.ceil(np.log2(max(len(ids), 1))))
+        return np.concatenate(
+            [ids, np.full(bucket - len(ids), ids[-1], ids.dtype)])
+
+    def _hs_codes(self, words: np.ndarray, mask: np.ndarray):
+        points = self.huffman.points[words]
+        codes = self.huffman.codes[words]
+        lmask = ((np.arange(self.cfg.max_code_length)[None, :] <
+                  self.huffman.lengths[words][:, None])
+                 .astype(np.float32) * mask[:, None])
+        return points, codes, lmask
+
+    def _collect_and_remap(self, batches):
+        """Per-variant touched-row sets for w_in / w_out and the remapped
+        per-batch step args."""
+        sg, hs = self.cfg.sg, self.cfg.hs
+        if sg:
+            ids_in = self._bucketed_unique(
+                np.concatenate([b.centers for b in batches]))
+        else:
+            ids_in = self._bucketed_unique(
+                np.concatenate([b.contexts.reshape(-1) for b in batches]))
+        if hs:
+            targets = [b.contexts if sg else b.centers for b in batches]
+            points_all = np.concatenate(
+                [self.huffman.points[t].reshape(-1) for t in targets])
+            ids_out = self._bucketed_unique(points_all)
+        else:
+            if sg:
+                ids_out = self._bucketed_unique(np.concatenate(
+                    [np.concatenate([b.contexts, b.negatives.reshape(-1)])
+                     for b in batches]))
+            else:
+                ids_out = self._bucketed_unique(np.concatenate(
+                    [np.concatenate([b.centers, b.negatives.reshape(-1)])
+                     for b in batches]))
+
+        def rm_in(x):
+            return np.searchsorted(ids_in, x).astype(np.int32)
+
+        def rm_out(x):
+            return np.searchsorted(ids_out, x).astype(np.int32)
+
+        group = []
+        for b in batches:
+            if sg and not hs:
+                group.append((rm_in(b.centers), rm_out(b.contexts),
+                              rm_out(b.negatives), b.mask))
+            elif sg and hs:
+                points, codes, lmask = self._hs_codes(b.contexts, b.mask)
+                group.append((rm_in(b.centers), rm_out(points), codes,
+                              lmask))
+            elif not sg and not hs:
+                group.append((rm_out(b.centers), rm_in(b.contexts),
+                              b.context_mask, rm_out(b.negatives), b.mask))
+            else:
+                points, codes, lmask = self._hs_codes(b.centers, b.mask)
+                # centers are unused by the cbow-hs step (tables are indexed
+                # via contexts and points only)
+                group.append((b.centers, rm_in(b.contexts), b.context_mask,
+                              rm_out(points), codes, lmask))
+        return ids_in, ids_out, group
+
     def _train_block(self, block: List[Sequence[int]]) -> int:
         batches = list(self.generator.batches(block))
         if not batches:
             return 0
-        # The touched row set: centers + contexts + negatives. Pad the id
-        # list and the batch-group count to powers of two so the jitted
-        # scan step compiles once per bucket, not once per block.
-        ids = np.unique(np.concatenate(
-            [np.concatenate([b.centers, b.contexts,
-                             b.negatives.reshape(-1)]) for b in batches]))
-        bucket = 1 << int(np.ceil(np.log2(max(len(ids), 1))))
-        ids = np.concatenate(
-            [ids, np.full(bucket - len(ids), ids[-1], ids.dtype)])
+        ids_in, ids_out, group = self._collect_and_remap(batches)
         # Pull (RequestParameter analog).
-        local_in = self.w_in.get_rows(ids)
-        local_out = self.w_out.get_rows(ids)
+        local_in = self.w_in.get_rows(ids_in)
+        local_out = self.w_out.get_rows(ids_out)
         old_in, old_out = local_in.copy(), local_out.copy()
         if self._adagrad:
-            local_gin = self.g_in.get_rows(ids)
-            local_gout = self.g_out.get_rows(ids)
+            local_gin = self.g_in.get_rows(ids_in)
+            local_gout = self.g_out.get_rows(ids_out)
             old_gin, old_gout = local_gin.copy(), local_gout.copy()
         else:
             local_gin = jnp.zeros_like(local_in)
             local_gout = jnp.zeros_like(local_out)
 
-        # Remap vocabulary ids -> local row indices.
-        def rm(x):
-            return np.searchsorted(ids, x).astype(np.int32)
-
-        group = [(rm(b.centers), rm(b.contexts), rm(b.negatives), b.mask)
-                 for b in batches]
         n_groups = 1 << int(np.ceil(np.log2(len(group))))
         zero_batch = tuple(np.zeros_like(a) for a in group[0])
         group = group + [zero_batch] * (n_groups - len(group))
         stacked = tuple(np.stack([g[i] for g in group])
-                        for i in range(4))
+                        for i in range(len(group[0])))
         lr = np.float32(self._current_lr())
         new_in, new_out, new_gin, new_gout, _ = self._scan_step(
             jnp.asarray(local_in), jnp.asarray(local_out),
@@ -137,11 +209,13 @@ class DistributedWord2Vec:
         # divides EVERY table's delta by the worker count, accumulators
         # included (communicator.cpp:167).
         scale = 1.0 / self.num_workers
-        self.w_in.add_rows(ids, (np.asarray(new_in) - old_in) * scale)
-        self.w_out.add_rows(ids, (np.asarray(new_out) - old_out) * scale)
+        self.w_in.add_rows(ids_in, (np.asarray(new_in) - old_in) * scale)
+        self.w_out.add_rows(ids_out,
+                            (np.asarray(new_out) - old_out) * scale)
         if self._adagrad:
-            self.g_in.add_rows(ids, (np.asarray(new_gin) - old_gin) * scale)
-            self.g_out.add_rows(ids,
+            self.g_in.add_rows(ids_in,
+                               (np.asarray(new_gin) - old_gin) * scale)
+            self.g_out.add_rows(ids_out,
                                 (np.asarray(new_gout) - old_gout) * scale)
         return sum(len(s) for s in block)
 

@@ -106,3 +106,34 @@ def test_two_rank_distributed_adagrad(mv_env):
     finally:
         svc0.close()
         svc1.close()
+
+
+@pytest.mark.parametrize("sg,hs", [(True, True), (False, False),
+                                   (False, True)])
+def test_distributed_variants_smoke(mv_env, sg, hs):
+    """HS and CBOW distributed modes train without NaNs and update both
+    tables (sg+ns is covered by the convergence tests above)."""
+    sents = _corpus(80)
+    d = Dictionary.build(sents, min_count=1)
+    ids = [d.encode(s) for s in sents]
+    cfg = Word2VecConfig(embedding_size=16, batch_size=128, window=3,
+                         negative=3, min_count=1, sample=0, sg=sg, hs=hs,
+                         epochs=1, learning_rate=0.05, block_words=500,
+                         pipeline=False, seed=1, optimizer="adagrad")
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    try:
+        w0 = DistributedWord2Vec(cfg, d, svc0, peers, rank=0)
+        w1 = DistributedWord2Vec(cfg, d, svc1, peers, rank=1)
+        w0.train(ids[0::2])
+        w1.train(ids[1::2])
+        emb = w0.embeddings()
+        assert np.isfinite(emb).all()
+        out_rows = (len(d) - 1) if hs else len(d)
+        out = w0.w_out.get_rows(np.arange(out_rows, dtype=np.int32))
+        assert np.abs(out).sum() > 0      # output table actually trained
+        np.testing.assert_allclose(w1.embeddings(), emb, rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        svc0.close()
+        svc1.close()
