@@ -260,6 +260,19 @@ class DistributedTableBase:
             client = self._clients[server] = PeerClient(host, port)
         return client
 
+    def reconnect(self, server: int,
+                  address: Optional[Tuple[str, int]] = None) -> None:
+        """Elastic re-admission: point this table at a restarted peer
+        (optionally at a new address) and drop the dead connection. The
+        restarted rank re-registers its shard (restored from checkpoint)
+        and traffic resumes — the recovery story the reference leaves to
+        'checkpoint/resume' alone (SURVEY.md §5)."""
+        if address is not None:
+            self._peers[server] = address
+        old = self._clients.pop(server, None)
+        if old is not None:
+            old.close()
+
     @classmethod
     def _next_msg_id(cls) -> int:
         with cls._counter_lock:

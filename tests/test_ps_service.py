@@ -193,3 +193,38 @@ def test_peer_death_fails_fast_not_hangs(mv_env):
             _time.sleep(0.05)
     assert _time.perf_counter() - start < 30      # fail-fast, not timeout
     svc0.close()
+
+
+def test_elastic_rank_restart_and_readmission(mv_env):
+    """Kill rank 1, restart it from a checkpoint of its shard, reconnect —
+    traffic resumes with no lost state."""
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    t0 = DistributedArrayTable(6, 40, svc0, peers, rank=0)
+    t1 = DistributedArrayTable(6, 40, svc1, peers, rank=1)
+    t0.add(np.arange(40, dtype=np.float32))
+    np.testing.assert_allclose(t0.get(), np.arange(40))
+
+    # rank 1 checkpoints its shard, then dies
+    shard_snapshot = t1.local_store.store_state()
+    svc1.close()
+    time.sleep(0.2)
+    with pytest.raises(Exception):
+        for _ in range(50):
+            t0.add(np.ones(40, dtype=np.float32))
+            time.sleep(0.05)
+    state_before_restart = t0.local_store.store_state()["data"]
+
+    # rank 1 restarts at a NEW address, restores its shard, re-registers
+    svc1b = PSService()
+    t1b = DistributedArrayTable(6, 40, svc1b, 
+                                [peers[0], svc1b.address], rank=1)
+    t1b.local_store.load_state(shard_snapshot)
+    t0.reconnect(1, svc1b.address)
+
+    # traffic resumes; rank-1 shard content survived the restart
+    full = t0.get()
+    np.testing.assert_allclose(full[20:40], np.arange(20, 40))
+    t0.add(np.ones(40, dtype=np.float32))
+    assert t0.get()[39] == pytest.approx(40.0)
+    svc0.close(); svc1b.close()
