@@ -49,7 +49,8 @@ class ServerStore:
     def __init__(self, name: str, shape: Tuple[int, ...], dtype: Any,
                  updater: Updater, mesh: jax.sharding.Mesh,
                  num_workers: int, shard_axis: int = 0,
-                 init_array: Optional[np.ndarray] = None):
+                 init_array: Optional[np.ndarray] = None,
+                 use_pallas_rows: bool = False):
         self.name = name
         self.logical_shape = tuple(int(s) for s in shape)
         self.dtype = np.dtype(dtype)
@@ -86,6 +87,17 @@ class ServerStore:
             leaf_sharding = mesh_lib.table_sharding(mesh, leaf.ndim, leaf_axis)
             self.state[key] = jax.device_put(leaf, leaf_sharding)
 
+        # Opt-in Pallas row data plane (DMA gather / sorted scatter-add,
+        # ops/pallas_rows.py). Narrow eligibility by design: 2-D float32
+        # tables on a single shard with the plain accumulating updater —
+        # the per-row hot path the kernels target. Everything else uses
+        # the XLA gather/scatter path.
+        self._pallas_rows = bool(
+            use_pallas_rows
+            and len(self.padded_shape) == 2
+            and self.dtype == np.float32
+            and num_servers == 1
+            and type(updater).__name__ == "Updater")
         self._build_kernels()
         self._lock = threading.Lock()
 
@@ -117,9 +129,28 @@ class ServerStore:
             return jnp.take(data, row_ids, axis=axis, mode="clip")
 
         self._dense_update = jax.jit(dense, donate_argnums=(0, 1))
-        self._row_update = jax.jit(rows, donate_argnums=(0, 1))
+        if self._pallas_rows:
+            from multiverso_tpu.ops.pallas_rows import (gather_rows,
+                                                        scatter_add_rows)
+
+            # Mosaic kernels need the interpreter on CPU backends (tests).
+            interpret = jax.default_backend() == "cpu"
+
+            def pallas_rows_update(data, state, row_ids, delta, *opt):
+                del opt
+                return (scatter_add_rows(data, row_ids, delta,
+                                         interpret=interpret), state)
+
+            def pallas_access_rows(data, row_ids):
+                return gather_rows(data, row_ids, interpret=interpret)
+
+            self._row_update = jax.jit(pallas_rows_update,
+                                       donate_argnums=(0, 1))
+            self._access_rows = pallas_access_rows  # inner fns already jit
+        else:
+            self._row_update = jax.jit(rows, donate_argnums=(0, 1))
+            self._access_rows = jax.jit(access_rows)
         self._access = jax.jit(access)
-        self._access_rows = jax.jit(access_rows)
 
     # -- server ops (ref ServerTable::ProcessAdd/ProcessGet) ---------------
     # Every dispatch happens under the store lock: the update kernels DONATE

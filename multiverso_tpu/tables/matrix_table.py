@@ -43,7 +43,8 @@ class MatrixTable(WorkerTable):
                                ).astype(option.dtype)
         store = ServerStore(name, (option.num_row, option.num_col),
                             option.dtype, updater, zoo.mesh,
-                            zoo.num_workers(), shard_axis=0, init_array=init)
+                            zoo.num_workers(), shard_axis=0, init_array=init,
+                            use_pallas_rows=option.use_pallas)
         super().__init__(store)
         self.num_row = option.num_row
         self.num_col = option.num_col

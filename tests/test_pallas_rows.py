@@ -52,3 +52,42 @@ def test_scatter_add_unsorted_wrapper():
     expected = table.copy()
     np.add.at(expected, ids, deltas)
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+def test_pallas_table_path(monkeypatch):
+    """MatrixTable with use_pallas=True routes row ops through the Mosaic
+    kernels (interpret mode on CPU) with identical semantics. Eligibility
+    needs a single shard: restrict the mesh to one device."""
+    import multiverso_tpu as mv
+
+    mv.init([], devices=jax.devices()[:1])
+    try:
+        t = mv.create_table(mv.MatrixTableOption(num_row=64, num_col=128,
+                                                 use_pallas=True))
+        assert t.store._pallas_rows
+        rows = [3, 9, 3, 63]
+        deltas = np.stack([np.full(128, float(i + 1), dtype=np.float32)
+                           for i in range(4)])
+        t.add_rows(rows, deltas)
+        expected = np.zeros((64, 128), dtype=np.float32)
+        np.add.at(expected, rows, deltas)
+        np.testing.assert_allclose(t.get_rows([3, 9, 63]),
+                                   expected[[3, 9, 63]], rtol=1e-6)
+        np.testing.assert_allclose(t.get(), expected, rtol=1e-6)
+    finally:
+        mv.shutdown()
+
+
+def test_pallas_flag_ignored_when_ineligible():
+    """Sharded tables (8 devices) silently fall back to the XLA path."""
+    import multiverso_tpu as mv
+
+    mv.init([])
+    try:
+        t = mv.create_table(mv.MatrixTableOption(num_row=64, num_col=128,
+                                                 use_pallas=True))
+        assert not t.store._pallas_rows   # 8 shards -> ineligible
+        t.add_rows([5], np.ones((1, 128), dtype=np.float32))
+        np.testing.assert_allclose(t.get_row(5), np.ones(128))
+    finally:
+        mv.shutdown()
