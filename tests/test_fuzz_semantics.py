@@ -1,0 +1,89 @@
+"""Property tests: random op sequences vs a numpy reference model.
+
+The reference validates tables with exact-arithmetic invariants (SURVEY.md
+§4); this extends that idea to randomized sequences — any divergence between
+the sharded device tables and a plain numpy model is a bug.
+"""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.options import AddOption
+
+
+def test_matrix_random_ops_match_numpy_model(mv_env):
+    rng = np.random.default_rng(0)
+    R, C = 37, 5    # odd row count: exercises shard padding
+    table = mv.create_table(mv.MatrixTableOption(num_row=R, num_col=C))
+    model = np.zeros((R, C), dtype=np.float32)
+    for step in range(60):
+        op = rng.integers(0, 4)
+        if op == 0:      # dense add
+            delta = rng.normal(size=(R, C)).astype(np.float32)
+            table.add(delta)
+            model += delta
+        elif op == 1:    # row add (with duplicates)
+            n = int(rng.integers(1, 8))
+            rows = rng.integers(0, R, size=n)
+            deltas = rng.normal(size=(n, C)).astype(np.float32)
+            table.add_rows(rows, deltas)
+            np.add.at(model, rows, deltas)
+        elif op == 2:    # row get
+            n = int(rng.integers(1, 8))
+            rows = rng.integers(0, R, size=n)
+            np.testing.assert_allclose(table.get_rows(rows), model[rows],
+                                       rtol=1e-4, atol=1e-5)
+        else:            # whole get
+            np.testing.assert_allclose(table.get(), model,
+                                       rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(table.get(), model, rtol=1e-4, atol=1e-5)
+
+
+def test_array_updater_sequences_match_model(mv_env):
+    """Random interleavings of momentum updates track the closed form."""
+    rng = np.random.default_rng(1)
+    N = 23
+    m = 0.7
+    table = mv.create_table(mv.ArrayTableOption(size=N,
+                                                updater="momentum_sgd"))
+    data = np.zeros(N, dtype=np.float64)
+    smooth = np.zeros(N, dtype=np.float64)
+    for _ in range(40):
+        delta = rng.normal(size=N).astype(np.float32)
+        table.add(delta, AddOption(momentum=m))
+        smooth = m * smooth + (1 - m) * delta
+        data = data - smooth
+        np.testing.assert_allclose(table.get(), data, rtol=1e-3, atol=1e-4)
+
+
+def test_distributed_tables_match_model():
+    """Random routed row traffic across two ranks equals the numpy model."""
+    from multiverso_tpu.parallel.ps_service import (DistributedMatrixTable,
+                                                    PSService)
+
+    mv.init([])
+    try:
+        rng = np.random.default_rng(2)
+        R, C = 31, 4
+        svc0, svc1 = PSService(), PSService()
+        peers = [svc0.address, svc1.address]
+        t0 = DistributedMatrixTable(11, R, C, svc0, peers, rank=0)
+        t1 = DistributedMatrixTable(11, R, C, svc1, peers, rank=1)
+        model = np.zeros((R, C), dtype=np.float32)
+        tables = [t0, t1]
+        for _ in range(40):
+            t = tables[int(rng.integers(0, 2))]
+            n = int(rng.integers(1, 6))
+            rows = rng.integers(0, R, size=n)
+            deltas = rng.normal(size=(n, C)).astype(np.float32)
+            t.add_rows(rows, deltas)
+            np.add.at(model, rows, deltas)
+        all_rows = np.arange(R, dtype=np.int32)
+        np.testing.assert_allclose(t0.get_rows(all_rows), model,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(t1.get_rows(all_rows), model,
+                                   rtol=1e-4, atol=1e-5)
+        svc0.close(); svc1.close()
+    finally:
+        mv.shutdown()
