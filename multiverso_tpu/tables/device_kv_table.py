@@ -1,0 +1,114 @@
+"""Device-resident KV table: host key directory over an HBM value slab.
+
+The plain :class:`KVTable` keeps values host-side — faithful to the
+reference's metadata use (``kv_table.h``), but wrong for KV workloads whose
+values are large vectors (lightLDA-scale topic rows). This hybrid keeps the
+**values in device HBM** (a sharded slab served by the same jitted updater
+data plane as the matrix tables) while the **key -> slot directory stays on
+the host** — directory ops are branchy pointer-chasing XLA should never see,
+and they're tiny next to the value traffic.
+
+Capacity is fixed at creation (slots are never reclaimed — matching the
+reference's grow-only server maps); exceeding it is a fatal check.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from multiverso_tpu.core.options import AddOption, KVTableOption
+from multiverso_tpu.core.table import ServerStore
+from multiverso_tpu.core.updater import get_updater
+from multiverso_tpu.core.zoo import Zoo
+from multiverso_tpu.utils.log import check
+
+
+class DeviceKVTable:
+    def __init__(self, option: KVTableOption, value_dim: int = 1):
+        zoo = Zoo.get()
+        check(zoo.started, "call mv.init() before creating tables")
+        self.name = option.name or f"devkv_{len(zoo.tables)}"
+        self.capacity = option.capacity
+        self.value_dim = int(value_dim)
+        updater = get_updater(option.value_dtype, option.updater)
+        self.store = ServerStore(self.name,
+                                 (self.capacity, self.value_dim),
+                                 option.value_dtype, updater, zoo.mesh,
+                                 zoo.num_workers())
+        self._slots: Dict[int, int] = {}
+        self._next_slot = 0
+        self._lock = threading.Lock()
+        self.table_id = zoo.register_table(self)
+
+    # -- directory ---------------------------------------------------------
+    def _resolve(self, keys: np.ndarray, allocate: bool) -> np.ndarray:
+        """keys -> slot ids; unknown keys get -1 (get) or a fresh slot
+        (add)."""
+        out = np.empty(len(keys), dtype=np.int32)
+        with self._lock:
+            for i, k in enumerate(keys.tolist()):
+                slot = self._slots.get(k)
+                if slot is None:
+                    if not allocate:
+                        out[i] = -1
+                        continue
+                    check(self._next_slot < self.capacity,
+                          f"DeviceKVTable '{self.name}' capacity "
+                          f"{self.capacity} exhausted")
+                    slot = self._next_slot
+                    self._next_slot += 1
+                    self._slots[k] = slot
+                out[i] = slot
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    # -- ops ---------------------------------------------------------------
+    def add(self, keys, values,
+            option: Optional[AddOption] = None) -> None:
+        """Server-side updater per key (``+=`` with the default updater)."""
+        keys = np.asarray(keys, dtype=np.int64).ravel()
+        values = np.asarray(values, dtype=self.store.dtype)
+        if values.ndim == 1:
+            values = values[:, None]
+        check(values.shape == (len(keys), self.value_dim),
+              f"values shape {values.shape} != "
+              f"{(len(keys), self.value_dim)}")
+        slots = self._resolve(keys, allocate=True)
+        self.store.apply_rows(slots, values, option or AddOption())
+
+    def get(self, keys) -> np.ndarray:
+        """Missing keys read as zero (reference map semantics)."""
+        keys = np.asarray(keys, dtype=np.int64).ravel()
+        slots = self._resolve(keys, allocate=False)
+        clipped = np.maximum(slots, 0)
+        rows = np.array(self.store.read_rows(clipped.astype(np.int32)))
+        rows[slots < 0] = 0
+        return rows[:, 0] if self.value_dim == 1 else rows
+
+    # -- checkpointing -----------------------------------------------------
+    def store_state(self) -> Dict[str, np.ndarray]:
+        with self._lock:
+            keys = np.asarray(list(self._slots.keys()), dtype=np.int64)
+            slots = np.asarray(list(self._slots.values()), dtype=np.int32)
+        payload = self.store.store_state()
+        payload["kv_keys"] = keys
+        payload["kv_slots"] = slots
+        return payload
+
+    def load_state(self, payload: Dict[str, np.ndarray]) -> None:
+        self.store.load_state(payload)
+        with self._lock:
+            self._slots = dict(zip(payload["kv_keys"].tolist(),
+                                   payload["kv_slots"].tolist()))
+            self._next_slot = (int(payload["kv_slots"].max()) + 1
+                               if len(payload["kv_slots"]) else 0)
+
+    def close(self) -> None:
+        with self._lock:
+            self._slots.clear()
