@@ -96,16 +96,21 @@ constexpr int kStripes = 64;
 struct MvBuffer {
   std::vector<float> data;          // flat [rows * cols] or [n]
   int64_t rows, cols;               // cols==1 for 1-D
+  int64_t rows_per_stripe;          // ONE row->stripe mapping for all ops
   std::mutex stripes[kStripes];
   std::atomic<int64_t> pending{0};  // adds staged since last drain
   std::vector<uint8_t> row_dirty;   // per-row touched flag (sparse drain)
 
   MvBuffer(int64_t r, int64_t c)
       : data(static_cast<size_t>(r * c), 0.0f), rows(r), cols(c),
+        rows_per_stripe((r + kStripes - 1) / kStripes),
         row_dirty(static_cast<size_t>(r), 0) {}
 
+  // Range-based mapping shared by dense (whole stripe ranges) and row
+  // (single row) paths — a modulo mapping here would lock a DIFFERENT
+  // stripe than the dense path for the same row (caught by TSAN).
   inline std::mutex& stripe_for_row(int64_t row) {
-    return stripes[row % kStripes];
+    return stripes[row / rows_per_stripe];
   }
 };
 
@@ -232,7 +237,7 @@ void mvbuf_destroy(void* bp) { delete static_cast<MvBuffer*>(bp); }
 // concurrent threads make progress on disjoint row ranges.
 void mvbuf_add_dense(void* bp, const float* delta, float alpha) {
   auto* b = static_cast<MvBuffer*>(bp);
-  const int64_t rows_per_stripe = (b->rows + kStripes - 1) / kStripes;
+  const int64_t rows_per_stripe = b->rows_per_stripe;
   for (int s = 0; s < kStripes; ++s) {
     const int64_t r0 = s * rows_per_stripe;
     if (r0 >= b->rows) break;

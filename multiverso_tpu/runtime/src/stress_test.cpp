@@ -1,0 +1,139 @@
+// Threaded stress binary for the native runtime, built under TSAN
+// (`make tsan-check`). Hammers the queue, waiter, allocator, and delta
+// buffer from many threads; any data race is a TSAN report + nonzero exit.
+//
+// The reference shipped no sanitizer coverage (SURVEY.md §5 "Race
+// detection: none present"); this closes that gap for our native layer.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* mvq_create();
+void mvq_destroy(void*);
+void mvq_push(void*, uint64_t);
+int mvq_pop(void*, uint64_t*, long);
+void mvq_exit(void*);
+
+void* mvw_create(int);
+void mvw_destroy(void*);
+int mvw_wait(void*, long);
+void mvw_notify(void*);
+
+void* mva_create(long);
+void mva_destroy(void*);
+void* mva_alloc(void*, long);
+void mva_free(void*, void*, long);
+
+void* mvbuf_create(int64_t, int64_t);
+void mvbuf_destroy(void*);
+void mvbuf_add_dense(void*, const float*, float);
+void mvbuf_add_rows(void*, const int32_t*, int64_t, const float*, float);
+int64_t mvbuf_drain_dense(void*, float*);
+int64_t mvbuf_pending(void*);
+}
+
+int main() {
+  constexpr int kThreads = 8;
+  constexpr int kIters = 2000;
+
+  // Queue: producers + consumers.
+  {
+    void* q = mvq_create();
+    std::vector<std::thread> ts;
+    for (int t = 0; t < kThreads / 2; ++t)
+      ts.emplace_back([q] {
+        for (int i = 0; i < kIters; ++i) mvq_push(q, i);
+      });
+    long popped = 0;
+    std::vector<std::thread> cs;
+    std::vector<long> counts(kThreads / 2, 0);
+    for (int t = 0; t < kThreads / 2; ++t)
+      cs.emplace_back([q, &counts, t] {
+        uint64_t v;
+        while (mvq_pop(q, &v, 100)) ++counts[t];
+      });
+    for (auto& t : ts) t.join();
+    mvq_exit(q);
+    for (auto& t : cs) t.join();
+    for (long c : counts) popped += c;
+    if (popped != (kThreads / 2) * (long)kIters) {
+      fprintf(stderr, "queue lost items: %ld\n", popped);
+      return 1;
+    }
+    mvq_destroy(q);
+  }
+
+  // Waiter: notify from many threads.
+  {
+    void* w = mvw_create(kThreads * kIters);
+    std::vector<std::thread> ts;
+    for (int t = 0; t < kThreads; ++t)
+      ts.emplace_back([w] {
+        for (int i = 0; i < kIters; ++i) mvw_notify(w);
+      });
+    for (auto& t : ts) t.join();
+    if (!mvw_wait(w, 1000)) {
+      fprintf(stderr, "waiter never reached zero\n");
+      return 1;
+    }
+    mvw_destroy(w);
+  }
+
+  // Allocator: concurrent alloc/free cycles through the pools.
+  {
+    void* a = mva_create(64);
+    std::vector<std::thread> ts;
+    for (int t = 0; t < kThreads; ++t)
+      ts.emplace_back([a] {
+        for (int i = 0; i < kIters; ++i) {
+          long size = 64 + (i % 4) * 64;
+          void* p = mva_alloc(a, size);
+          memset(p, 0, size);
+          mva_free(a, p, size);
+        }
+      });
+    for (auto& t : ts) t.join();
+    mva_destroy(a);
+  }
+
+  // Delta buffer: dense + row accumulation racing a drainer.
+  {
+    constexpr int64_t kRows = 256, kCols = 64;
+    void* b = mvbuf_create(kRows, kCols);
+    std::vector<float> delta(kRows * kCols, 1.0f);
+    std::vector<float> row(kCols, 1.0f);
+    int32_t ids[2] = {3, 200};
+    std::vector<float> rows2(2 * kCols, 1.0f);
+    std::vector<std::thread> ts;
+    for (int t = 0; t < kThreads; ++t)
+      ts.emplace_back([&, t] {
+        for (int i = 0; i < kIters / 4; ++i) {
+          if (t % 2 == 0)
+            mvbuf_add_dense(b, delta.data(), 1.0f);
+          else
+            mvbuf_add_rows(b, ids, 2, rows2.data(), 1.0f);
+        }
+      });
+    std::vector<float> out(kRows * kCols);
+    int64_t drained = 0;
+    std::thread drainer([&] {
+      for (int i = 0; i < 50; ++i) drained += mvbuf_drain_dense(b, out.data());
+    });
+    for (auto& t : ts) t.join();
+    drainer.join();
+    drained += mvbuf_drain_dense(b, out.data());
+    if (drained != kThreads * (int64_t)(kIters / 4)) {
+      fprintf(stderr, "delta buffer lost adds: %lld\n",
+              (long long)drained);
+      return 1;
+    }
+    mvbuf_destroy(b);
+  }
+
+  printf("stress OK\n");
+  return 0;
+}

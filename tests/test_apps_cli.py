@@ -72,3 +72,20 @@ def test_logreg_cli(tmp_path):
                f"-lr_test_file={test}", f"-output_file={preds}"])
     assert rc == 0
     assert len(preds.read_text().strip().split("\n")) == 100
+
+
+def test_lda_cli(tmp_path, capsys):
+    from multiverso_tpu.apps.lda_main import main
+
+    rng = np.random.default_rng(0)
+    docs = tmp_path / "docs.txt"
+    with open(docs, "w") as f:
+        for i in range(60):
+            lo = 0 if i % 2 == 0 else 10
+            words = [f"w{rng.integers(lo, lo + 10)}" for _ in range(40)]
+            f.write(" ".join(words) + "\n")
+    rc = main([f"-docs_file={docs}", "-num_topics=2",
+               "-lda_iterations=20", "-topn=5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "topic   0:" in out and "topic   1:" in out
