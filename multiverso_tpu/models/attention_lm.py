@@ -1,0 +1,154 @@
+"""Long-context decoder-only LM trained with causal ring attention.
+
+The reference has no sequence dimension anywhere (SURVEY.md §5); this model
+is the framework's demonstration that long-context training is first-class:
+the sequence axis is sharded over the mesh's ``"seq"`` axis and attention
+runs as the ring program in ``parallel/sequence.py`` (K/V shards rotating
+over ICI, streaming-softmax merge, causal masking reconstructed from block
+indices), with data parallelism on the ``"data"`` axis. Memory per device is
+O(S/n) — the S x S score matrix never materializes, which is what makes
+sequence lengths beyond a single chip's HBM trainable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.parallel.sequence import ring_attention
+from multiverso_tpu.utils.log import check, log
+
+Params = Dict[str, jax.Array]
+
+
+@dataclasses.dataclass
+class LMConfig:
+    vocab: int = 256
+    dim: int = 64
+    heads: int = 4
+    layers: int = 2
+    seq: int = 128
+    learning_rate: float = 1e-3
+    data_parallel: Optional[int] = None   # None -> infer from devices
+    seq_parallel: Optional[int] = None
+    seed: int = 0
+
+
+def init_params(cfg: LMConfig, key: jax.Array) -> Params:
+    keys = jax.random.split(key, 2 + 4 * cfg.layers)
+    scale = cfg.dim ** -0.5
+    params: Params = {
+        "embed": jax.random.normal(keys[0], (cfg.vocab, cfg.dim)) * scale,
+        "out": jax.random.normal(keys[1], (cfg.dim, cfg.vocab)) * scale,
+    }
+    for i in range(cfg.layers):
+        k = keys[2 + 4 * i: 6 + 4 * i]
+        params[f"qkv_{i}"] = jax.random.normal(
+            k[0], (cfg.dim, 3 * cfg.dim)) * scale
+        params[f"attn_out_{i}"] = jax.random.normal(
+            k[1], (cfg.dim, cfg.dim)) * scale
+        params[f"mlp_in_{i}"] = jax.random.normal(
+            k[2], (cfg.dim, 4 * cfg.dim)) * scale
+        params[f"mlp_out_{i}"] = jax.random.normal(
+            k[3], (4 * cfg.dim, cfg.dim)) * scale
+    return params
+
+
+def _ln(x: jax.Array) -> jax.Array:
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6)
+
+
+def forward(params: Params, tokens: jax.Array, cfg: LMConfig,
+            mesh: Mesh) -> jax.Array:
+    """tokens [B, S] -> logits [B, S, vocab]. Positions enter via a fixed
+    sinusoidal table (content-independent, cheap, length-extrapolating)."""
+    B, S = tokens.shape
+    H, D = cfg.heads, cfg.dim
+    dh = D // H
+    x = jnp.take(params["embed"], tokens, axis=0)
+    pos = jnp.arange(S)[:, None] / (
+        10000.0 ** (jnp.arange(D)[None, :] / D))
+    x = x + jnp.where(jnp.arange(D)[None, :] % 2 == 0, jnp.sin(pos),
+                      jnp.cos(pos))[None, :, :]
+    for i in range(cfg.layers):
+        h = _ln(x)
+        qkv = h @ params[f"qkv_{i}"]                       # [B,S,3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, H, dh).transpose(0, 2, 1, 3)
+
+        o = ring_attention(heads(q), heads(k), heads(v), mesh,
+                           causal=True)                    # [B,H,S,dh]
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, D)
+        x = x + o @ params[f"attn_out_{i}"]
+        h = _ln(x)
+        x = x + jax.nn.gelu(h @ params[f"mlp_in_{i}"]) @ params[f"mlp_out_{i}"]
+    return _ln(x) @ params["out"]
+
+
+def next_token_loss(params: Params, tokens: jax.Array, cfg: LMConfig,
+                    mesh: Mesh) -> jax.Array:
+    logits = forward(params, tokens, cfg, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    # predict token[t+1] from position t; wrap-around position masked out
+    targets = jnp.roll(tokens, -1, axis=1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    S = tokens.shape[1]
+    valid = (jnp.arange(S) < S - 1).astype(picked.dtype)[None, :]
+    return -(picked * valid).sum() / valid.sum() / tokens.shape[0]
+
+
+class AttentionLM:
+    def __init__(self, cfg: LMConfig,
+                 devices: Optional[List[jax.Device]] = None):
+        import optax
+
+        check(cfg.dim % cfg.heads == 0, "dim must divide by heads")
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        sp = cfg.seq_parallel or min(n, 4)
+        dp = cfg.data_parallel or (n // sp)
+        check(dp * sp <= n, f"mesh {dp}x{sp} exceeds {n} devices")
+        check(cfg.seq % sp == 0, "seq must divide by seq_parallel")
+        self.cfg = cfg
+        self.mesh = Mesh(
+            np.asarray(devices[:dp * sp]).reshape(dp, sp), ("data", "seq"))
+        self.params = init_params(cfg, jax.random.PRNGKey(cfg.seed))
+        self._opt = optax.adam(cfg.learning_rate)
+        self._opt_state = self._opt.init(self.params)
+        self._token_sharding = NamedSharding(self.mesh, P("data", "seq"))
+
+        def train_step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(next_token_loss)(
+                params, tokens, cfg, self.mesh)
+            updates, opt_state = self._opt.update(grads, opt_state)
+            import optax as _optax
+            params = _optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    def fit(self, batches: Iterable[np.ndarray]) -> List[float]:
+        """batches of int tokens [B, S]; returns per-batch losses."""
+        losses = []
+        for tokens in batches:
+            tokens = jax.device_put(np.asarray(tokens, dtype=np.int32),
+                                    self._token_sharding)
+            self.params, self._opt_state, loss = self._train_step(
+                self.params, self._opt_state, tokens)
+            losses.append(loss)
+        return [float(l) for l in losses]
+
+    def loss(self, tokens: np.ndarray) -> float:
+        tokens = jax.device_put(np.asarray(tokens, dtype=np.int32),
+                                self._token_sharding)
+        return float(next_token_loss(self.params, tokens, self.cfg,
+                                     self.mesh))

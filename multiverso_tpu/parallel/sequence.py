@@ -29,10 +29,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 SEQ_AXIS = "seq"
 
 
-def _block_attn(q, k, v, scale):
+def _block_attn(q, k, v, scale, mask=None):
     """Scores for one (q-block, kv-block) pair plus streaming-softmax stats.
-    q: [B, H, Sq, D]; k, v: [B, H, Sk, D]."""
+    q: [B, H, Sq, D]; k, v: [B, H, Sk, D]; mask: [Sq, Sk] additive."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        s = s + mask[None, None, :, :]
     m = jnp.max(s, axis=-1, keepdims=True)                     # [B,H,Sq,1]
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)                     # [B,H,Sq,1]
@@ -41,21 +43,40 @@ def _block_attn(q, k, v, scale):
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                   axis: str = SEQ_AXIS) -> jax.Array:
+                   axis: str = SEQ_AXIS, causal: bool = False) -> jax.Array:
     """Attention over a sequence sharded across ``axis``.
 
     Inputs are [B, H, S, D] logically, sharded on S. Each of the n steps
     attends the local queries against the currently-held K/V shard, then
     rotates K/V one neighbor around the ring. Streaming-softmax merging
-    keeps exact softmax semantics.
+    keeps exact softmax semantics. With ``causal=True`` the global position
+    mask is reconstructed per ring step from the block indices (device i
+    holds K/V block ``(i + step) % n`` at step ``step``).
     """
     n = mesh.shape[axis]
     scale = 1.0 / np.sqrt(q.shape[-1])
 
     def local(q_blk, k_blk, v_blk):
-        def body(carry, _):
+        my = jax.lax.axis_index(axis)
+        Sq = q_blk.shape[2]
+
+        def body(carry, step):
             o_acc, m_acc, l_acc, k_cur, v_cur = carry
-            o, m, l = _block_attn(q_blk, k_cur, v_cur, scale)
+            if causal:
+                # ppermute sends i -> i+1, so after `step` rotations this
+                # device holds the K/V block that originated on device
+                # (my - step) mod n.
+                k_blk_idx = jnp.mod(my - step, n)
+                q_pos = my * Sq + jnp.arange(Sq)[:, None]
+                k_pos = k_blk_idx * Sq + jnp.arange(Sq)[None, :]
+                # Finite large-negative (not -inf): a fully-masked row
+                # would otherwise produce exp(-inf - -inf) = nan in the
+                # streaming softmax; -1e30 underflows cleanly and the
+                # merge's beta factor zeroes the block's contribution.
+                mask = jnp.where(k_pos > q_pos, -1e30, 0.0)
+            else:
+                mask = None
+            o, m, l = _block_attn(q_blk, k_cur, v_cur, scale, mask)
             m_new = jnp.maximum(m_acc, m)
             alpha = jnp.exp(m_acc - m_new)
             beta = jnp.exp(m - m_new)
@@ -74,7 +95,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                                        q_blk.dtype), axis),
                 jax.lax.pvary(jnp.zeros((B, H, Sq, 1), q_blk.dtype), axis),
                 k_blk, v_blk)
-        (o, _, l, _, _), _ = jax.lax.scan(body, init, None, length=n)
+        (o, _, l, _, _), _ = jax.lax.scan(body, init, jnp.arange(n))
         return o / jnp.maximum(l, 1e-20)
 
     spec = P(None, None, axis, None)

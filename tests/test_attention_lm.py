@@ -1,0 +1,46 @@
+"""Long-context LM over causal ring attention: learns a deterministic
+sequence on the (data x seq) mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from multiverso_tpu.models.attention_lm import AttentionLM, LMConfig
+
+
+def _cyclic_batches(n_batches, B=4, S=64, K=17, seed=0):
+    """Deterministic cyclic sequences: token[t+1] = (token[t]+1) mod K."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        starts = rng.integers(0, K, size=(B, 1))
+        out.append((starts + np.arange(S)[None, :]) % K)
+    return out
+
+
+def test_lm_learns_cyclic_sequence():
+    cfg = LMConfig(vocab=32, dim=32, heads=4, layers=2, seq=64,
+                   learning_rate=3e-3, seq_parallel=4, data_parallel=2)
+    lm = AttentionLM(cfg)
+    assert dict(zip(lm.mesh.axis_names, lm.mesh.devices.shape)) == \
+        {"data": 2, "seq": 4}
+    batches = _cyclic_batches(60)
+    initial = lm.loss(batches[0])
+    losses = lm.fit(batches)
+    final = lm.loss(batches[0])
+    assert np.isfinite(losses).all()
+    # the transition rule is deterministic: loss should collapse well below
+    # the uniform baseline (log 32 ~ 3.47) and far below the initial loss
+    assert final < initial * 0.5
+    assert final < 1.0, f"final loss {final:.3f} (initial {initial:.3f})"
+
+
+def test_lm_full_seq_parallel():
+    """All 8 devices on the seq axis (pure context parallelism)."""
+    cfg = LMConfig(vocab=16, dim=32, heads=4, layers=1, seq=64,
+                   seq_parallel=8, data_parallel=1, learning_rate=3e-3)
+    lm = AttentionLM(cfg)
+    losses = lm.fit(_cyclic_batches(20, B=2, K=11))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

@@ -65,3 +65,23 @@ def test_ring_attention_jits_under_mesh(seq_mesh):
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(reference_attention(q, k, v)),
                                rtol=2e-4, atol=2e-5)
+
+def reference_causal_attention(q, k, v):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    S = q.shape[2]
+    mask = jnp.where(jnp.arange(S)[None, :] > jnp.arange(S)[:, None],
+                     -1e30, 0.0)
+    s = s + mask[None, None]
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+def test_causal_ring_attention_matches_dense(seq_mesh):
+    q, k, v = _qkv()
+    spec = NamedSharding(seq_mesh, P(None, None, "seq", None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = ring_attention(qs, ks, vs, seq_mesh, causal=True)
+    expected = reference_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-4, atol=2e-5)
+    assert np.isfinite(np.asarray(out)).all()
