@@ -1,0 +1,76 @@
+"""GPipe-style pipeline: matches sequential stage application; trains."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from multiverso_tpu.parallel.pipeline import (pipeline_apply,
+                                              stage_sharding)
+
+
+@pytest.fixture
+def stage_mesh():
+    devices = jax.devices()[:4]
+    return Mesh(np.asarray(devices), ("stage",))
+
+
+def _stage_fn(params, x):
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+def _init_stages(S, D, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(S, D, D)).astype(np.float32) * 0.3
+    b = rng.normal(size=(S, 1, D)).astype(np.float32) * 0.1
+    return w, b
+
+
+def test_pipeline_matches_sequential(stage_mesh):
+    S, M, mb, D = 4, 6, 8, 16
+    w, b = _init_stages(S, D)
+    x = np.random.default_rng(1).normal(size=(M, mb, D)).astype(np.float32)
+    sh = stage_sharding(stage_mesh)
+    params = (jax.device_put(w, sh),
+              jax.device_put(b, jax.sharding.NamedSharding(
+                  stage_mesh, jax.sharding.PartitionSpec("stage", None,
+                                                         None))))
+    y = pipeline_apply(_stage_fn, params, jnp.asarray(x), stage_mesh)
+    # sequential reference
+    expected = x.copy()
+    for s in range(S):
+        expected = np.tanh(expected @ w[s] + b[s])
+    np.testing.assert_allclose(np.asarray(y), expected, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_pipeline_trains_under_grad(stage_mesh):
+    """jax.grad through the pipeline updates every stage's weights."""
+    S, M, mb, D = 4, 4, 4, 8
+    w, b = _init_stages(S, D, seed=2)
+    x = np.random.default_rng(3).normal(size=(M, mb, D)).astype(np.float32)
+    target = np.random.default_rng(4).normal(size=(M, mb, D)) \
+        .astype(np.float32)
+
+    def loss_fn(params):
+        y = pipeline_apply(_stage_fn, params, jnp.asarray(x), stage_mesh)
+        return ((y - target) ** 2).mean()
+
+    params = (jnp.asarray(w), jnp.asarray(b))
+    loss0 = float(loss_fn(params))
+
+    @jax.jit
+    def update(params):
+        grads = jax.grad(loss_fn)(params)
+        return jax.tree.map(lambda p, g: p - 0.3 * g, params, grads)
+
+    for _ in range(30):
+        params = update(params)
+    loss1 = float(loss_fn(params))
+    assert loss1 < loss0 * 0.9, (loss0, loss1)
+    # every stage's weights moved (the pipeline really trains all stages)
+    for s in range(S):
+        assert not np.allclose(np.asarray(params[0][s]), w[s])
