@@ -16,8 +16,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.utils.log import check
 
 STAGE_AXIS = "stage"
 
@@ -41,6 +42,13 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     M = microbatches.shape[0]
     T = M + S - 1
     perm = [(i, (i + 1) % S) for i in range(S)]
+    # Each leaf must carry exactly one row per stage: a larger multiple
+    # would shard multiple stages onto one device and `p[0]` would
+    # silently DROP all but the first (wrong-but-plausible outputs).
+    for leaf in jax.tree.leaves(stage_params):
+        check(leaf.shape[0] == S,
+              f"stage_params leading dim {leaf.shape[0]} != "
+              f"{S} pipeline stages on axis '{axis}'")
 
     def local(params_local, xs):
         sid = jax.lax.axis_index(axis)

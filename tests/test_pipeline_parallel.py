@@ -34,10 +34,7 @@ def test_pipeline_matches_sequential(stage_mesh):
     w, b = _init_stages(S, D)
     x = np.random.default_rng(1).normal(size=(M, mb, D)).astype(np.float32)
     sh = stage_sharding(stage_mesh)
-    params = (jax.device_put(w, sh),
-              jax.device_put(b, jax.sharding.NamedSharding(
-                  stage_mesh, jax.sharding.PartitionSpec("stage", None,
-                                                         None))))
+    params = (jax.device_put(w, sh), jax.device_put(b, sh))
     y = pipeline_apply(_stage_fn, params, jnp.asarray(x), stage_mesh)
     # sequential reference
     expected = x.copy()
@@ -74,3 +71,13 @@ def test_pipeline_trains_under_grad(stage_mesh):
     # every stage's weights moved (the pipeline really trains all stages)
     for s in range(S):
         assert not np.allclose(np.asarray(params[0][s]), w[s])
+
+
+def test_pipeline_rejects_mismatched_stage_count(stage_mesh):
+    """8 stage rows on a 4-stage mesh must error loudly, not drop stages."""
+    from multiverso_tpu.utils.log import FatalError
+    w, b = _init_stages(8, 8)
+    x = np.zeros((2, 4, 8), dtype=np.float32)
+    with pytest.raises(FatalError):
+        pipeline_apply(_stage_fn, (jnp.asarray(w), jnp.asarray(b)),
+                       jnp.asarray(x), stage_mesh)
