@@ -36,6 +36,8 @@ class LMConfig:
     learning_rate: float = 1e-3
     data_parallel: Optional[int] = None   # None -> infer from devices
     seq_parallel: Optional[int] = None
+    moe_experts: int = 0                  # >0: MoE MLP (expert parallelism)
+    moe_aux_weight: float = 0.01
     seed: int = 0
 
 
@@ -52,10 +54,18 @@ def init_params(cfg: LMConfig, key: jax.Array) -> Params:
             k[0], (cfg.dim, 3 * cfg.dim)) * scale
         params[f"attn_out_{i}"] = jax.random.normal(
             k[1], (cfg.dim, cfg.dim)) * scale
-        params[f"mlp_in_{i}"] = jax.random.normal(
-            k[2], (cfg.dim, 4 * cfg.dim)) * scale
-        params[f"mlp_out_{i}"] = jax.random.normal(
-            k[3], (4 * cfg.dim, cfg.dim)) * scale
+        if cfg.moe_experts > 0:
+            from multiverso_tpu.parallel.expert import init_moe
+
+            moe = init_moe(k[2], cfg.dim, 4 * cfg.dim, cfg.moe_experts)
+            params[f"moe_router_{i}"] = moe.router
+            params[f"moe_w1_{i}"] = moe.w1
+            params[f"moe_w2_{i}"] = moe.w2
+        else:
+            params[f"mlp_in_{i}"] = jax.random.normal(
+                k[2], (cfg.dim, 4 * cfg.dim)) * scale
+            params[f"mlp_out_{i}"] = jax.random.normal(
+                k[3], (4 * cfg.dim, cfg.dim)) * scale
     return params
 
 
@@ -66,9 +76,9 @@ def _ln(x: jax.Array) -> jax.Array:
 
 
 def forward(params: Params, tokens: jax.Array, cfg: LMConfig,
-            mesh: Mesh) -> jax.Array:
-    """tokens [B, S] -> logits [B, S, vocab]. Positions enter via a fixed
-    sinusoidal table (content-independent, cheap, length-extrapolating)."""
+            mesh: Mesh) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, S] -> (logits [B, S, vocab], moe aux loss). Positions
+    enter via a fixed sinusoidal table."""
     B, S = tokens.shape
     H, D = cfg.heads, cfg.dim
     dh = D // H
@@ -77,6 +87,7 @@ def forward(params: Params, tokens: jax.Array, cfg: LMConfig,
         10000.0 ** (jnp.arange(D)[None, :] / D))
     x = x + jnp.where(jnp.arange(D)[None, :] % 2 == 0, jnp.sin(pos),
                       jnp.cos(pos))[None, :, :]
+    aux_total = jnp.float32(0.0)
     for i in range(cfg.layers):
         h = _ln(x)
         qkv = h @ params[f"qkv_{i}"]                       # [B,S,3D]
@@ -90,20 +101,31 @@ def forward(params: Params, tokens: jax.Array, cfg: LMConfig,
         o = o.transpose(0, 2, 1, 3).reshape(B, S, D)
         x = x + o @ params[f"attn_out_{i}"]
         h = _ln(x)
-        x = x + jax.nn.gelu(h @ params[f"mlp_in_{i}"]) @ params[f"mlp_out_{i}"]
-    return _ln(x) @ params["out"]
+        if cfg.moe_experts > 0:
+            from multiverso_tpu.parallel.expert import MoEParams, top1_moe
+
+            moe = MoEParams(params[f"moe_router_{i}"],
+                            params[f"moe_w1_{i}"], params[f"moe_w2_{i}"])
+            y, aux = top1_moe(moe, h)
+            x = x + y
+            aux_total = aux_total + aux
+        else:
+            x = x + jax.nn.gelu(h @ params[f"mlp_in_{i}"]) \
+                @ params[f"mlp_out_{i}"]
+    return _ln(x) @ params["out"], aux_total
 
 
 def next_token_loss(params: Params, tokens: jax.Array, cfg: LMConfig,
                     mesh: Mesh) -> jax.Array:
-    logits = forward(params, tokens, cfg, mesh)
+    logits, aux = forward(params, tokens, cfg, mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     # predict token[t+1] from position t; wrap-around position masked out
     targets = jnp.roll(tokens, -1, axis=1)
     picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     S = tokens.shape[1]
     valid = (jnp.arange(S) < S - 1).astype(picked.dtype)[None, :]
-    return -(picked * valid).sum() / valid.sum() / tokens.shape[0]
+    xent = -(picked * valid).sum() / valid.sum() / tokens.shape[0]
+    return xent + cfg.moe_aux_weight * aux
 
 
 class AttentionLM:

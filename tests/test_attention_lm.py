@@ -44,3 +44,17 @@ def test_lm_full_seq_parallel():
     losses = lm.fit(_cyclic_batches(20, B=2, K=11))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_moe_lm_trains():
+    """MoE MLP (expert parallelism inside the LM) still learns the cycle."""
+    cfg = LMConfig(vocab=16, dim=32, heads=4, layers=1, seq=32,
+                   seq_parallel=2, data_parallel=2, moe_experts=4,
+                   learning_rate=3e-3)
+    lm = AttentionLM(cfg)
+    batches = _cyclic_batches(40, B=4, S=32, K=11)
+    initial = lm.loss(batches[0])
+    lm.fit(batches)
+    final = lm.loss(batches[0])
+    assert np.isfinite(final)
+    assert final < initial * 0.6, (initial, final)
