@@ -50,23 +50,29 @@ def bench_word2vec() -> float:
     sentences = [rng.choice(vocab_size, size=sent_len, p=zipf)
                  .astype(np.int32) for _ in range(n_sent)]
 
-    cfg = Word2VecConfig(embedding_size=128, window=5, negative=5,
-                         batch_size=8192, sample=1e-3, sg=True, hs=False,
-                         optimizer="adagrad", epochs=1, pipeline=True,
-                         device_pipeline=True, block_sentences=512,
-                         pad_sentence_length=512, seed=0)
-    w2v = Word2Vec(cfg, d)
+    def run(param_dtype: str) -> float:
+        cfg = Word2VecConfig(embedding_size=128, window=5, negative=5,
+                             batch_size=8192, sample=1e-3, sg=True,
+                             hs=False, optimizer="adagrad", epochs=1,
+                             pipeline=True, device_pipeline=True,
+                             block_sentences=512, pad_sentence_length=512,
+                             param_dtype=param_dtype, seed=0)
+        w2v = Word2Vec(cfg, d)
+        # Warm-up compiles the step outside the timer.
+        w2v.train(sentences=sentences[:4])
+        w2v.trained_words = 0
+        stats = w2v.train(sentences=sentences)
+        _log(f"word2vec[{param_dtype}]: {stats['words']} words in "
+             f"{stats['seconds']:.2f}s -> {stats['words_per_sec']:.0f} "
+             f"words/sec (loss {stats['loss']:.4f})")
+        return stats["words_per_sec"]
 
-    # Warm-up: compile the step (first TPU compile is slow) outside timing.
-    warm = sentences[:4]
-    w2v.train(sentences=warm)
-    w2v.trained_words = 0
-
-    stats = w2v.train(sentences=sentences)
-    _log(f"word2vec: {stats['words']} words in {stats['seconds']:.2f}s "
-         f"-> {stats['words_per_sec']:.0f} words/sec "
-         f"(loss {stats['loss']:.4f})")
-    return stats["words_per_sec"]
+    headline = run("float32")
+    try:
+        run("bfloat16")     # secondary: stderr only
+    except Exception as e:  # noqa: BLE001 - comparison is best-effort
+        _log(f"bf16 comparison skipped: {e}")
+    return headline
 
 
 def bench_matrix_table() -> float:
