@@ -106,7 +106,11 @@ def create_table(option: TableOption):
         table = (SparseMatrixTable(option) if option.is_sparse
                  else MatrixTable(option))
     elif isinstance(option, KVTableOption):
-        table = KVTable(option)
+        if option.device:
+            from multiverso_tpu.tables.device_kv_table import DeviceKVTable
+            table = DeviceKVTable(option, value_dim=option.value_dim)
+        else:
+            table = KVTable(option)
     else:
         raise TypeError(f"unknown table option {type(option).__name__}")
     barrier()  # ref multiverso.h:40: creation is followed by a barrier

@@ -98,12 +98,20 @@ class MatrixTableOption(TableOption):
 
 @dataclasses.dataclass
 class KVTableOption(TableOption):
-    """Distributed key->value map (ref include/multiverso/table/kv_table.h)."""
+    """Distributed key->value map (ref include/multiverso/table/kv_table.h).
+
+    ``device=True`` selects the HBM-slab variant (host key directory over
+    device-resident values; supports ``value_dim`` vectors and updaters).
+    """
     value_dtype: Any = np.float32
-    capacity: int = 1 << 16         # device hash-table capacity (power of two)
+    capacity: int = 1 << 16         # slot capacity (device variant)
+    device: bool = False
+    value_dim: int = 1
 
     def __init__(self, value_dtype: Any = np.float32, capacity: int = 1 << 16,
-                 **kw: Any):
+                 device: bool = False, value_dim: int = 1, **kw: Any):
         super().__init__(**kw)
         self.value_dtype = value_dtype
         self.capacity = int(capacity)
+        self.device = bool(device)
+        self.value_dim = int(value_dim)

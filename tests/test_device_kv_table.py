@@ -63,3 +63,11 @@ def test_checkpoint_roundtrip(mv_env):
     ckpt.load_table(t, uri)
     np.testing.assert_allclose(t.get([100, 200, 300]), [1.0, 2.0, 0.0])
     assert len(t) == 2
+
+
+def test_factory_routes_device_flag(mv_env):
+    t = mv.create_table(KVTableOption(device=True, capacity=16,
+                                      value_dim=4))
+    assert isinstance(t, DeviceKVTable)
+    t.add([3], np.ones((1, 4), dtype=np.float32))
+    np.testing.assert_allclose(t.get([3]), np.ones((1, 4)))
