@@ -1,0 +1,112 @@
+"""Small CNN trained with ASGD parameter-manager sync — the binding
+benchmark workload.
+
+The reference's published headline numbers are ResNet/CIFAR-10 trained by N
+processes syncing through `MVModelParamManager` every few batches
+(``binding/python/docs/BENCHMARK.md``, BASELINE.md rows). This module
+reproduces that workload shape TPU-first: a jitted convnet step (convs on
+the MXU) per worker, with workers syncing their pytree of parameters
+through ONE ArrayTable via :class:`PyTreeParamManager` — push the local
+delta, pull the merged global model (the theano_ext ``mv_sync`` cycle).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.binding.param_manager import PyTreeParamManager
+from multiverso_tpu.utils.log import log
+
+Params = Dict[str, jax.Array]
+
+
+@dataclasses.dataclass
+class ConvNetConfig:
+    image_size: int = 16
+    channels: int = 1
+    num_classes: int = 2
+    widths: Tuple[int, ...] = (16, 32)
+    dense: int = 64
+    learning_rate: float = 0.05
+    seed: int = 0
+
+
+def init_params(cfg: ConvNetConfig, key: jax.Array) -> Params:
+    keys = jax.random.split(key, len(cfg.widths) + 2)
+    params: Params = {}
+    cin = cfg.channels
+    for i, w in enumerate(cfg.widths):
+        params[f"conv_{i}"] = jax.random.normal(
+            keys[i], (3, 3, cin, w)) * np.sqrt(2.0 / (9 * cin))
+        cin = w
+    spatial = cfg.image_size // (2 ** len(cfg.widths))
+    flat = spatial * spatial * cin
+    params["dense"] = jax.random.normal(
+        keys[-2], (flat, cfg.dense)) * np.sqrt(2.0 / flat)
+    params["out"] = jax.random.normal(
+        keys[-1], (cfg.dense, cfg.num_classes)) * np.sqrt(2.0 / cfg.dense)
+    return params
+
+
+def forward(params: Params, x: jax.Array, cfg: ConvNetConfig) -> jax.Array:
+    """x [B, H, W, C] -> logits [B, classes]."""
+    for i in range(len(cfg.widths)):
+        x = jax.lax.conv_general_dilated(
+            x, params[f"conv_{i}"], window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x)
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense"])
+    return x @ params["out"]
+
+
+def make_sgd_step(cfg: ConvNetConfig):
+    def loss_fn(params, x, y):
+        logits = forward(params, x, cfg)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        params = jax.tree.map(lambda p, g: p - cfg.learning_rate * g,
+                              params, grads)
+        return params, loss
+
+    return jax.jit(step, donate_argnums=0), jax.jit(
+        lambda params, x: forward(params, x, cfg).argmax(-1))
+
+
+class ASGDConvNetWorker:
+    """One worker: local jitted steps + periodic param-manager sync
+    (``MVCallback`` semantics: sync every ``sync_freq`` batches)."""
+
+    def __init__(self, cfg: ConvNetConfig, manager: PyTreeParamManager,
+                 sync_freq: int = 4):
+        self.cfg = cfg
+        self.manager = manager
+        self.sync_freq = max(1, sync_freq)
+        self.params = manager.get()
+        self._step, self._predict = make_sgd_step(cfg)
+
+    def train(self, batches: Iterable[Tuple[np.ndarray, np.ndarray]]
+              ) -> List[float]:
+        losses = []
+        for i, (x, y) in enumerate(batches):
+            self.params, loss = self._step(
+                self.params, jnp.asarray(x), jnp.asarray(y, dtype=jnp.int32))
+            losses.append(float(loss))
+            if (i + 1) % self.sync_freq == 0:
+                self.params = self.manager.sync(self.params)
+        self.params = self.manager.sync(self.params)
+        return losses
+
+    def accuracy(self, x: np.ndarray, y: np.ndarray) -> float:
+        pred = np.asarray(self._predict(self.params, jnp.asarray(x)))
+        return float((pred == y).mean())

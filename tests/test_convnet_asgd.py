@@ -1,0 +1,68 @@
+"""CNN + ASGD param-manager sync — the binding benchmark workload shape
+(ResNet/CIFAR ASGD in the reference's BENCHMARK.md, miniaturized)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import multiverso_tpu as mv
+from multiverso_tpu.binding.param_manager import PyTreeParamManager
+from multiverso_tpu.models.convnet import (ASGDConvNetWorker, ConvNetConfig,
+                                           init_params)
+from multiverso_tpu.parallel.async_engine import WorkerPool
+
+
+def _striped_images(n, size=16, seed=0):
+    """Class 0: horizontal stripes; class 1: vertical stripes (+noise)."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, size=n)
+    x = np.zeros((n, size, size, 1), dtype=np.float32)
+    phase = rng.integers(0, 4, size=n)
+    for i in range(n):
+        stripes = ((np.arange(size) + phase[i]) // 2) % 2
+        img = np.tile(stripes[:, None] if y[i] == 0 else stripes[None, :],
+                      (1, size) if y[i] == 0 else (size, 1))
+        x[i, :, :, 0] = img + rng.normal(0, 0.3, size=(size, size))
+    return x, y.astype(np.int64)
+
+
+def test_single_worker_learns(mv_env):
+    cfg = ConvNetConfig(seed=1)
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    manager = PyTreeParamManager(params, name="cnn1")
+    worker = ASGDConvNetWorker(cfg, manager, sync_freq=4)
+    x, y = _striped_images(512)
+    batches = [(x[i:i + 64], y[i:i + 64]) for i in range(0, 512, 64)]
+    for _ in range(6):
+        worker.train(batches)
+    xt, yt = _striped_images(256, seed=9)
+    acc = worker.accuracy(xt, yt)
+    assert acc > 0.9, acc
+
+
+def test_multi_worker_asgd_converges(mv_env):
+    """Four ASGD workers on disjoint shards, syncing through one table,
+    converge to one good shared model (the 8-proc x 1-GPU benchmark shape)."""
+    cfg = ConvNetConfig(seed=2, learning_rate=0.03)
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    manager = PyTreeParamManager(params, name="cnn4")
+    n_workers = 4
+    x, y = _striped_images(1024, seed=3)
+    shards = [(x[w::n_workers], y[w::n_workers]) for w in range(n_workers)]
+    workers = [ASGDConvNetWorker(cfg, manager, sync_freq=2)
+               for _ in range(n_workers)]
+
+    def run(wid):
+        xs, ys = shards[wid]
+        batches = [(xs[i:i + 32], ys[i:i + 32])
+                   for i in range(0, len(xs), 32)]
+        for _ in range(6):
+            workers[wid].train(batches)
+
+    WorkerPool(n_workers).run(run)
+    # the GLOBAL model (fresh pull) must be good — not just a local replica
+    probe = ASGDConvNetWorker(cfg, manager)
+    xt, yt = _striped_images(256, seed=11)
+    acc = probe.accuracy(xt, yt)
+    assert acc > 0.9, acc
