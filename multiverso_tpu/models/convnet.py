@@ -19,8 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from multiverso_tpu.binding.param_manager import PyTreeParamManager
-from multiverso_tpu.utils.log import log
+from multiverso_tpu.binding.param_manager import (PyTreeParamManager,
+                                                  SyncCallback)
 
 Params = Dict[str, jax.Array]
 
@@ -91,20 +91,21 @@ class ASGDConvNetWorker:
                  sync_freq: int = 4):
         self.cfg = cfg
         self.manager = manager
-        self.sync_freq = max(1, sync_freq)
+        self._callback = SyncCallback(manager, freq=sync_freq)
         self.params = manager.get()
         self._step, self._predict = make_sgd_step(cfg)
 
     def train(self, batches: Iterable[Tuple[np.ndarray, np.ndarray]]
               ) -> List[float]:
         losses = []
-        for i, (x, y) in enumerate(batches):
+        for x, y in batches:
             self.params, loss = self._step(
                 self.params, jnp.asarray(x), jnp.asarray(y, dtype=jnp.int32))
             losses.append(float(loss))
-            if (i + 1) % self.sync_freq == 0:
-                self.params = self.manager.sync(self.params)
-        self.params = self.manager.sync(self.params)
+            merged = self._callback.on_batch_end(self.params)
+            if merged is not None:
+                self.params = merged
+        self.params = self.manager.sync(self.params)   # epoch boundary
         return losses
 
     def accuracy(self, x: np.ndarray, y: np.ndarray) -> float:

@@ -21,8 +21,9 @@ def _striped_images(n, size=16, seed=0):
     phase = rng.integers(0, 4, size=n)
     for i in range(n):
         stripes = ((np.arange(size) + phase[i]) // 2) % 2
-        img = np.tile(stripes[:, None] if y[i] == 0 else stripes[None, :],
-                      (1, size) if y[i] == 0 else (size, 1))
+        img = np.tile(stripes[:, None], (1, size))   # horizontal stripes
+        if y[i] == 1:
+            img = img.T                              # vertical stripes
         x[i, :, :, 0] = img + rng.normal(0, 0.3, size=(size, size))
     return x, y.astype(np.int64)
 
