@@ -9,10 +9,11 @@ regression). See SURVEY.md for the structural map of the reference this
 framework re-implements TPU-first.
 """
 
-from multiverso_tpu.api import (aggregate, barrier, create_table, get_flag,
-                                init, is_master_worker, num_servers,
-                                num_workers, rank, server_id, set_flag,
-                                shutdown, size, worker_id)
+from multiverso_tpu.api import (aggregate, barrier, create_table,
+                                finish_train, get_flag, init,
+                                is_master_worker, num_servers, num_workers,
+                                rank, server_id, set_flag, shutdown, size,
+                                worker_id)
 from multiverso_tpu.core.options import (AddOption, ArrayTableOption,
                                          GetOption, KVTableOption,
                                          MatrixTableOption)
@@ -22,7 +23,7 @@ __version__ = "0.1.0"
 __all__ = [
     "init", "shutdown", "barrier", "rank", "size", "num_workers",
     "num_servers", "worker_id", "server_id", "is_master_worker",
-    "set_flag", "get_flag", "create_table", "aggregate",
+    "set_flag", "get_flag", "create_table", "aggregate", "finish_train",
     "AddOption", "GetOption", "ArrayTableOption", "MatrixTableOption",
     "KVTableOption",
 ]

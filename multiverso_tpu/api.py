@@ -120,3 +120,15 @@ def create_table(option: TableOption):
 def aggregate(data):
     """``MV_Aggregate`` analog: allreduce-SUM across processes."""
     return collectives.aggregate(data)
+
+
+def finish_train(worker_id: Optional[int] = None) -> None:
+    """``Zoo::FinishTrain`` analog (ref src/zoo.cpp:152-161): release this
+    worker from every table's BSP clocks so stragglers can drain to
+    shutdown."""
+    zoo = Zoo.get()
+    wid = worker_id if worker_id is not None else max(zoo.worker_id(), 0)
+    for table in zoo.tables:
+        ft = getattr(table, "finish_train", None)
+        if ft is not None:
+            ft(wid)

@@ -123,3 +123,48 @@ def test_device_reduce_scatter(mv_env):
     out = device_reduce_scatter(x, mesh)
     # every element reduced over n contributors
     np.testing.assert_allclose(np.asarray(out), np.full((n * 2, 3), n))
+
+
+def test_mesh_shape_flag():
+    """-mesh_shape builds a named multi-axis mesh."""
+    mv.init(["-mesh_shape=server:4,worker:2"])
+    try:
+        from multiverso_tpu.core.zoo import Zoo
+        mesh = Zoo.get().mesh
+        assert dict(mesh.shape) == {"server": 4, "worker": 2}
+        assert mv.num_servers() == 4
+        t = mv.create_table(mv.ArrayTableOption(size=80))
+        t.add(np.ones(80, dtype=np.float32))
+        np.testing.assert_allclose(t.get(), np.ones(80))
+    finally:
+        mv.shutdown()
+
+
+def test_finish_train_api():
+    """mv.finish_train releases the calling worker from all BSP tables."""
+    import threading
+    from multiverso_tpu.core.options import AddOption, GetOption
+
+    mv.init(["-sync=true"], num_local_workers=2)
+    try:
+        t = mv.create_table(mv.ArrayTableOption(size=4))
+        d = np.ones(4, dtype=np.float32)
+
+        def short():
+            t.add(d, AddOption(worker_id=0))
+            t.get(GetOption(worker_id=0))
+            mv.finish_train(0)
+
+        def long():
+            for _ in range(3):
+                t.add(d, AddOption(worker_id=1))
+                t.get(GetOption(worker_id=1))
+
+        th = [threading.Thread(target=short), threading.Thread(target=long)]
+        for x in th:
+            x.start()
+        for x in th:
+            x.join(timeout=30)
+            assert not x.is_alive()
+    finally:
+        mv.shutdown()
