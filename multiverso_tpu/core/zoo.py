@@ -99,6 +99,18 @@ class Zoo:
         self.ma_mode = configure.get_flag("ma")
         self.sync_mode = configure.get_flag("sync")
         self._num_local_workers = max(1, int(num_local_workers))
+        # Machine-file mode (the reference's ZMQ deployment,
+        # zmq_net.h:25-61): derive rank/world from this host's position in
+        # the file; rank 0's entry hosts the coordination service.
+        machine_file = configure.get_flag("machine_file")
+        if machine_file and not configure.get_flag("coordinator"):
+            from multiverso_tpu.utils.net_util import rank_from_machine_file
+
+            my_rank, world, peers = rank_from_machine_file(machine_file)
+            configure.set_flag("rank", my_rank)
+            configure.set_flag("world_size", world)
+            configure.set_flag("coordinator",
+                               f"{peers[0][0]}:{peers[0][1]}")
         # Multi-controller bring-up: the RegisterNode/Controller handshake
         # (ref src/controller.cpp:38-80) maps to jax.distributed's
         # coordination service — rank 0 hosts it, everyone registers.

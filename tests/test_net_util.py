@@ -1,0 +1,36 @@
+"""net_util machine-file rank identification (ref src/util/net_util.cpp,
+zmq_net.h machine-file mode)."""
+
+import pytest
+
+from multiverso_tpu.utils.net_util import (get_local_ips, parse_machine_file,
+                                           rank_from_machine_file)
+
+
+def test_local_ips_include_loopback():
+    ips = get_local_ips()
+    assert "127.0.0.1" in ips
+
+
+def test_parse_machine_file(tmp_path):
+    p = tmp_path / "machines"
+    p.write_text("# cluster\n10.0.0.1:6000\n10.0.0.2\n\n10.0.0.3:7000\n")
+    peers = parse_machine_file(str(p))
+    assert peers[0] == ("10.0.0.1", 6000)
+    assert peers[1] == ("10.0.0.2", 55555)   # -port flag default
+    assert peers[2] == ("10.0.0.3", 7000)
+
+
+def test_rank_from_machine_file(tmp_path):
+    p = tmp_path / "machines"
+    p.write_text("10.9.9.9\n127.0.0.1:6001\n10.8.8.8\n")
+    rank, world, peers = rank_from_machine_file(str(p))
+    assert rank == 1 and world == 3
+    assert peers[1] == ("127.0.0.1", 6001)
+
+
+def test_rank_not_found_raises(tmp_path):
+    p = tmp_path / "machines"
+    p.write_text("10.1.1.1\n10.2.2.2\n")
+    with pytest.raises(LookupError):
+        rank_from_machine_file(str(p), local_ips=["192.168.0.5"])
