@@ -10,7 +10,9 @@ framework re-implements TPU-first.
 """
 
 from multiverso_tpu.api import (aggregate, barrier, create_table,
-                                finish_train, get_flag, init,
+                                create_distributed_array_table,
+                                finish_train, get_flag, init, net_bind,
+                                net_connect,
                                 is_master_worker, num_servers, num_workers,
                                 rank, server_id, set_flag, shutdown, size,
                                 worker_id)
@@ -24,6 +26,7 @@ __all__ = [
     "init", "shutdown", "barrier", "rank", "size", "num_workers",
     "num_servers", "worker_id", "server_id", "is_master_worker",
     "set_flag", "get_flag", "create_table", "aggregate", "finish_train",
+    "net_bind", "net_connect", "create_distributed_array_table",
     "AddOption", "GetOption", "ArrayTableOption", "MatrixTableOption",
     "KVTableOption",
 ]

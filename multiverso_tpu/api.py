@@ -122,6 +122,44 @@ def aggregate(data):
     return collectives.aggregate(data)
 
 
+def net_bind(host: str = "127.0.0.1", port: int = 0):
+    """``MV_NetBind`` analog (ref src/multiverso.cpp:58-62): start this
+    process's PS service listener; returns (host, port)."""
+    from multiverso_tpu.parallel.ps_service import PSService
+
+    zoo = Zoo.get()
+    check(zoo.started, "call mv.init() first")
+    check(zoo.ps_service is None, "service already bound")
+    zoo.ps_service = PSService(host, port)
+    return zoo.ps_service.address
+
+
+def net_connect(peers) -> None:
+    """``MV_NetConnect`` analog (ref src/multiverso.cpp:64-68): record the
+    full peer list ((host, port) per rank, this process's own entry
+    included) used by distributed tables."""
+    zoo = Zoo.get()
+    check(zoo.started, "call mv.init() first")
+    zoo.ps_peers = [tuple(p) for p in peers]
+
+
+def create_distributed_array_table(table_id: int, size: int, rank: int,
+                                   dtype=None, updater: str = "default"):
+    """Distributed (process-sharded) array table over the bound service +
+    connected peers."""
+    import numpy as _np
+
+    from multiverso_tpu.parallel.ps_service import DistributedArrayTable
+
+    zoo = Zoo.get()
+    check(zoo.ps_service is not None, "call mv.net_bind() first")
+    check(len(zoo.ps_peers) > 0, "call mv.net_connect() first")
+    return DistributedArrayTable(table_id, size, zoo.ps_service,
+                                 list(zoo.ps_peers), rank,
+                                 dtype=dtype or _np.float32,
+                                 updater=updater)
+
+
 def finish_train(worker_id: Optional[int] = None) -> None:
     """``Zoo::FinishTrain`` analog (ref src/zoo.cpp:152-161): release this
     worker from every table's BSP clocks so stragglers can drain to

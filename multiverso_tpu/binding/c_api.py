@@ -61,6 +61,14 @@ def MV_ServerId() -> int:
     return mv.server_id()
 
 
+def MV_NetBind(host: str = "127.0.0.1", port: int = 0):
+    return mv.net_bind(host, port)
+
+
+def MV_NetConnect(peers) -> None:
+    mv.net_connect(peers)
+
+
 # -- array tables (ref c_api.h:26-38) ---------------------------------------
 def MV_NewArrayTable(size: int, init_value: Optional[np.ndarray] = None
                      ) -> int:

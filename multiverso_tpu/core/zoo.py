@@ -75,6 +75,9 @@ class Zoo:
         self.tables: List[Any] = []
         self._barrier_count = 0
         self._num_local_workers = 1
+        # Explicit net bind/connect state (MV_NetBind/MV_NetConnect parity)
+        self.ps_service: Optional[Any] = None
+        self.ps_peers: List[Any] = []
 
     # -- singleton ---------------------------------------------------------
     @classmethod
@@ -139,6 +142,10 @@ class Zoo:
             if close:
                 close()
         self.tables.clear()
+        if self.ps_service is not None:
+            self.ps_service.close()
+            self.ps_service = None
+        self.ps_peers = []
         self.mesh = None
         self.started = False
 

@@ -228,3 +228,19 @@ def test_elastic_rank_restart_and_readmission(mv_env):
     t0.add(np.ones(40, dtype=np.float32))
     assert t0.get()[39] == pytest.approx(40.0)
     svc0.close(); svc1b.close()
+
+
+def test_net_bind_connect_api():
+    """MV_NetBind/MV_NetConnect parity surface over the PS service."""
+    import multiverso_tpu as mv2
+
+    mv2.init([])
+    try:
+        addr = mv2.net_bind()
+        assert addr[1] > 0
+        mv2.net_connect([addr])
+        t = mv2.create_distributed_array_table(77, 16, rank=0)
+        t.add(np.ones(16, dtype=np.float32))
+        np.testing.assert_allclose(t.get(), np.ones(16))
+    finally:
+        mv2.shutdown()
