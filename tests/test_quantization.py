@@ -76,3 +76,13 @@ def test_dcasgd_compensates_stale_worker():
         np.testing.assert_allclose(t.get(), [expected], rtol=1e-6)
     finally:
         mv.shutdown()
+
+
+def test_one_bit_partial_byte():
+    """Sizes not divisible by 8 decode exactly size elements."""
+    f = OneBitsFilter(size=13)
+    v = np.linspace(-1, 1, 13).astype(np.float32)
+    bits, ps, ns = f.encode(v)
+    out = OneBitsFilter.decode(bits, ps, ns, 13)
+    assert out.shape == (13,)
+    assert set(np.unique(out)).issubset({np.float32(ps), np.float32(ns)})

@@ -55,3 +55,34 @@ def test_display_contains_all():
     Dashboard.get("b").add(2.0)
     report = Dashboard.display()
     assert "[a]" in report and "[b]" in report
+
+
+def test_log_file_sink(tmp_path):
+    path = str(tmp_path / "mv.log")
+    log.set_log_file(path)
+    try:
+        log.info("sink check %d", 42)
+    finally:
+        log.set_log_file(None)
+    content = open(path).read()
+    assert "sink check 42" in content and "[INFO]" in content
+
+
+def test_log_levels_filter(capsys):
+    from multiverso_tpu.utils.log import LogLevel
+    log.set_level(LogLevel.ERROR)
+    try:
+        log.info("hidden message")
+        log.error("shown message")
+    finally:
+        log.set_level(LogLevel.INFO)
+    out = capsys.readouterr()
+    assert "hidden message" not in out.out
+    assert "shown message" in out.err
+
+
+def test_profiler_annotate_smoke():
+    from multiverso_tpu.utils.profiler import annotate
+    with annotate("annotated_region"):
+        pass
+    assert Dashboard.get("annotated_region").count == 1
