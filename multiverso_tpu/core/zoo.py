@@ -142,6 +142,8 @@ class Zoo:
             if close:
                 close()
         self.tables.clear()
+        from multiverso_tpu.core.actor import stop_all_actors
+        stop_all_actors()
         if self.ps_service is not None:
             self.ps_service.close()
             self.ps_service = None

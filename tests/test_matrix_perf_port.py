@@ -1,0 +1,33 @@
+"""Functional port of the reference perf harness
+(``Test/test_matrix_perf.cpp:32-80``): Get-all -> Add at 10%..100% row
+coverage -> Get-all sweeps, with exact-value verification at every coverage
+level (shrunk matrix; the timing version lives in bench.py)."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.utils.timer import Timer
+
+
+@pytest.mark.parametrize("coverage", [0.1, 0.5, 1.0])
+def test_get_add_get_sweep(mv_env, coverage):
+    num_row, num_col = 10_000, 50
+    table = mv.create_table(mv.MatrixTableOption(num_row, num_col))
+    model = np.zeros((num_row, num_col), dtype=np.float32)
+    rng = np.random.default_rng(int(coverage * 10))
+
+    timer = Timer()
+    # Get-all (cold)
+    np.testing.assert_allclose(table.get(), model)
+    # Add at this row coverage
+    n_rows = int(num_row * coverage)
+    rows = rng.choice(num_row, size=n_rows, replace=False)
+    deltas = rng.normal(size=(n_rows, num_col)).astype(np.float32)
+    table.add_rows(rows, deltas)
+    model[rows] += deltas
+    # Get the touched rows and the whole table
+    np.testing.assert_allclose(table.get_rows(rows[:100]),
+                               model[rows[:100]], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(table.get(), model, rtol=1e-5, atol=1e-5)
+    assert timer.elapse() > 0   # harness plumbing (timing lives in bench.py)
