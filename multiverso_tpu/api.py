@@ -3,9 +3,10 @@
 Parity with ``include/multiverso/multiverso.h:9-65``: init/shutdown/barrier,
 rank/size/worker/server queries, flag override, table creation (the
 ``table_factory`` dispatch, ref ``include/multiverso/table_factory.h:16-26``),
-and allreduce aggregate. TPU-native: ``init`` stands in for
-``jax.distributed``-based bring-up; there is no explicit net bind/connect —
-device discovery is the runtime's job.
+and allreduce aggregate. TPU-native: ``init`` wraps ``jax.distributed``
+bring-up; explicit ``net_bind``/``net_connect`` (the reference's
+``MV_NetBind``/``MV_NetConnect``, src/multiverso.cpp:58-68) expose the host
+PS service for externally-orchestrated clusters.
 """
 
 from __future__ import annotations
@@ -154,10 +155,12 @@ def create_distributed_array_table(table_id: int, size: int, rank: int,
     zoo = Zoo.get()
     check(zoo.ps_service is not None, "call mv.net_bind() first")
     check(len(zoo.ps_peers) > 0, "call mv.net_connect() first")
-    return DistributedArrayTable(table_id, size, zoo.ps_service,
-                                 list(zoo.ps_peers), rank,
-                                 dtype=dtype or _np.float32,
-                                 updater=updater)
+    table = DistributedArrayTable(table_id, size, zoo.ps_service,
+                                  list(zoo.ps_peers), rank,
+                                  dtype=dtype or _np.float32,
+                                  updater=updater)
+    zoo.register_table(table)   # so shutdown closes its peer connections
+    return table
 
 
 def finish_train(worker_id: Optional[int] = None) -> None:
@@ -165,7 +168,9 @@ def finish_train(worker_id: Optional[int] = None) -> None:
     worker from every table's BSP clocks so stragglers can drain to
     shutdown."""
     zoo = Zoo.get()
-    wid = worker_id if worker_id is not None else max(zoo.worker_id(), 0)
+    wid = worker_id if worker_id is not None else zoo.worker_id()
+    if wid < 0:
+        return   # this process hosts no worker; nothing to release
     for table in zoo.tables:
         ft = getattr(table, "finish_train", None)
         if ft is not None:

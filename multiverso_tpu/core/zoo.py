@@ -112,8 +112,12 @@ class Zoo:
             my_rank, world, peers = rank_from_machine_file(machine_file)
             configure.set_flag("rank", my_rank)
             configure.set_flag("world_size", world)
+            # The machine-file ports are the PS service ports; the
+            # coordination service must not squat on rank 0's PS port (the
+            # peers will net_bind/net_connect against those entries), so it
+            # binds one port above.
             configure.set_flag("coordinator",
-                               f"{peers[0][0]}:{peers[0][1]}")
+                               f"{peers[0][0]}:{peers[0][1] + 1}")
         # Multi-controller bring-up: the RegisterNode/Controller handshake
         # (ref src/controller.cpp:38-80) maps to jax.distributed's
         # coordination service — rank 0 hosts it, everyone registers.

@@ -168,3 +168,16 @@ def test_finish_train_api():
             assert not x.is_alive()
     finally:
         mv.shutdown()
+
+
+def test_finish_train_noop_without_worker():
+    """A server-only process must not release worker 0's clocks."""
+    mv.init(["-ps_role=server", "-sync=true"], num_local_workers=2)
+    try:
+        t = mv.create_table(mv.ArrayTableOption(size=4))
+        coord = t._sync
+        mv.finish_train()          # no local worker: must be a no-op
+        if coord is not None:
+            assert coord._adds.value(0) != float("inf")
+    finally:
+        mv.shutdown()
