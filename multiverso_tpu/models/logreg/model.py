@@ -120,15 +120,18 @@ class LocalModel:
 
 
 class PSModel:
-    """PS mode: weights live in a sharded ArrayTable."""
+    """PS mode: weights live in a sharded ArrayTable (or any injected
+    table with the same get/add surface — e.g. a DistributedArrayTable for
+    multi-process deployments, the reference's 24-machine LR shape)."""
 
-    def __init__(self, cfg: LogRegConfig):
+    def __init__(self, cfg: LogRegConfig, table=None):
         self.cfg = cfg
         is_ftrl = cfg.objective == "ftrl"
         updater = "ftrl" if is_ftrl else "sgd"
-        self.table = mv.create_table(ArrayTableOption(
-            size=cfg.width * cfg.num_class, updater=updater,
-            name="logreg_weights"))
+        self.table = table if table is not None else mv.create_table(
+            ArrayTableOption(
+                size=cfg.width * cfg.num_class, updater=updater,
+                name="logreg_weights"))
         self.is_ftrl = is_ftrl
         self._step = _make_step(cfg)
         self.local_weights = np.zeros((cfg.width, cfg.num_class),

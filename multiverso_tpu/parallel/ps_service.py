@@ -349,6 +349,26 @@ class DistributedArrayTable(DistributedTableBase):
             out[lo:hi] = slot[0].data[0][:hi - lo]
         return out
 
+    # -- WorkerTable-compatible async surface (PSModel pipelining etc.) ----
+    # The wire path is synchronous per call; these adapters provide the
+    # msg_id/wait contract so in-process consumers (pipelined pulls) work
+    # unchanged against distributed tables.
+    def add_async(self, delta, option: Optional[AddOption] = None) -> int:
+        self.add(delta, option)
+        self._last_get = None
+        return self._next_msg_id()
+
+    def get_async(self) -> int:
+        result = self.get()
+        msg_id = self._next_msg_id()
+        self._pending_gets = getattr(self, "_pending_gets", {})
+        self._pending_gets[msg_id] = result
+        return msg_id
+
+    def wait(self, msg_id: int):
+        pending = getattr(self, "_pending_gets", {})
+        return pending.pop(msg_id, None)
+
 
 class DistributedMatrixTable(DistributedTableBase):
     """2-D table row-sharded across processes; row-granular Get/Add."""
