@@ -78,6 +78,25 @@ class SparseMatrixTable(MatrixTable):
             self._stale[wid, rows] = False
         return rows, values
 
+    # -- checkpointing ------------------------------------------------------
+    def store_state(self) -> Dict[str, np.ndarray]:
+        payload = self.store.store_state()
+        with self._stale_lock:
+            payload["staleness"] = self._stale.copy()
+        return payload
+
+    def load_state(self, payload: Dict[str, np.ndarray]) -> None:
+        self.store.load_state(payload)
+        with self._stale_lock:
+            saved = payload.get("staleness")
+            if saved is not None and saved.shape == self._stale.shape:
+                self._stale[:] = saved.astype(bool)
+            else:
+                # Unknown staleness after restore: everything stale is the
+                # safe direction (workers re-pull; nothing reads stale data).
+                self._stale[:] = True
+            self._caches.clear()
+
     def get(self, option: Optional[GetOption] = None) -> np.ndarray:
         """Whole-table get. With a GetOption this is incremental: only stale
         rows cross the wire, scattered into a per-worker host cache."""
