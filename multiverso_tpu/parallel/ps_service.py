@@ -352,22 +352,33 @@ class DistributedArrayTable(DistributedTableBase):
     # -- WorkerTable-compatible async surface (PSModel pipelining etc.) ----
     # The wire path is synchronous per call; these adapters provide the
     # msg_id/wait contract so in-process consumers (pipelined pulls) work
-    # unchanged against distributed tables.
+    # unchanged against distributed tables. Pending get results are bounded
+    # (oldest evicted) like WorkerTable.MAX_PENDING.
+    MAX_PENDING_GETS = 64
+
     def add_async(self, delta, option: Optional[AddOption] = None) -> int:
         self.add(delta, option)
-        self._last_get = None
         return self._next_msg_id()
 
     def get_async(self) -> int:
+        import collections
+
         result = self.get()
         msg_id = self._next_msg_id()
-        self._pending_gets = getattr(self, "_pending_gets", {})
-        self._pending_gets[msg_id] = result
+        pending = getattr(self, "_pending_gets", None)
+        if pending is None:
+            pending = self._pending_gets = collections.OrderedDict()
+        pending[msg_id] = result
+        while len(pending) > self.MAX_PENDING_GETS:
+            pending.popitem(last=False)
         return msg_id
 
     def wait(self, msg_id: int):
         pending = getattr(self, "_pending_gets", {})
-        return pending.pop(msg_id, None)
+        result = pending.pop(msg_id, None)
+        check(result is not None,
+              f"unknown or already-waited msg_id {msg_id}")
+        return result
 
 
 class DistributedMatrixTable(DistributedTableBase):

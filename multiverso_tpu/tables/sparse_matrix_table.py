@@ -79,22 +79,15 @@ class SparseMatrixTable(MatrixTable):
         return rows, values
 
     # -- checkpointing ------------------------------------------------------
-    def store_state(self) -> Dict[str, np.ndarray]:
-        payload = self.store.store_state()
-        with self._stale_lock:
-            payload["staleness"] = self._stale.copy()
-        return payload
-
     def load_state(self, payload: Dict[str, np.ndarray]) -> None:
+        """Restore marks EVERYTHING stale — the reference-faithful choice
+        (the sparse server initializes its bitmap to all-stale on
+        construction). Preserving a saved bitmap would be wrong here: a
+        fresh bit promises the worker's cache holds the current row, and
+        worker caches are not part of the checkpoint."""
         self.store.load_state(payload)
         with self._stale_lock:
-            saved = payload.get("staleness")
-            if saved is not None and saved.shape == self._stale.shape:
-                self._stale[:] = saved.astype(bool)
-            else:
-                # Unknown staleness after restore: everything stale is the
-                # safe direction (workers re-pull; nothing reads stale data).
-                self._stale[:] = True
+            self._stale[:] = True
             self._caches.clear()
 
     def get(self, option: Optional[GetOption] = None) -> np.ndarray:

@@ -25,8 +25,9 @@ def test_two_rank_distributed_logreg(mv_env):
                        sync_frequency=1)
     svc0, svc1 = PSService(), PSService()
     peers = [svc0.address, svc1.address]
+    tables = []
     try:
-        tables = [DistributedArrayTable(50, cfg.width, svc, peers, rank=r,
+        tables += [DistributedArrayTable(50, cfg.width, svc, peers, rank=r,
                                         updater="sgd")
                   for r, svc in enumerate((svc0, svc1))]
         models = [PSModel(cfg, table=t) for t in tables]
@@ -61,5 +62,7 @@ def test_two_rank_distributed_logreg(mv_env):
         np.testing.assert_allclose(tables[0].get(), tables[1].get(),
                                    rtol=1e-5, atol=1e-6)
     finally:
+        for t in tables:
+            t.close()
         svc0.close()
         svc1.close()
