@@ -169,10 +169,22 @@ def main() -> None:
 
     if not _probe_backend():
         _log("backend unreachable (tunneled TPU down?) — recording zeros")
+        recorded = None
+        baseline_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_BASELINE.json")
+        if os.path.exists(baseline_path):
+            try:
+                with open(baseline_path) as f:
+                    recorded = json.load(f).get("w2v_words_per_sec")
+            except (OSError, ValueError):
+                pass
         print(json.dumps({
             "metric": "w2v_words_per_sec", "value": 0.0,
             "unit": "words/sec/chip", "vs_baseline": 0.0,
-            "error": "jax backend unreachable within probe timeout",
+            "error": "jax backend unreachable within probe timeout "
+                     "(tunnel outage); last measured value on this chip: "
+                     f"{recorded} (BENCH_BASELINE.json, docs/BENCHMARK.md)",
         }))
         return
 
