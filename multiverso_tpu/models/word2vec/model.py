@@ -607,6 +607,27 @@ class Word2Vec:
                     vec_s = " ".join(f"{x:.6f}" for x in vec)
                     f.write(f"{self.dict.words[r]} {vec_s}\n")
 
+    def analogy(self, a: str, b: str, c: str, topk: int = 5
+                ) -> List[Tuple[str, float]]:
+        """a : b :: c : ?  via vector arithmetic (b - a + c), inputs
+        excluded — the standard word2vec evaluation query."""
+        ids = [self.dict.word2id.get(w) for w in (a, b, c)]
+        if any(i is None for i in ids):
+            return []
+        emb = self.embeddings().astype(np.float32)
+        emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
+        query = emb[ids[1]] - emb[ids[0]] + emb[ids[2]]
+        query = query / (np.linalg.norm(query) + 1e-12)
+        sims = emb @ query
+        out: List[Tuple[str, float]] = []
+        for i in np.argsort(-sims):
+            if i in ids:
+                continue
+            out.append((self.dict.words[i], float(sims[i])))
+            if len(out) == topk:
+                break
+        return out
+
     def most_similar(self, word: str, topk: int = 5) -> List[Tuple[str, float]]:
         wid = self.dict.word2id.get(word)
         if wid is None:

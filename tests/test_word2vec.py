@@ -208,3 +208,16 @@ def test_bfloat16_save_and_checkpoint(tmp_path, mv_env):
     np.testing.assert_allclose(
         w2v.input_table.get().astype(np.float32), before)
     assert str(w2v.input_table.store.dtype) == "bfloat16"
+
+
+def test_analogy_query(mv_env):
+    sents = _corpus(100)
+    d = Dictionary.build(sents, min_count=1)
+    cfg = Word2VecConfig(embedding_size=16, batch_size=128, min_count=1,
+                         sample=0, epochs=1, pipeline=False)
+    w2v = Word2Vec(cfg, d)
+    w2v.train(sentences=[d.encode(s) for s in sents])
+    out = w2v.analogy("a0", "a1", "b0", topk=3)
+    assert len(out) == 3
+    assert all(w not in ("a0", "a1", "b0") for w, _ in out)
+    assert w2v.analogy("a0", "missing", "b0") == []
