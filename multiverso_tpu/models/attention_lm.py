@@ -38,6 +38,7 @@ class LMConfig:
     seq_parallel: Optional[int] = None
     moe_experts: int = 0                  # >0: MoE MLP (expert parallelism)
     moe_aux_weight: float = 0.01
+    remat: bool = False                   # rematerialize each layer block
     seed: int = 0
 
 
@@ -88,7 +89,9 @@ def forward(params: Params, tokens: jax.Array, cfg: LMConfig,
     x = x + jnp.where(jnp.arange(D)[None, :] % 2 == 0, jnp.sin(pos),
                       jnp.cos(pos))[None, :, :]
     aux_total = jnp.float32(0.0)
-    for i in range(cfg.layers):
+
+    def layer_block(x, i):
+        aux = jnp.float32(0.0)
         h = _ln(x)
         qkv = h @ params[f"qkv_{i}"]                       # [B,S,3D]
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -108,10 +111,16 @@ def forward(params: Params, tokens: jax.Array, cfg: LMConfig,
                             params[f"moe_w1_{i}"], params[f"moe_w2_{i}"])
             y, aux = top1_moe(moe, h)
             x = x + y
-            aux_total = aux_total + aux
         else:
             x = x + jax.nn.gelu(h @ params[f"mlp_in_{i}"]) \
                 @ params[f"mlp_out_{i}"]
+        return x, aux
+
+    for i in range(cfg.layers):
+        block = (jax.checkpoint(layer_block, static_argnums=(1,))
+                 if cfg.remat else layer_block)
+        x, aux = block(x, i)
+        aux_total = aux_total + aux
     return _ln(x) @ params["out"], aux_total
 
 

@@ -58,3 +58,18 @@ def test_moe_lm_trains():
     final = lm.loss(batches[0])
     assert np.isfinite(final)
     assert final < initial * 0.6, (initial, final)
+
+
+def test_remat_matches_baseline_loss():
+    """jax.checkpoint on the layer blocks changes memory, not math."""
+    cfg_a = LMConfig(vocab=16, dim=32, heads=4, layers=2, seq=32,
+                     seq_parallel=2, data_parallel=2, seed=5)
+    cfg_b = LMConfig(vocab=16, dim=32, heads=4, layers=2, seq=32,
+                     seq_parallel=2, data_parallel=2, seed=5, remat=True)
+    lm_a, lm_b = AttentionLM(cfg_a), AttentionLM(cfg_b)
+    batch = _cyclic_batches(1, B=4, S=32, K=11)[0]
+    np.testing.assert_allclose(lm_a.loss(batch), lm_b.loss(batch),
+                               rtol=1e-5)
+    (la,) = lm_a.fit([batch])
+    (lb,) = lm_b.fit([batch])
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
