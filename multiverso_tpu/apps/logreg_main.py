@@ -31,10 +31,12 @@ def _body(argv: List[str]) -> int:
     config_file = configure.get_flag("config_file")
     cfg = (LogRegConfig.from_file(config_file) if config_file
            else LogRegConfig())
-    train_file = configure.get_flag("lr_train_file")
-    test_file = configure.get_flag("lr_test_file")
+    # Flags override; the config file's own train_file/test_file/output_file
+    # keys (ref configure.h:53-79) are honored otherwise.
+    train_file = configure.get_flag("lr_train_file") or cfg.train_file
+    test_file = configure.get_flag("lr_test_file") or cfg.test_file
     if not train_file:
-        log.error("missing -lr_train_file")
+        log.error("missing -lr_train_file (flag or train_file= config key)")
         return 1
     if cfg.num_feature <= 0:
         log.error("config must set num_feature")
@@ -46,13 +48,16 @@ def _body(argv: List[str]) -> int:
     losses = lr.train(reader)
     log.info("train losses per epoch: %s",
              ", ".join(f"{l:.5f}" for l in losses))
+    if cfg.output_model_file:
+        lr.save_model(cfg.output_model_file)
     if test_file:
         test_reader = SampleReader(test_file, cfg.num_feature,
                                    cfg.minibatch_size,
                                    input_format=cfg.input_format,
                                    bias=cfg.bias)
         acc = lr.test(test_reader,
-                      output_path=configure.get_flag("output_file") or None)
+                      output_path=configure.get_flag("output_file") or
+                      cfg.output_file or None)
         log.info("test accuracy: %.4f", acc)
     Dashboard.display()
     return 0

@@ -12,11 +12,15 @@ free; it matters for the *host-driven* mode where independent worker threads
 (or processes) issue Get/Add against the shared device store. The gating rule
 distilled from the reference's clock algebra:
 
-* Add #a from worker w may be **applied** only once every active worker has
-  completed Get #(a-1) — otherwise a fast worker's next-round add would
-  contaminate a slow worker's current-round view.
-* Get #g from worker w may be **served** only once every active worker's Add
-  count >= g — so the g-th view contains exactly g adds from everyone.
+* Add from worker w may be **applied** only while w's own Get count is not
+  ahead of the global (min) Get count (ref ``ProcessAdd``: cache when
+  ``get_local[w] > get_global``) — a fast worker's next-round add would
+  otherwise contaminate a slow worker's current-round view.
+* Get from worker w may be **served** only while w's own Add count is not
+  ahead of the global (min) Add count (ref ``ProcessGet``: cache when
+  ``add_local[w] > add_global``), and w has no Add still in flight. The
+  first Get in a get-train-add loop is therefore served immediately; both
+  get-first and add-first worker loops are live.
 
 Implemented as a condition-variable-guarded pair of clock vectors rather than
 message caching (threads can simply block; the reference had to cache because
@@ -63,6 +67,10 @@ class SyncCoordinator:
         self.num_workers = num_workers
         self._adds = VectorClock(num_workers)
         self._gets = VectorClock(num_workers)
+        # Adds admitted past their gate but not yet committed; a Get from the
+        # same worker must order after them (ref ``num_waited_add_`` in
+        # src/server.cpp ProcessGet).
+        self._inflight_adds = [0] * num_workers
         self._cv = threading.Condition()
 
     # -- gates -------------------------------------------------------------
@@ -73,23 +81,34 @@ class SyncCoordinator:
     # the single-threaded server actor both applies and clocks a message).
     def acquire_add(self, worker_id: int, timeout: float = 60.0) -> None:
         with self._cv:
-            target = self._adds.value(worker_id)  # this will be add #target+1
             ok = self._cv.wait_for(
-                lambda: self._gets.min() >= target or
+                lambda: self._gets.min() >= self._gets.value(worker_id) or
                 self._adds.value(worker_id) == VectorClock.INF,
                 timeout)
             check(ok, f"sync add gate timed out (worker {worker_id})")
+            self._inflight_adds[worker_id] += 1
 
     def commit_add(self, worker_id: int) -> None:
         with self._cv:
             self._adds.tick(worker_id)
+            self._inflight_adds[worker_id] -= 1
+            self._cv.notify_all()
+
+    def abort_add(self, worker_id: int) -> None:
+        """Release an admitted add whose application failed — without this,
+        a raise between acquire and commit would wedge every future get."""
+        with self._cv:
+            self._inflight_adds[worker_id] -= 1
             self._cv.notify_all()
 
     def acquire_get(self, worker_id: int, timeout: float = 60.0) -> None:
+        # A get must not race ANY worker's admitted-but-uncommitted add
+        # (the reference's single-threaded server applies and clocks each
+        # add atomically, so a served get never observes a half-round).
         with self._cv:
-            target = self._gets.value(worker_id) + 1
             ok = self._cv.wait_for(
-                lambda: self._adds.min() >= target or
+                lambda: (self._adds.min() >= self._adds.value(worker_id) and
+                         not any(self._inflight_adds)) or
                 self._gets.value(worker_id) == VectorClock.INF,
                 timeout)
             check(ok, f"sync get gate timed out (worker {worker_id})")

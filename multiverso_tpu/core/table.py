@@ -25,6 +25,7 @@ need it.
 from __future__ import annotations
 
 import collections
+import contextlib
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -233,22 +234,32 @@ class WorkerTable:
             from multiverso_tpu.core.sync_coordinator import SyncCoordinator
             self._sync = SyncCoordinator(zoo.num_workers())
 
-    # -- BSP gates (no-ops in async mode / single-worker worlds) -----------
-    def _gate_add(self, option: Optional[AddOption]) -> None:
-        if self._sync is not None:
-            self._sync.acquire_add(option.worker_id if option else 0)
+    # -- BSP gates (no-ops in async mode / single-worker worlds). Context
+    # managers so a raise during application releases the in-flight slot
+    # (abort) instead of wedging every future get. --------------------------
+    @contextlib.contextmanager
+    def _bsp_add(self, option: Optional[AddOption]):
+        if self._sync is None:
+            yield
+            return
+        wid = option.worker_id if option else 0
+        self._sync.acquire_add(wid)
+        try:
+            yield
+        except BaseException:
+            self._sync.abort_add(wid)
+            raise
+        self._sync.commit_add(wid)
 
-    def _commit_add(self, option: Optional[AddOption]) -> None:
-        if self._sync is not None:
-            self._sync.commit_add(option.worker_id if option else 0)
-
-    def _gate_get(self, option: Optional[GetOption]) -> None:
-        if self._sync is not None:
-            self._sync.acquire_get(option.worker_id if option else 0)
-
-    def _commit_get(self, option: Optional[GetOption]) -> None:
-        if self._sync is not None:
-            self._sync.commit_get(option.worker_id if option else 0)
+    @contextlib.contextmanager
+    def _bsp_get(self, option: Optional[GetOption]):
+        if self._sync is None:
+            yield
+            return
+        wid = option.worker_id if option else 0
+        self._sync.acquire_get(wid)
+        yield
+        self._sync.commit_get(wid)
 
     def finish_train(self, worker_id: int) -> None:
         """``Zoo::FinishTrain`` analog (ref src/zoo.cpp:152-161): release a

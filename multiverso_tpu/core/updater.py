@@ -34,6 +34,34 @@ State = Dict[str, jax.Array]
 Scalars = Tuple[Any, Any, Any, Any, Any]
 
 
+def combine_duplicate_rows(rows: jax.Array, delta: jax.Array, num_rows: int
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """Fold duplicate row ids into one combined delta per id.
+
+    Stateful updaters gather-compute-set; a ``.at[rows].set`` with duplicate
+    ids is last-write-wins, which would drop all but one duplicate's state
+    contribution (the reference's sequential per-element loop accumulates,
+    ``src/updater/updater.cpp:22-29``). Shape-stable under jit: sort by id,
+    segment-sum the run, give the run-start position the run total, and remap
+    every other duplicate to the out-of-bounds sentinel ``num_rows`` so
+    ``mode="drop"`` writes discard it.
+
+    Returns ``(rows_eff, delta_combined)`` in sorted order; both same shapes
+    as the inputs.
+    """
+    if rows.shape[0] == 0:   # static shape: empty add is a no-op
+        return rows, delta
+    order = jnp.argsort(rows)
+    r = jnp.take(rows, order)
+    d = jnp.take(delta, order, axis=0)
+    is_start = jnp.concatenate([jnp.ones((1,), bool), r[1:] != r[:-1]])
+    seg = jnp.cumsum(is_start) - 1
+    totals = jax.ops.segment_sum(d, seg, num_segments=r.shape[0])
+    d_comb = jnp.take(totals, seg, axis=0)
+    r_eff = jnp.where(is_start, r, num_rows)
+    return r_eff, d_comb
+
+
 class Updater:
     """Base: plain accumulate — ``data += delta`` (ref updater.cpp:19-29)."""
 
@@ -86,6 +114,7 @@ class MomentumUpdater(Updater):
 
     def update_rows(self, data, state, rows, delta, opt):
         m = opt[1].astype(data.dtype)
+        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
         prev = jnp.take(state["smooth"], rows, axis=0, mode="clip")
         smooth_rows = m * prev + (1 - m) * delta
         smooth = state["smooth"].at[rows].set(smooth_rows, mode="drop")
@@ -94,8 +123,15 @@ class MomentumUpdater(Updater):
 
 class AdaGradUpdater(Updater):
     """Per-worker historic squared-gradient accumulators
-    (ref adagrad_updater.h:17-41): ``G[w] += delta^2;
-    data -= rho / sqrt(G[w] + eps) * delta / lr``."""
+    (ref adagrad_updater.h:17-41): ``G[w] += (delta/lr)^2;
+    data -= rho / sqrt(G[w] + eps) * delta / lr``.
+
+    Clients pre-scale deltas by lr, so the raw gradient is ``delta/lr`` —
+    the reference normalizes the accumulator by ``learning_rate`` twice
+    (adagrad_updater.h:29-33) so G accumulates squared *gradients*, not
+    squared pre-scaled deltas. (The reference's own Update then subtracts a
+    stale accumulator copy — a bug we do not reproduce; we keep the clearly
+    intended G += grad^2 semantics.) lr==0 is guarded to a no-op scale."""
 
     name = "adagrad"
     eps = 1e-6
@@ -104,21 +140,27 @@ class AdaGradUpdater(Updater):
         return {"g2": jnp.zeros((max(num_workers, 1),) + tuple(shape),
                                 dtype=jnp.float32)}
 
+    @staticmethod
+    def _grad(d32, lr):
+        lr_safe = jnp.where(lr > 0, lr, 1.0).astype(jnp.float32)
+        return d32 / lr_safe
+
     def update_dense(self, data, state, delta, opt):
         worker_id, _, lr, rho, _ = opt
-        d32 = delta.astype(jnp.float32)
-        g2_w = state["g2"][worker_id] + jnp.square(d32)
+        g = self._grad(delta.astype(jnp.float32), lr)
+        g2_w = state["g2"][worker_id] + jnp.square(g)
         g2 = state["g2"].at[worker_id].set(g2_w)
-        step = rho / jnp.sqrt(g2_w + self.eps) * d32 / lr
+        step = rho / jnp.sqrt(g2_w + self.eps) * g
         return data - step.astype(data.dtype), {"g2": g2}
 
     def update_rows(self, data, state, rows, delta, opt):
         worker_id, _, lr, rho, _ = opt
-        d32 = delta.astype(jnp.float32)
+        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
+        g = self._grad(delta.astype(jnp.float32), lr)
         prev = jnp.take(state["g2"][worker_id], rows, axis=0, mode="clip")
-        g2_rows = prev + jnp.square(d32)
+        g2_rows = prev + jnp.square(g)
         g2 = state["g2"].at[worker_id, rows].set(g2_rows, mode="drop")
-        step = rho / jnp.sqrt(g2_rows + self.eps) * d32 / lr
+        step = rho / jnp.sqrt(g2_rows + self.eps) * g
         return data.at[rows].add(-step.astype(data.dtype), mode="drop"), {"g2": g2}
 
 
@@ -149,6 +191,7 @@ class DCASGDUpdater(Updater):
 
     def update_rows(self, data, state, rows, delta, opt):
         worker_id, _, lr, _, lam = opt
+        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
         g = delta.astype(jnp.float32)
         d_rows = jnp.take(data, rows, axis=0, mode="clip").astype(jnp.float32)
         backup_rows = jnp.take(state["backup"][worker_id], rows, axis=0,
@@ -197,6 +240,7 @@ class FTRLUpdater(Updater):
         return w, {"z": z, "n": n}
 
     def update_rows(self, data, state, rows, delta, opt):
+        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
         w_rows = jnp.take(data, rows, axis=0, mode="clip")
         z_rows = jnp.take(state["z"], rows, axis=0, mode="clip")
         n_rows = jnp.take(state["n"], rows, axis=0, mode="clip")

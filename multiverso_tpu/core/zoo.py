@@ -98,6 +98,11 @@ class Zoo:
               num_local_workers: int = 1) -> List[str]:
         check(not self.started, "Zoo already started")
         remaining = configure.parse_cmd_flags(argv)
+        # Must precede any jax device use; the env var is not honored once
+        # a sitecustomize has pinned jax_platforms via jax.config.
+        platform = configure.get_flag("platform")
+        if platform:
+            jax.config.update("jax_platforms", platform)
         self.role = Role.parse(configure.get_flag("ps_role"))
         self.ma_mode = configure.get_flag("ma")
         self.sync_mode = configure.get_flag("sync")

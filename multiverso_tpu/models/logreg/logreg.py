@@ -26,6 +26,19 @@ class LogReg:
         self.model = make_model(cfg)
         _, predict = get_objective(cfg.objective)
         self._predict = jax.jit(predict)
+        if cfg.init_model_file:
+            self.load_model(cfg.init_model_file)
+
+    # -- model file IO (ref configure.h:53,77: init_model_file /
+    # output_model_file; format is .npy instead of the reference's raw
+    # binary dump) -------------------------------------------------------
+    def save_model(self, path: str) -> None:
+        with open(path, "wb") as f:
+            np.save(f, self.model.get_weights())
+
+    def load_model(self, path: str) -> None:
+        with open(path, "rb") as f:
+            self.model.set_weights(np.load(f))
 
     def train(self, batches: Iterable[Tuple[np.ndarray, np.ndarray]],
               epochs: Optional[int] = None) -> List[float]:

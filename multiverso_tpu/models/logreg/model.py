@@ -18,7 +18,7 @@ FTRL updater owns {z, n} and recomputes weights (the reference's FTRL table).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import ClassVar, Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +51,29 @@ class LogRegConfig:
     ftrl_beta: float = 1.0
     ftrl_l1: float = 1.0
     ftrl_l2: float = 1.0
+    # IO surface carried in the config file (ref configure.h:53-79)
+    train_file: str = ""
+    test_file: str = ""
+    output_file: str = ""
+    init_model_file: str = ""
+    output_model_file: str = ""
+
+    # Reference key names (configure.h:19-96) -> our field names.
+    KEY_ALIASES: ClassVar[dict] = {
+        "input_size": "num_feature",
+        "output_size": "num_class",
+        "train_epoch": "epochs",
+        "objective_type": "objective",
+        "regular_type": "regular",
+        "alpha": "ftrl_alpha",
+        "beta": "ftrl_beta",
+        "lambda1": "ftrl_l1",
+        "lambda2": "ftrl_l2",
+    }
+    VALUE_ALIASES: ClassVar[dict] = {
+        "objective": {"default": "linear"},
+        "regular": {"default": "none", "L1": "l1", "L2": "l2"},
+    }
 
     @property
     def width(self) -> int:
@@ -58,7 +81,8 @@ class LogRegConfig:
 
     @classmethod
     def from_file(cls, path: str) -> "LogRegConfig":
-        """Parse the reference's ``key=value`` config-file format."""
+        """Parse the reference's ``key=value`` config-file format, accepting
+        both our field names and the reference's key spellings."""
         cfg = cls()
         with open(path) as f:
             for line in f:
@@ -66,8 +90,9 @@ class LogRegConfig:
                 if not line or line.startswith("#") or "=" not in line:
                     continue
                 key, _, val = line.partition("=")
-                key = key.strip()
+                key = cls.KEY_ALIASES.get(key.strip(), key.strip())
                 val = val.strip()
+                val = cls.VALUE_ALIASES.get(key, {}).get(val, val)
                 if hasattr(cfg, key):
                     field_type = type(getattr(cfg, key))
                     if field_type is bool:
@@ -118,6 +143,11 @@ class LocalModel:
     def get_weights(self) -> np.ndarray:
         return np.asarray(self.weights)
 
+    def set_weights(self, w: np.ndarray) -> None:
+        self.weights = jnp.asarray(
+            np.asarray(w, dtype=np.float32).reshape(self.cfg.width,
+                                                    self.cfg.num_class))
+
 
 class PSModel:
     """PS mode: weights live in a sharded ArrayTable (or any injected
@@ -138,6 +168,7 @@ class PSModel:
                                       dtype=np.float32)
         self._minibatches_since_sync = 0
         self._pending_get: Optional[int] = None
+        self._dirty = False     # True once this instance has pushed grads
         if is_ftrl:
             self._add_option = AddOption(
                 learning_rate=cfg.ftrl_alpha, rho=cfg.ftrl_beta,
@@ -156,6 +187,7 @@ class PSModel:
             delta = self.cfg.learning_rate * grad  # client-side lr scaling
         with monitor("LOGREG_PUSH"):
             self.table.add_async(delta.reshape(-1), self._add_option)
+        self._dirty = True
         self._minibatches_since_sync += 1
         if self._needs_sync():
             self._pull()
@@ -193,6 +225,30 @@ class PSModel:
 
     def get_weights(self) -> np.ndarray:
         return self.local_weights
+
+    def set_weights(self, w: np.ndarray) -> None:
+        """Warm start (ref ``init_model_file``) on a FRESH (zero) table via
+        the reference binding's master-init trick
+        (``binding/python/multiverso/tables.py:38-68``): the master worker
+        adds the init value, every other worker adds zeros — one symmetric
+        add per worker, so it is BSP-safe and concurrent warm-starts cannot
+        double-apply. FTRL keeps server-side {z,n} state that a raw weight
+        file cannot reconstruct, so warm start is rejected there."""
+        from multiverso_tpu.utils.log import check, log
+        if self.is_ftrl:
+            log.error("init_model_file ignored: ftrl server state cannot be "
+                      "reconstructed from a weight vector")
+            return
+        check(not self._dirty,
+              "warm start requires a fresh (zero) PS table — construct a "
+              "new LogReg with init_model_file instead of calling "
+              "load_model on a trained one")
+        w = np.asarray(w, dtype=np.float32).reshape(self.cfg.width,
+                                                    self.cfg.num_class)
+        # sgd updater applies data -= delta, so the master pushes -w.
+        delta = -w if mv.is_master_worker() else np.zeros_like(w)
+        self.table.add(delta.reshape(-1), self._add_option)
+        self.local_weights = w.copy()
 
 
 def make_model(cfg: LogRegConfig):

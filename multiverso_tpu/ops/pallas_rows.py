@@ -15,9 +15,10 @@ the prefetched id array. For scatter:
   duplicates are consecutive *runs*;
 * within a group, run deltas are folded by an unrolled prefix pass and only
   the LAST row of each run is written back — no lost updates;
-* a run spanning a group boundary is safe because the grid is sequential and
-  each step waits for its write DMAs before finishing, so the next group
-  re-reads the updated row.
+* the final lane of every group ALWAYS flushes its partial sum: a run
+  spanning a group boundary writes rows[7]+acc[7] back, and the next group
+  (grid is sequential, write DMAs awaited) re-reads the updated row and
+  accumulates its own deltas on top, so cross-boundary runs are exact.
 
 In-place via ``input_output_aliases`` (the table buffer is donated). The
 jitted XLA paths remain the default; these kernels are opt-in and are
@@ -100,7 +101,6 @@ def gather_rows(table: jax.Array, ids: jax.Array,
 def _scatter_kernel(ids_ref, delta_ref, table_in_ref, table_ref, rows, sems):
     del table_in_ref  # aliased with table_ref (the output)
     g = pl.program_id(0)
-    n_groups = pl.num_programs(0)
     base = g * GROUP
 
     # Load the group's rows.
@@ -119,30 +119,27 @@ def _scatter_kernel(ids_ref, delta_ref, table_in_ref, table_ref, rows, sems):
         acc[k] = delta_ref[k, :] + jnp.where(same, acc[k - 1],
                                              jnp.zeros_like(acc[k - 1]))
 
-    # Write back only the LAST row of each run (run end = id changes next,
-    # or this is the very last element overall).
-    last_group = g == n_groups - 1
-    for k in range(GROUP):
-        if k < GROUP - 1:
-            is_run_end = ids_ref[base + k] != ids_ref[base + k + 1]
-        else:
-            # Last lane: run end unless the run continues into next group.
-            nxt = jnp.minimum(base + GROUP,
-                              n_groups * GROUP - 1)
-            is_run_end = jnp.logical_or(
-                last_group, ids_ref[base + k] != ids_ref[nxt])
+    # Write back only the LAST row of each run (run end = id changes next).
+    # Lane GROUP-1 ALWAYS flushes: if its run continues into the next group,
+    # the partial sum lands in HBM before that group's (sequential) read, so
+    # the continuation accumulates on top of it instead of dropping it.
+    def _flush(k):
+        rows[k, :] = rows[k, :] + acc[k]
+        pltpu.make_async_copy(rows.at[k],
+                              table_ref.at[ids_ref[base + k]],
+                              sems.at[k]).start()
+        pltpu.make_async_copy(rows.at[k],
+                              table_ref.at[ids_ref[base + k]],
+                              sems.at[k]).wait()
+
+    for k in range(GROUP - 1):
+        is_run_end = ids_ref[base + k] != ids_ref[base + k + 1]
 
         @pl.when(is_run_end)
         def _(k=k):
-            rows[k, :] = rows[k, :] + acc[k]
-            pltpu.make_async_copy(rows.at[k],
-                                  table_ref.at[ids_ref[base + k]],
-                                  sems.at[k]).start()
-            pltpu.make_async_copy(rows.at[k],
-                                  table_ref.at[ids_ref[base + k]],
-                                  sems.at[k]).wait()
+            _flush(k)
 
-        # Run continues into the next lane/group: carry, write nothing.
+    _flush(GROUP - 1)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -44,9 +44,8 @@ class ArrayTable(WorkerTable):
 
     # -- get (ref array_table.cpp:29-46) -----------------------------------
     def get_async(self, option: Optional[GetOption] = None) -> int:
-        self._gate_get(option)
-        arr = self.store.read()
-        self._commit_get(option)
+        with self._bsp_get(option):
+            arr = self.store.read()
         return self._register(lambda: np.asarray(arr))
 
     def get(self, option: Optional[GetOption] = None) -> np.ndarray:
@@ -62,9 +61,8 @@ class ArrayTable(WorkerTable):
         delta = np.asarray(delta, dtype=self.store.dtype)
         check(delta.shape == (self.size,),
               f"delta shape {delta.shape} != ({self.size},)")
-        self._gate_add(option)
-        self.store.apply_dense(delta, option or AddOption())
-        self._commit_add(option)
+        with self._bsp_add(option):
+            self.store.apply_dense(delta, option or AddOption())
         return self._register_add()
 
     def add(self, delta, option: Optional[AddOption] = None) -> None:

@@ -56,9 +56,8 @@ class MatrixTable(WorkerTable):
 
     # -- whole-table ops (sentinel key -1 in the reference) ----------------
     def get_async(self, option: Optional[GetOption] = None) -> int:
-        self._gate_get(option)
-        arr = self.store.read()
-        self._commit_get(option)
+        with self._bsp_get(option):
+            arr = self.store.read()
         return self._register(lambda: np.asarray(arr))
 
     def get(self, option: Optional[GetOption] = None) -> np.ndarray:
@@ -72,9 +71,8 @@ class MatrixTable(WorkerTable):
         delta = np.asarray(delta, dtype=self.store.dtype)
         check(delta.shape == (self.num_row, self.num_col),
               f"delta shape {delta.shape} != {(self.num_row, self.num_col)}")
-        self._gate_add(option)
-        self.store.apply_dense(delta, option or AddOption())
-        self._commit_add(option)
+        with self._bsp_add(option):
+            self.store.apply_dense(delta, option or AddOption())
         return self._register_add()
 
     def add(self, delta, option: Optional[AddOption] = None) -> None:
@@ -85,9 +83,8 @@ class MatrixTable(WorkerTable):
     def get_rows_async(self, row_ids,
                        option: Optional[GetOption] = None) -> int:
         row_ids = np.asarray(row_ids, dtype=np.int32)
-        self._gate_get(option)
-        arr = self.store.read_rows(row_ids)
-        self._commit_get(option)
+        with self._bsp_get(option):
+            arr = self.store.read_rows(row_ids)
         return self._register(lambda: np.asarray(arr))
 
     def get_rows(self, row_ids, option: Optional[GetOption] = None
@@ -105,9 +102,8 @@ class MatrixTable(WorkerTable):
         check(deltas.shape == (len(row_ids), self.num_col),
               f"row delta shape {deltas.shape} != "
               f"{(len(row_ids), self.num_col)}")
-        self._gate_add(option)
-        self.store.apply_rows(row_ids, deltas, option or AddOption())
-        self._commit_add(option)
+        with self._bsp_add(option):
+            self.store.apply_rows(row_ids, deltas, option or AddOption())
         return self._register_add()
 
     def add_rows(self, row_ids, deltas,

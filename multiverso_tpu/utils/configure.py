@@ -197,3 +197,5 @@ define_string("coordinator", "", "host:port of the jax.distributed "
               "coordinator; empty = single-process")
 define_int("world_size", 1, "number of processes (ranks)")
 define_int("rank", 0, "this process's rank")
+define_string("platform", "", "force the jax platform (e.g. 'cpu') before "
+              "first device use — lets CLIs run when the TPU is unreachable")

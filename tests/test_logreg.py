@@ -120,6 +120,45 @@ def test_config_from_file(tmp_path, mv_env):
     assert cfg.pipeline is True
 
 
+def test_config_reference_key_aliases(tmp_path, mv_env):
+    """The reference's own key spellings (configure.h:19-96) are honored."""
+    p = tmp_path / "ref.conf"
+    p.write_text("input_size=40\noutput_size=3\ntrain_epoch=7\n"
+                 "objective_type=softmax\nregular_type=L2\n"
+                 "train_file=a.svm\ntest_file=b.svm\noutput_file=o.txt\n"
+                 "alpha=0.25\nlambda1=2.5\n")
+    cfg = LogRegConfig.from_file(str(p))
+    assert cfg.num_feature == 40 and cfg.num_class == 3 and cfg.epochs == 7
+    assert cfg.objective == "softmax" and cfg.regular == "l2"
+    assert cfg.train_file == "a.svm" and cfg.test_file == "b.svm"
+    assert cfg.output_file == "o.txt"
+    assert cfg.ftrl_alpha == 0.25 and cfg.ftrl_l1 == 2.5
+
+
+def test_model_save_load_roundtrip(tmp_path, mv_env):
+    """init_model_file / output_model_file (ref configure.h:53,77): saved
+    weights warm-start a fresh model with identical predictions, in both
+    local and PS modes."""
+    X, y = _synthetic_binary()
+    for use_ps in (False, True):
+        cfg = LogRegConfig(objective="sigmoid", num_feature=10,
+                           use_ps=use_ps, learning_rate=1.0,
+                           minibatch_size=32)
+        lr = LogReg(cfg)
+        lr.train(ArrayBatcher(X, y, 32), epochs=5)
+        path = tmp_path / f"model_{use_ps}.npy"
+        lr.save_model(str(path))
+
+        cfg2 = LogRegConfig(objective="sigmoid", num_feature=10,
+                            use_ps=use_ps, init_model_file=str(path))
+        lr2 = LogReg(cfg2)
+        np.testing.assert_allclose(lr2.model.get_weights(),
+                                   lr.model.get_weights(), rtol=1e-6)
+        Xb = np.concatenate([X[:16], np.ones((16, 1), X.dtype)], axis=1)
+        np.testing.assert_allclose(lr2.predict(Xb), lr.predict(Xb),
+                                   rtol=1e-5)
+
+
 def test_predictions_written(tmp_path, mv_env):
     X, y = _synthetic_binary(n=64)
     cfg = LogRegConfig(objective="sigmoid", num_feature=10, use_ps=False)

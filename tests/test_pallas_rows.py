@@ -42,6 +42,43 @@ def test_scatter_add_duplicates_accumulate():
     np.testing.assert_allclose(np.asarray(out), expected)
 
 
+def test_scatter_add_run_crossing_group_boundary():
+    """Regression: a duplicate-id run longer than GROUP(8) spanning a group
+    boundary must not drop the first group's partial sum (advisor round-1
+    finding: 16 deltas of 1.0 yielded +8.0; [1]*10+[3]*6 yielded +2.0)."""
+    table = np.zeros((8, 128), dtype=np.float32)
+    ids = np.full(16, 1, dtype=np.int32)
+    deltas = np.ones((16, 128), dtype=np.float32)
+    out = scatter_add_sorted_rows(jnp.asarray(table), jnp.asarray(ids),
+                                  jnp.asarray(deltas), interpret=True)
+    expected = np.zeros((8, 128), dtype=np.float32)
+    expected[1] = 16.0
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+    ids = np.array([1] * 10 + [3] * 6, dtype=np.int32)
+    out = scatter_add_sorted_rows(jnp.zeros((8, 128), dtype=jnp.float32),
+                                  jnp.asarray(ids), jnp.asarray(deltas),
+                                  interpret=True)
+    expected = np.zeros((8, 128), dtype=np.float32)
+    expected[1] = 10.0
+    expected[3] = 6.0
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_scatter_add_long_runs_random():
+    """Runs of random lengths (1..20) across several group boundaries."""
+    rng = np.random.default_rng(7)
+    table = rng.normal(size=(32, 128)).astype(np.float32)
+    ids = np.sort(rng.integers(0, 32, size=67)).astype(np.int32)
+    deltas = rng.normal(size=(67, 128)).astype(np.float32)
+    out = scatter_add_sorted_rows(jnp.asarray(table), jnp.asarray(ids),
+                                  jnp.asarray(deltas), interpret=True)
+    expected = table.copy()
+    np.add.at(expected, ids, deltas)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                               atol=1e-5)
+
+
 def test_scatter_add_unsorted_wrapper():
     rng = np.random.default_rng(1)
     table = rng.normal(size=(32, 128)).astype(np.float32)

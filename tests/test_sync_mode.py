@@ -68,6 +68,44 @@ def test_bsp_identical_views_across_workers():
         mv.shutdown()
 
 
+def test_bsp_get_first_loop_is_live():
+    """Regression (advisor round 1): the canonical get-train-add loop must not
+    deadlock — the reference serves a worker's Get whenever its own add clock
+    is not ahead of the global add clock (src/server.cpp ProcessGet), so the
+    FIRST Get is served immediately, before any worker has Added."""
+    num_workers = 3
+    rounds = 4
+    mv.init(["-sync=true"], num_local_workers=num_workers)
+    try:
+        table = mv.create_table(mv.ArrayTableOption(size=8))
+        delta = np.ones(8, dtype=np.float32)
+        views = [[] for _ in range(num_workers)]
+
+        def worker(wid):
+            for _ in range(rounds):
+                views[wid].append(table.get(GetOption(worker_id=wid)).copy())
+                table.add(delta, AddOption(worker_id=wid))
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(num_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), \
+            "get-first BSP loop deadlocked"
+
+        # Worker w's i-th get (0-indexed) sees exactly i adds from everyone.
+        for i in range(rounds):
+            expected = delta * i * num_workers
+            for w in range(num_workers):
+                np.testing.assert_allclose(
+                    views[w][i], expected,
+                    err_msg=f"worker {w} round {i} saw a non-BSP view")
+    finally:
+        mv.shutdown()
+
+
 def test_finish_train_releases_stragglers():
     """Server_Finish_Train analog (ref src/server.cpp:190-213): a finished
     worker must not block the others' clocks."""

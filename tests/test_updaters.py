@@ -48,8 +48,9 @@ def test_momentum_updater(mv_env):
 
 
 def test_adagrad_updater_per_worker_state(mv_env):
-    """G[w] += d^2; data -= rho/sqrt(G[w]+eps) * d / lr
-    (ref adagrad_updater.h:17-41): accumulators are PER WORKER."""
+    """G[w] += (d/lr)^2; data -= rho/sqrt(G[w]+eps) * d / lr — clients
+    pre-scale deltas by lr, so G accumulates squared *gradients*
+    (ref adagrad_updater.h:17-41, lr^2-normalized accumulator)."""
     rho, lr = 0.1, 0.2
     t = mv.create_table(mv.ArrayTableOption(size=2, updater="adagrad"))
     d = np.array([1.0, 2.0], dtype=np.float32)
@@ -61,7 +62,7 @@ def test_adagrad_updater_per_worker_state(mv_env):
     data = np.zeros(2)
     for _ in range(2):
         t.add(d, mv.AddOption(worker_id=0, rho=rho, learning_rate=lr))
-        g = g + d * d
+        g = g + (d / lr) ** 2
         data = data - rho / np.sqrt(g + eps) * d / lr
         np.testing.assert_allclose(t.get(), data, rtol=1e-5)
 
@@ -74,8 +75,51 @@ def test_adagrad_row_updates(mv_env):
     d = np.ones((2, 2), dtype=np.float32)
     t.add_rows(rows, d, mv.AddOption(rho=rho, learning_rate=lr))
     eps = AdaGradUpdater.eps
-    expected_row = -rho / np.sqrt(1.0 + eps) * 1.0 / lr
+    grad = 1.0 / lr
+    expected_row = -rho / np.sqrt(grad * grad + eps) * grad
     got = t.get()
     np.testing.assert_allclose(got[rows], np.full((2, 2), expected_row),
                                rtol=1e-5)
     assert np.all(got[[0, 2, 3, 5]] == 0)
+
+
+def test_stateful_updaters_duplicate_rows(mv_env):
+    """Duplicate row ids in ONE add must accumulate their state contribution
+    (the reference's sequential loop accumulates; gather/set last-wins would
+    drop all but one). Deltas are pre-combined per id, so k duplicates of
+    delta d behave exactly like a single add of k*d."""
+    for updater in ("momentum_sgd", "adagrad", "ftrl", "dcasgd"):
+        t_dup = mv.create_table(
+            mv.MatrixTableOption(num_row=8, num_col=4, updater=updater))
+        t_one = mv.create_table(
+            mv.MatrixTableOption(num_row=8, num_col=4, updater=updater))
+        opt = mv.AddOption(worker_id=0, momentum=0.5, learning_rate=0.1,
+                           rho=0.1, lambda_=0.01)
+        d = np.ones((5, 4), dtype=np.float32)
+        # rows 2 appears x3, row 6 x2 -> equivalent single adds of 3d and 2d
+        t_dup.add_rows([2, 2, 2, 6, 6], d, opt)
+        t_one.add_rows([2, 6], np.stack([3 * d[0], 2 * d[0]]), opt)
+        np.testing.assert_allclose(t_dup.get(), t_one.get(), rtol=1e-5,
+                                   err_msg=f"updater={updater}")
+        # state carried correctly into a second (unique-id) add
+        t_dup.add_rows([2, 6], d[:2], opt)
+        t_one.add_rows([2, 6], d[:2], opt)
+        np.testing.assert_allclose(t_dup.get(), t_one.get(), rtol=1e-5,
+                                   err_msg=f"updater={updater} second add")
+
+
+def test_stateful_updater_empty_add_is_noop(mv_env):
+    t = mv.create_table(
+        mv.MatrixTableOption(num_row=4, num_col=2, updater="adagrad"))
+    t.add_rows([], np.zeros((0, 2), dtype=np.float32),
+               mv.AddOption(learning_rate=0.1, rho=0.1))
+    np.testing.assert_allclose(t.get(), np.zeros((4, 2)))
+
+
+def test_plain_updater_duplicate_rows(mv_env):
+    """Stateless adders use scatter-add, which accumulates duplicates."""
+    t = mv.create_table(mv.MatrixTableOption(num_row=4, num_col=2))
+    t.add_rows([1, 1, 3, 1], np.ones((4, 2), dtype=np.float32))
+    got = t.get()
+    np.testing.assert_allclose(got[1], [3.0, 3.0])
+    np.testing.assert_allclose(got[3], [1.0, 1.0])
