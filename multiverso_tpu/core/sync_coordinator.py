@@ -25,6 +25,18 @@ distilled from the reference's clock algebra:
 Implemented as a condition-variable-guarded pair of clock vectors rather than
 message caching (threads can simply block; the reference had to cache because
 actors must not block their mailbox loop).
+
+CONTRACT (inherited verbatim from the reference, ``src/server.cpp:61-63``:
+"The implementation assumes all the workers will call same number of Add
+and/or Get requests"): the identical-views guarantee holds for HOMOGENEOUS
+worker loops — every worker issues the same number of Adds between
+consecutive Gets (any fixed number, e.g. ``sync_frequency`` adds per pull).
+Round isolation then follows: round-(i+1) adds are gated behind every
+worker's i-th get, and each get waits for every worker's same add count, so
+the i-th view is exactly ``num_workers x adds_per_round x i`` updates.  If
+workers issue UNEQUAL add counts per round, the i-th views may differ by
+arrival order — exactly as in the reference, which caches by the same
+clocks.  Use ``finish_train`` to retire a worker that stops participating.
 """
 
 from __future__ import annotations

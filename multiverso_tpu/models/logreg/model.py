@@ -169,12 +169,18 @@ class PSModel:
         self._minibatches_since_sync = 0
         self._pending_get: Optional[int] = None
         self._dirty = False     # True once this instance has pushed grads
+        # Real worker id: in sync mode the BSP vector clocks are per-worker,
+        # so every worker's adds must tick its OWN clock (worker_id=0 for
+        # everyone would wedge the get gate at world>1).
+        wid = max(mv.worker_id(), 0)
         if is_ftrl:
             self._add_option = AddOption(
-                learning_rate=cfg.ftrl_alpha, rho=cfg.ftrl_beta,
-                lambda_=cfg.ftrl_l1, momentum=cfg.ftrl_l2)
+                worker_id=wid, learning_rate=cfg.ftrl_alpha,
+                rho=cfg.ftrl_beta, lambda_=cfg.ftrl_l1,
+                momentum=cfg.ftrl_l2)
         else:
-            self._add_option = AddOption(learning_rate=cfg.learning_rate)
+            self._add_option = AddOption(worker_id=wid,
+                                         learning_rate=cfg.learning_rate)
 
     def update(self, X: np.ndarray, y: np.ndarray):
         """Returns the loss as a device scalar (no host sync)."""
@@ -243,6 +249,12 @@ class PSModel:
               "warm start requires a fresh (zero) PS table — construct a "
               "new LogReg with init_model_file instead of calling "
               "load_model on a trained one")
+        # _dirty only tracks THIS instance; an injected/shared table may
+        # have been trained elsewhere. Ask the server (one init-time pull;
+        # symmetric across workers, so BSP-safe).
+        check(not np.any(self.table.get()),
+              "warm start requires a fresh (zero) PS table — the shared "
+              "table already holds trained weights")
         w = np.asarray(w, dtype=np.float32).reshape(self.cfg.width,
                                                     self.cfg.num_class)
         # sgd updater applies data -= delta, so the master pushes -w.

@@ -66,6 +66,9 @@ class Word2VecConfig:
     # Device pipeline (sg+ns): pair-gen/subsample/negatives on device;
     # host uploads raw token ids only.
     device_pipeline: bool = False
+    # Compact valid pairs to the front of the device pair stream and skip
+    # all-padding chunks (~2x fewer chunk steps at typical subsample rates).
+    compact_pairs: bool = True
     block_sentences: int = 512      # sentences per device block
     pad_sentence_length: int = 512  # fixed sentence pad (longer ones split)
     max_code_length: int = 40
@@ -210,7 +213,8 @@ def raw_cbow_hs_step(adagrad: bool):
 
 
 def build_device_block_step(window: int, negative: int, chunk: int,
-                            table_size: int, adagrad: bool):
+                            table_size: int, adagrad: bool,
+                            compact: bool = True):
     """Whole-block training step with ON-DEVICE pair generation.
 
     The host uploads only raw token ids ([S, L] padded sentences + lengths)
@@ -218,9 +222,19 @@ def build_device_block_step(window: int, negative: int, chunk: int,
     window pair extraction, unigram negative sampling,
     ``wordembedding.cpp:120-135`` / ``sampler.cpp``) happens inside one
     jitted program: masked offset-shift pairing (static shapes), PRNG-driven
-    subsample/window/negative draws, then a ``lax.scan`` over fixed-size
-    chunks of pairs through the fused update. Host->device traffic per block
-    drops from ~40 bytes/pair to 4 bytes/word.
+    subsample/window/negative draws, then a loop over fixed-size chunks of
+    pairs through the fused update. Host->device traffic per block drops
+    from ~40 bytes/pair to 4 bytes/word.
+
+    ``compact=True`` additionally scatter-compacts the valid pairs to the
+    front of the stream (cumsum positions + masked scatter — cheap int32
+    traffic) and runs a dynamic-trip-count ``fori_loop`` over only the
+    chunks that hold real pairs. The fixed window-d shift construction
+    leaves ~half the slots masked (subsampled words, shrunk windows,
+    sentence pads); without compaction every one of those slots still pays
+    its (2+K)·D gather/einsum/scatter. With it the per-block compute is
+    proportional to true pairs — the TPU answer to the reference's exact
+    dynamic-window pair loop (``wordembedding.cpp:120-135``).
     """
     raw = raw_sg_ns_step(adagrad)
 
@@ -249,16 +263,54 @@ def build_device_block_step(window: int, negative: int, chunk: int,
 
         P = centers.shape[0]
         pad = (-P) % chunk
-        centers = jnp.pad(centers, (0, pad))
-        contexts = jnp.pad(contexts, (0, pad))
-        pmask = jnp.pad(pmask, (0, pad))
-        n = (P + pad) // chunk
+        total = P + pad
+        n = total // chunk
+        n_pairs = pmask.sum()
+
+        if compact:
+            # Stable partition of valid pairs to the front: destination =
+            # rank among valid pairs; invalid slots scatter out of bounds
+            # and drop.
+            dest = jnp.cumsum(pmask.astype(jnp.int32)) - 1
+            dest = jnp.where(pmask, dest, total)
+            centers = (jnp.zeros(total, centers.dtype)
+                       .at[dest].set(centers, mode="drop"))
+            contexts = (jnp.zeros(total, contexts.dtype)
+                        .at[dest].set(contexts, mode="drop"))
+        else:
+            centers = jnp.pad(centers, (0, pad))
+            contexts = jnp.pad(contexts, (0, pad))
         centers = centers.reshape(n, chunk)
         contexts = contexts.reshape(n, chunk)
-        mask = pmask.reshape(n, chunk).astype(jnp.float32)
         neg_idx = jax.random.randint(k_neg, (n, chunk, negative), 0,
                                      table_size)
         negatives = jnp.take(neg_table, neg_idx, mode="clip")
+
+        if compact:
+            # After compaction the first n_pairs slots are exactly the
+            # valid pairs, so only ceil(n_pairs/chunk) chunks carry work.
+            n_live = (n_pairs.astype(jnp.int32) + chunk - 1) // chunk
+            lane = jnp.arange(chunk)
+
+            def body(i, carry):
+                *tables, loss = carry
+                c = jax.lax.dynamic_index_in_dim(centers, i, keepdims=False)
+                o = jax.lax.dynamic_index_in_dim(contexts, i,
+                                                 keepdims=False)
+                neg = jax.lax.dynamic_index_in_dim(negatives, i,
+                                                   keepdims=False)
+                m = ((i * chunk + lane) <
+                     n_pairs.astype(jnp.int32)).astype(jnp.float32)
+                out = raw(*tables, c, o, neg, m, lr)
+                return (*out[:4], loss + out[4])
+
+            carry = jax.lax.fori_loop(
+                0, n_live, body,
+                (w_in, w_out, g_in, g_out, jnp.float32(0.0)))
+            return (*carry, n_pairs)
+
+        mask = jnp.pad(pmask, (0, pad)).reshape(n, chunk) \
+                  .astype(jnp.float32)
 
         def body(carry, xs):
             c, o, m, neg = xs
@@ -268,7 +320,7 @@ def build_device_block_step(window: int, negative: int, chunk: int,
         carry, losses = jax.lax.scan(
             body, (w_in, w_out, g_in, g_out),
             (centers, contexts, mask, negatives))
-        return (*carry, losses.sum(), pmask.sum())
+        return (*carry, losses.sum(), n_pairs)
 
     return jax.jit(block_step, donate_argnums=(0, 1, 2, 3))
 
@@ -353,7 +405,7 @@ class Word2Vec:
                 .astype(np.float32))
             self._block_step = build_device_block_step(
                 cfg.window, cfg.negative, cfg.batch_size,
-                len(sampler.table), adagrad)
+                len(sampler.table), adagrad, compact=cfg.compact_pairs)
             self._key = jax.random.PRNGKey(cfg.seed)
 
         self.total_words = dictionary.total_count * max(cfg.epochs, 1)

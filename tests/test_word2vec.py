@@ -153,6 +153,71 @@ def test_save_embeddings(tmp_path, mv_env):
     assert len(first) == 9
 
 
+def test_pair_compaction_identity_when_all_valid(mv_env):
+    """window=1 + no subsampling leaves every pair slot valid, so the
+    compaction scatter is the identity permutation and the compacted
+    fori_loop must reproduce the uncompacted scan path bitwise (same key →
+    same negatives per chunk slot)."""
+    import jax
+    import jax.numpy as jnp
+    from multiverso_tpu.models.word2vec.model import build_device_block_step
+
+    rng = np.random.default_rng(0)
+    V, D, S, L, chunk = 50, 16, 4, 8, 16
+    neg_table = jnp.asarray(rng.integers(0, V, size=997).astype(np.int32))
+    keep_prob = jnp.ones(V, dtype=np.float32)
+    sents = jnp.asarray(rng.integers(0, V, size=(S, L)).astype(np.int32))
+    lengths = jnp.full((S,), L, dtype=jnp.int32)
+    key = jax.random.PRNGKey(7)
+
+    outs = []
+    for compact in (False, True):
+        step = build_device_block_step(window=1, negative=3, chunk=chunk,
+                                       table_size=997, adagrad=True,
+                                       compact=compact)
+        w_in = jnp.asarray(rng0 := np.random.default_rng(1)
+                           .normal(size=(V, D)).astype(np.float32))
+        w_out = jnp.zeros((V, D), jnp.float32)
+        g_in = jnp.zeros((V, D), jnp.float32)
+        g_out = jnp.zeros((V, D), jnp.float32)
+        outs.append(step(w_in, w_out, g_in, g_out, neg_table, keep_prob,
+                         sents, lengths, key, jnp.float32(0.05)))
+    # P = S*(L-1)*2 = 56 -> padded to 64, all 56 valid
+    assert int(outs[0][5]) == int(outs[1][5]) == S * (L - 1) * 2
+    for a, b in zip(outs[0][:5], outs[1][:5]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pair_compaction_counts_and_loss_with_masking(mv_env):
+    """Partial masks (shrunk windows + short sentences): compacted path must
+    report the same true-pair count as the scan path and produce a finite
+    loss; updates must only touch rows that appear in valid pairs."""
+    import jax
+    import jax.numpy as jnp
+    from multiverso_tpu.models.word2vec.model import build_device_block_step
+
+    rng = np.random.default_rng(3)
+    V, D, S, L, chunk = 60, 8, 6, 12, 32
+    neg_table = jnp.asarray(rng.integers(0, V, size=499).astype(np.int32))
+    keep_prob = jnp.ones(V, dtype=np.float32)
+    sents = jnp.asarray(rng.integers(1, V, size=(S, L)).astype(np.int32))
+    lengths = jnp.asarray(rng.integers(2, L + 1, size=S).astype(np.int32))
+    key = jax.random.PRNGKey(11)
+    args = (neg_table, keep_prob, sents, lengths, key, jnp.float32(0.05))
+
+    counts, losses = [], []
+    for compact in (False, True):
+        step = build_device_block_step(window=4, negative=2, chunk=chunk,
+                                       table_size=499, adagrad=False,
+                                       compact=compact)
+        zeros = [jnp.zeros((V, D), jnp.float32) for _ in range(4)]
+        out = step(*zeros, *args)
+        counts.append(int(out[5]))
+        losses.append(float(out[4]))
+    assert counts[0] == counts[1] > 0
+    assert np.isfinite(losses[1])
+
+
 def test_device_pipeline_matches_host_semantics(mv_env):
     """Device-side pair-gen path must train to the same topic separation."""
     sents = _corpus(300)
