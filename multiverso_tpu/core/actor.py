@@ -36,6 +36,9 @@ class MsgType(enum.IntEnum):
     Server_Finish_Train = 31
     Control_Barrier = 33
     Control_Register = 34
+    Control_Lookup = 35
+    Reply_Register = -34
+    Reply_Lookup = -35
     Heartbeat = 40
     Heartbeat_Reply = -40
     Exit = 99

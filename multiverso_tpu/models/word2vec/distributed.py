@@ -76,6 +76,7 @@ class DistributedWord2Vec:
         # rows, accumulate locally, and push back the delta scaled by
         # 1/num_workers, the same scaling the reference applies to every
         # table's delta (GetDeltaLoop, communicator.cpp:167).
+        self.g_in = self.g_out = None
         if self._adagrad:
             self.g_in = DistributedMatrixTable(self.TABLE_G_IN, V, D,
                                                service, peers, rank)
@@ -207,16 +208,21 @@ class DistributedWord2Vec:
 
         # Push averaged deltas (AddDeltaParameter analog): the reference
         # divides EVERY table's delta by the worker count, accumulators
-        # included (communicator.cpp:167).
+        # included (communicator.cpp:167). Async pushes: deltas stage in the
+        # native buffer and flush as one frame per server when the next
+        # block's pull arrives on the same FIFO stream (GetDeltaLoop's
+        # overlap, distributed_wordembedding.cpp:157-171, without its
+        # per-request reply waits).
         scale = 1.0 / self.num_workers
-        self.w_in.add_rows(ids_in, (np.asarray(new_in) - old_in) * scale)
-        self.w_out.add_rows(ids_out,
-                            (np.asarray(new_out) - old_out) * scale)
+        self.w_in.add_rows_async(ids_in,
+                                 (np.asarray(new_in) - old_in) * scale)
+        self.w_out.add_rows_async(ids_out,
+                                  (np.asarray(new_out) - old_out) * scale)
         if self._adagrad:
-            self.g_in.add_rows(ids_in,
-                               (np.asarray(new_gin) - old_gin) * scale)
-            self.g_out.add_rows(ids_out,
-                                (np.asarray(new_gout) - old_gout) * scale)
+            self.g_in.add_rows_async(ids_in,
+                                     (np.asarray(new_gin) - old_gin) * scale)
+            self.g_out.add_rows_async(
+                ids_out, (np.asarray(new_gout) - old_gout) * scale)
         return sum(len(s) for s in block)
 
     # -- training ---------------------------------------------------------------
@@ -243,6 +249,11 @@ class DistributedWord2Vec:
             for block in BlockStream(iter(sentences), self.cfg.block_words,
                                      prefetch=self.cfg.pipeline):
                 self.trained_words += self._train_block(block)
+        # Drain staged pushes so peers (e.g. the saving master) see this
+        # worker's last deltas after their barrier.
+        for table in (self.w_in, self.w_out, self.g_in, self.g_out):
+            if table is not None:
+                table.flush(wait=True)
         elapsed = time.perf_counter() - t0
         self.words_per_sec = self.trained_words / max(elapsed, 1e-9)
         return {"words": self.trained_words,

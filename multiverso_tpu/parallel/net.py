@@ -61,6 +61,44 @@ def send_message(sock: socket.socket, msg: Message) -> None:
     sock.sendall(pack_message(msg))
 
 
+def parse_frame(buf) -> Tuple[Optional[Message], int]:
+    """Incremental decode for selector-driven servers: returns
+    ``(message, bytes_consumed)`` or ``(None, 0)`` when the buffer does not
+    yet hold one complete frame. Blob payloads are copied out so the caller
+    may immediately compact its receive buffer."""
+    n = len(buf)
+    if n < _MAGIC.size + _HEADER.size:
+        return None, 0
+    (value,) = _MAGIC.unpack_from(buf, 0)
+    if value != _MAGIC_VALUE:
+        raise IOError("bad frame magic")
+    off = _MAGIC.size
+    mtype, table_id, msg_id, src, n_blobs = _HEADER.unpack_from(buf, off)
+    off += _HEADER.size
+    data: List[np.ndarray] = []
+    for _ in range(n_blobs):
+        if n < off + _BLOB_HEADER.size:
+            return None, 0
+        dtype_tag, ndim = _BLOB_HEADER.unpack_from(buf, off)
+        off += _BLOB_HEADER.size
+        if n < off + 8 * ndim + 8:
+            return None, 0
+        shape: Tuple[int, ...] = ()
+        if ndim:
+            shape = struct.unpack_from(f"<{ndim}q", buf, off)
+            off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<q", buf, off)
+        off += 8
+        if n < off + nbytes:
+            return None, 0
+        arr = np.frombuffer(bytes(buf[off:off + nbytes]),
+                            dtype=np.dtype(dtype_tag.rstrip(b"\0").decode()))
+        off += nbytes
+        data.append(arr.reshape(shape))
+    return Message(src=src, type=mtype, table_id=table_id, msg_id=msg_id,
+                   data=data), off
+
+
 def recv_message(sock: socket.socket) -> Optional[Message]:
     """Blocking read of one framed message; None on clean EOF."""
     magic = _recv_exact(sock, _MAGIC.size)

@@ -244,3 +244,319 @@ def test_net_bind_connect_api():
         np.testing.assert_allclose(t.get(), np.ones(16))
     finally:
         mv2.shutdown()
+
+
+# -- real async surface (round 2: VERDICT #3) -------------------------------
+def test_add_async_staging_merges_wire_messages(two_rank_world, monkeypatch):
+    """N staged add_async calls must become ONE Request_Add frame per remote
+    server at flush, and the merged sum must land."""
+    import multiverso_tpu.parallel.ps_service as pss
+
+    svc0, svc1, peers = two_rank_world
+    t0 = DistributedArrayTable(30, 64, svc0, peers, rank=0)
+    DistributedArrayTable(30, 64, svc1, peers, rank=1)
+
+    sent_adds = []
+    orig = pss.send_message
+
+    def counting(sock, msg):
+        if msg.type == MsgType.Request_Add:
+            sent_adds.append(msg)
+        orig(sock, msg)
+
+    monkeypatch.setattr(pss, "send_message", counting)
+    ids = [t0.add_async(np.full(64, float(i + 1), dtype=np.float32))
+           for i in range(8)]
+    assert sent_adds == []            # all staged, nothing on the wire yet
+    got = t0.get()                    # get flushes first (read-your-writes)
+    assert len(sent_adds) == 1        # one merged frame to the one peer
+    np.testing.assert_allclose(got, np.full(64, 36.0))
+    for i in ids:                     # staged ids resolve to the flush batch
+        t0.wait(i)
+
+
+def test_get_async_returns_before_reply(two_rank_world):
+    """get_async must issue the wire request and return immediately even
+    when the serving peer is slow; wait() then assembles the reply."""
+    svc0, svc1, peers = two_rank_world
+    t0 = DistributedArrayTable(31, 40, svc0, peers, rank=0)
+    DistributedArrayTable(31, 40, svc1, peers, rank=1)
+    t0.add(np.arange(40, dtype=np.float32))
+
+    orig = svc1._dispatch_control
+
+    def slow(msg):
+        time.sleep(0.5)
+        return orig(msg)
+
+    svc1._dispatch_control = slow
+    start = time.perf_counter()
+    msg_id = t0.get_async()
+    issue_time = time.perf_counter() - start
+    result = t0.wait(msg_id)
+    total_time = time.perf_counter() - start
+    assert issue_time < 0.2, f"get_async blocked for {issue_time:.2f}s"
+    assert total_time >= 0.5          # the reply really was slow
+    np.testing.assert_allclose(result, np.arange(40))
+
+
+def test_stateful_updater_fire_and_forget_matches_blocking(two_rank_world):
+    """AdaGrad (non-stageable) adds fire without waiting but apply in FIFO
+    order per connection — final state must equal the blocking sequence."""
+    from multiverso_tpu.core.options import AddOption
+
+    svc0, svc1, peers = two_rank_world
+    t_async = DistributedArrayTable(32, 20, svc0, peers, rank=0,
+                                    updater="adagrad")
+    DistributedArrayTable(32, 20, svc1, peers, rank=1, updater="adagrad")
+    t_block = DistributedArrayTable(33, 20, svc0, peers, rank=0,
+                                    updater="adagrad")
+    DistributedArrayTable(33, 20, svc1, peers, rank=1, updater="adagrad")
+
+    opt = AddOption(learning_rate=0.1, rho=0.9)
+    for i in range(3):
+        delta = np.full(20, float(i + 1), dtype=np.float32)
+        t_async.add_async(delta, opt)
+        t_block.add(delta, opt)
+    t_async.flush(wait=True)
+    t_async.local_store.block()
+    np.testing.assert_allclose(t_async.get(), t_block.get(), rtol=1e-6)
+
+
+def test_pipelined_pull_overlaps_compute(two_rank_world):
+    """The double-buffer pattern (ref ps_model.cpp:236-271): with a slow
+    server, issue-next-pull-then-compute must beat pull-then-compute."""
+    svc0, svc1, peers = two_rank_world
+    t0 = DistributedArrayTable(34, 16, svc0, peers, rank=0)
+    DistributedArrayTable(34, 16, svc1, peers, rank=1)
+    t0.add(np.ones(16, dtype=np.float32))
+
+    delay, compute, rounds = 0.15, 0.15, 4
+    orig = svc1._dispatch_control
+
+    def slow(msg):
+        time.sleep(delay)
+        return orig(msg)
+
+    svc1._dispatch_control = slow
+
+    start = time.perf_counter()
+    for _ in range(rounds):
+        t0.get()
+        time.sleep(compute)           # un-overlapped: serial pull + compute
+    serial = time.perf_counter() - start
+
+    start = time.perf_counter()
+    pending = t0.get_async()
+    for _ in range(rounds):
+        time.sleep(compute)           # compute overlaps the in-flight pull
+        t0.wait(pending)
+        pending = t0.get_async()
+    t0.wait(pending)
+    pipelined = time.perf_counter() - start
+    assert pipelined < serial * 0.85, (
+        f"no overlap: pipelined {pipelined:.2f}s vs serial {serial:.2f}s")
+
+
+def test_matrix_add_rows_async_staging(two_rank_world, monkeypatch):
+    """Row adds stage in the native buffer: duplicates merge, one wire frame
+    per touched server at flush."""
+    import multiverso_tpu.parallel.ps_service as pss
+
+    svc0, svc1, peers = two_rank_world
+    m0 = DistributedMatrixTable(35, 20, 4, svc0, peers, rank=0)
+    DistributedMatrixTable(35, 20, 4, svc1, peers, rank=1)
+
+    sent_adds = []
+    orig = pss.send_message
+
+    def counting(sock, msg):
+        if msg.type == MsgType.Request_Add:
+            sent_adds.append(msg)
+        orig(sock, msg)
+
+    monkeypatch.setattr(pss, "send_message", counting)
+    # rows 5 (local shard) and 15 (remote shard), added twice each
+    for _ in range(2):
+        m0.add_rows_async([5, 15], np.ones((2, 4), dtype=np.float32))
+    assert sent_adds == []
+    got = m0.get_rows([5, 15])
+    assert len(sent_adds) == 1
+    np.testing.assert_allclose(got, np.full((2, 4), 2.0))
+
+
+def test_world16_stress_bounded_threads(mv_env):
+    """Hardening (VERDICT r1 #10): 16 ranks, all-to-all traffic — each
+    service must hold its fixed 2-thread budget (selector IO + dispatcher),
+    and every rank must observe the full accumulated state."""
+    import threading as _threading
+
+    world = 16
+    services = [PSService() for _ in range(world)]
+    peers = [s.address for s in services]
+    tables = [DistributedArrayTable(40, 160, services[r], peers, rank=r)
+              for r in range(world)]
+    for svc in services:
+        assert svc.num_service_threads == 2
+
+    before = _threading.active_count()
+    errors = []
+
+    def worker(r):
+        try:
+            for i in range(5):
+                tables[r].add_async(np.full(160, 1.0, dtype=np.float32))
+            tables[r].flush(wait=True)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [_threading.Thread(target=worker, args=(r,))
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors[0]
+    # each service still exactly 2 threads despite 15 inbound connections
+    for svc in services:
+        assert svc.num_service_threads == 2
+    expected = np.full(160, float(world * 5), dtype=np.float32)
+    for r in (0, 7, 15):
+        np.testing.assert_allclose(tables[r].get(), expected)
+    for t_ in tables:
+        t_.close()
+    for s in services:
+        s.close()
+
+
+# -- wire compression (round 2: VERDICT #5) ---------------------------------
+def _count_wire_bytes(monkeypatch, kinds):
+    """Patch ps_service.send_message to tally packed bytes by msg type."""
+    import multiverso_tpu.parallel.ps_service as pss
+    from multiverso_tpu.parallel.net import pack_message
+
+    counts = {k: 0 for k in kinds}
+    orig = pss.send_message
+
+    def counting(sock, msg):
+        if msg.type in counts:
+            counts[msg.type] += len(pack_message(msg))
+        orig(sock, msg)
+
+    monkeypatch.setattr(pss, "send_message", counting)
+    return counts
+
+
+def test_wire_sparse_filter_reduces_bytes(two_rank_world, monkeypatch):
+    """A 95%-zero delta must cross the wire sparse (FilterIn analog) and
+    reconstruct exactly (FilterOut); bytes on the wire must shrink."""
+    from multiverso_tpu.utils.configure import set_flag
+
+    svc0, svc1, peers = two_rank_world
+    t0 = DistributedArrayTable(50, 4000, svc0, peers, rank=0)
+    DistributedArrayTable(50, 4000, svc1, peers, rank=1)
+
+    rng = np.random.default_rng(0)
+    delta = np.zeros(4000, dtype=np.float32)
+    hot = rng.choice(4000, size=200, replace=False)
+    delta[hot] = rng.normal(size=200).astype(np.float32)
+
+    counts = _count_wire_bytes(monkeypatch,
+                               (MsgType.Request_Add, MsgType.Reply_Get))
+    set_flag("wire_compression", "none")
+    t0.add(delta)
+    raw_add = counts[MsgType.Request_Add]
+
+    set_flag("wire_compression", "sparse")
+    t0.add(delta)
+    sparse_add = counts[MsgType.Request_Add] - raw_add
+    assert sparse_add < raw_add * 0.35, (raw_add, sparse_add)
+
+    got = t0.get()                  # reply leg also filtered (mostly zeros)
+    np.testing.assert_allclose(got, 2 * delta)
+    assert counts[MsgType.Reply_Get] < raw_add * 0.5
+
+
+def test_wire_onebit_error_feedback_converges(two_rank_world):
+    """OneBit mode quantizes add payloads to sign bits + scales with
+    sender-held error feedback: K pushes of the same delta must accumulate
+    to ~K*delta (residual stays bounded), and the flag must not corrupt
+    get replies (absolute values never quantized)."""
+    from multiverso_tpu.utils.configure import set_flag
+
+    svc0, svc1, peers = two_rank_world
+    t0 = DistributedArrayTable(51, 64, svc0, peers, rank=0)
+    DistributedArrayTable(51, 64, svc1, peers, rank=1)
+
+    rng = np.random.default_rng(1)
+    delta = rng.normal(size=64).astype(np.float32)
+    set_flag("wire_compression", "onebit")
+    try:
+        K = 50
+        for _ in range(K):
+            t0.add(delta)
+        got = t0.get()
+    finally:
+        set_flag("wire_compression", "sparse")
+    # local shard (rank 0's half) is exact; remote half is 1-bit quantized
+    # with error feedback: accumulated error == the sender-held residual,
+    # which stays BOUNDED independent of K (measured ~14 for this seed at
+    # K=50..5000), so the relative error vanishes as 1/K.
+    np.testing.assert_allclose(got[:32], K * delta[:32], rtol=1e-5)
+    err = np.abs(got[32:] - K * delta[32:])
+    assert err.max() < 20.0, err.max()
+    assert err.max() / K < np.abs(delta[32:]).mean()
+
+
+def test_elastic_auto_readmission_no_manual_reconnect(mv_env):
+    """Round-2 elastic membership (VERDICT #7): rank 1 dies and restarts at
+    a NEW address. Its table construction re-registers with the rank-0
+    directory; rank 0's next failed request rediscovers the address through
+    the directory and traffic resumes — NO reconnect() call anywhere."""
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    t0 = DistributedArrayTable(60, 40, svc0, peers, rank=0)
+    t1 = DistributedArrayTable(60, 40, svc1, peers, rank=1)
+    t0.add(np.arange(40, dtype=np.float32))
+    np.testing.assert_allclose(t0.get(), np.arange(40))
+
+    shard_snapshot = t1.local_store.store_state()
+    svc1.close()                 # rank 1 dies
+    time.sleep(0.3)
+
+    # rank 1 restarts at a new port; enable_directory re-registers it
+    svc1b = PSService()
+    t1b = DistributedArrayTable(60, 40, svc1b,
+                                [peers[0], svc1b.address], rank=1)
+    t1b.local_store.load_state(shard_snapshot)
+
+    # rank 0 still points at the DEAD address; the failed request must
+    # rediscover the new one through the directory automatically
+    got = t0.get()
+    np.testing.assert_allclose(got, np.arange(40))
+    t0.add(np.ones(40, dtype=np.float32))
+    assert t0.get()[39] == pytest.approx(40.0)
+    svc0.close(); svc1b.close()
+
+
+def test_reply_leg_never_clips_parameter_values(two_rank_world):
+    """A user clip threshold sparsifies add DELTAS; Get replies carry
+    absolute parameters and must come back exact even when most weights are
+    inside the clip band (regression: review r2 finding)."""
+    from multiverso_tpu.utils.configure import set_flag
+
+    svc0, svc1, peers = two_rank_world
+    t0 = DistributedArrayTable(70, 40, svc0, peers, rank=0)
+    DistributedArrayTable(70, 40, svc1, peers, rank=1)
+    small = np.full(40, 0.01, dtype=np.float32)   # all inside the clip band
+    set_flag("wire_compression_clip", 0.5)
+    try:
+        t0.add(np.ones(40, dtype=np.float32))     # deltas above clip: exact
+        got = t0.get()
+    finally:
+        set_flag("wire_compression_clip", 0.0)
+    np.testing.assert_allclose(got, np.ones(40))
+    # now push values INTO the band and confirm the pull stays exact
+    set_flag("wire_compression_clip", 0.0)
+    t0.add(small - 1.0)
+    np.testing.assert_allclose(t0.get(), small, rtol=1e-6)
