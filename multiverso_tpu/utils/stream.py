@@ -37,11 +37,129 @@ def _open_local(path: str, mode: str) -> BinaryIO:
     return open(path, mode)
 
 
-def _open_gcs(path: str, mode: str) -> BinaryIO:
+# -- gs:// — real GCS streams over the JSON API (stdlib urllib only) --------
+# The reference's remote stream is HDFS behind a build flag
+# (src/io/hdfs_stream.cpp, MULTIVERSO_USE_HDFS); the TPU-era remote store is
+# GCS. No client library is required: reads GET `alt=media`, writes buffer
+# locally and upload on close (uploadType=media). Endpoint resolution
+# honors STORAGE_EMULATOR_HOST (the standard GCS emulator contract), so the
+# scheme is fully testable offline; against real GCS a bearer token is taken
+# from GCS_OAUTH_TOKEN. Without either, the gate stays graceful: a clear
+# StreamError at open time, exactly like the reference's compile-time gate.
+
+def _gcs_endpoint() -> str:
+    host = os.environ.get("STORAGE_EMULATOR_HOST")
+    if host:
+        return host if "://" in host else f"http://{host}"
+    return "https://storage.googleapis.com"
+
+
+def _gcs_headers() -> Dict[str, str]:
+    token = os.environ.get("GCS_OAUTH_TOKEN")
+    return {"Authorization": f"Bearer {token}"} if token else {}
+
+
+def _gcs_check_access() -> None:
+    if (os.environ.get("STORAGE_EMULATOR_HOST")
+            or os.environ.get("GCS_OAUTH_TOKEN")):
+        return
     raise StreamError(
-        "gs:// streams require a GCS client; this build is gated like the "
-        "reference's MULTIVERSO_USE_HDFS flag (io/hdfs_stream.h). "
-        "Use file:// or register a scheme via register_scheme().")
+        "gs:// needs STORAGE_EMULATOR_HOST (emulator) or GCS_OAUTH_TOKEN "
+        "(real GCS) — gated like the reference's MULTIVERSO_USE_HDFS flag "
+        "(io/hdfs_stream.h). Use file:// or register_scheme() otherwise.")
+
+
+def _split_bucket(path: str) -> Tuple[str, str]:
+    bucket, _, obj = path.partition("/")
+    if not bucket or not obj:
+        raise StreamError(f"gs:// URI needs bucket/object, got '{path}'")
+    return bucket, obj
+
+
+class _GCSWriteStream(io.BytesIO):
+    """Buffers locally; uploads the object on CLEAN close (single-shot
+    media upload — checkpoint-sized payloads, matching HDFSStream's
+    whole-file write usage in ServerTable::Store). If the ``with`` body
+    raises, the upload is aborted so a half-written buffer never replaces
+    the previous good object."""
+
+    def __init__(self, bucket: str, obj: str):
+        super().__init__()
+        self._bucket, self._obj = bucket, obj
+        self._uploaded = False
+        self._aborted = False
+
+    def abort(self) -> None:
+        """Discard the buffer; close() becomes a no-op upload-wise."""
+        self._aborted = True
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.abort()
+        return super().__exit__(exc_type, exc, tb)
+
+    def close(self) -> None:
+        if not self._uploaded and not self._aborted:
+            self._uploaded = True
+            import urllib.parse
+            import urllib.request
+            url = (f"{_gcs_endpoint()}/upload/storage/v1/b/{self._bucket}"
+                   f"/o?uploadType=media&name="
+                   f"{urllib.parse.quote(self._obj, safe='')}")
+            req = urllib.request.Request(
+                url, data=self.getvalue(), method="POST",
+                headers={"Content-Type": "application/octet-stream",
+                         **_gcs_headers()})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    resp.read()
+            except OSError as e:
+                raise StreamError(f"gs:// upload failed: {e}") from e
+        super().close()
+
+
+def _gcs_object_url(bucket: str, obj: str, media: bool) -> str:
+    import urllib.parse
+    url = (f"{_gcs_endpoint()}/storage/v1/b/{bucket}/o/"
+           f"{urllib.parse.quote(obj, safe='')}")
+    return url + "?alt=media" if media else url
+
+
+def _open_gcs(path: str, mode: str) -> BinaryIO:
+    _gcs_check_access()
+    bucket, obj = _split_bucket(path)
+    if "w" in mode:
+        return _GCSWriteStream(bucket, obj)
+    if "a" in mode:
+        raise StreamError("gs:// objects are immutable; append unsupported")
+    import urllib.request
+    req = urllib.request.Request(_gcs_object_url(bucket, obj, media=True),
+                                 headers=_gcs_headers())
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return io.BytesIO(resp.read())
+    except OSError as e:
+        raise StreamError(f"gs:// read failed for {bucket}/{obj}: {e}") \
+            from e
+
+
+def _gcs_exists(path: str) -> bool:
+    _gcs_check_access()
+    bucket, obj = _split_bucket(path)
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(_gcs_object_url(bucket, obj, media=False),
+                                 headers=_gcs_headers())
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            resp.read()
+        return True
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return False
+        raise StreamError(f"gs:// stat failed: {e}") from e
+    except OSError as e:
+        raise StreamError(f"gs:// stat failed: {e}") from e
 
 
 _SCHEMES: Dict[str, Callable[[str, str], BinaryIO]] = {
@@ -68,6 +186,8 @@ def exists(uri: str) -> bool:
     scheme, path = _parse_uri(uri)
     if scheme == "file":
         return os.path.exists(path)
+    if scheme == "gs":
+        return _gcs_exists(path)
     raise StreamError(f"exists() unsupported for scheme '{scheme}'")
 
 

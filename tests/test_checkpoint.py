@@ -161,3 +161,115 @@ def test_bf16_momentum_state_dtype_roundtrip(tmp_path, mv_env):
     # next update must not retrace to f32 nor change table dtype
     t.add(np.ones((8, 4), dtype=np.float32), mv.AddOption(momentum=0.5))
     assert str(t.store.data.dtype) == "bfloat16"
+
+
+# -- gs:// (round 2: VERDICT #9) --------------------------------------------
+class _FakeGCS:
+    """In-memory GCS emulator speaking the slice of the JSON API the stream
+    uses: media GET, metadata GET, media upload POST."""
+
+    def __init__(self):
+        import http.server
+        import threading
+        import urllib.parse
+
+        store = self.store = {}
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802 - silence
+                pass
+
+            def _object_key(self):
+                # /storage/v1/b/<bucket>/o/<object>[?alt=media]
+                path, _, query = self.path.partition("?")
+                parts = path.split("/")
+                bucket, obj = parts[4], urllib.parse.unquote(parts[6])
+                return f"{bucket}/{obj}", "alt=media" in query
+
+            def do_GET(self):  # noqa: N802
+                key, media = self._object_key()
+                if key not in store:
+                    self.send_response(404); self.end_headers(); return
+                body = store[key] if media else b"{}"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                # /upload/storage/v1/b/<bucket>/o?uploadType=media&name=X
+                import urllib.parse as up
+                path, _, query = self.path.partition("?")
+                bucket = path.split("/")[5]
+                name = up.unquote(dict(up.parse_qsl(query))["name"])
+                n = int(self.headers["Content-Length"])
+                store[f"{bucket}/{name}"] = self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.address = f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        self.server.shutdown()
+
+
+@pytest.fixture
+def fake_gcs(monkeypatch):
+    gcs = _FakeGCS()
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", gcs.address)
+    yield gcs
+    gcs.close()
+
+
+def test_gcs_stream_roundtrip_and_exists(fake_gcs):
+    with open_stream("gs://bucket/dir/blob.bin", "w") as s:
+        s.write(b"payload-123")
+    assert fake_gcs.store["bucket/dir/blob.bin"] == b"payload-123"
+    with open_stream("gs://bucket/dir/blob.bin", "r") as s:
+        assert s.read() == b"payload-123"
+    assert exists("gs://bucket/dir/blob.bin")
+    assert not exists("gs://bucket/missing")
+    with pytest.raises(StreamError):
+        open_stream("gs://bucket/missing", "r")
+
+
+def test_gcs_gate_without_emulator_or_token(monkeypatch):
+    monkeypatch.delenv("STORAGE_EMULATOR_HOST", raising=False)
+    monkeypatch.delenv("GCS_OAUTH_TOKEN", raising=False)
+    with pytest.raises(StreamError, match="STORAGE_EMULATOR_HOST"):
+        open_stream("gs://bucket/obj", "r")
+
+
+def test_checkpoint_through_gcs_scheme(fake_gcs, mv_env):
+    """A table checkpoint written through gs:// must restore bit-exact —
+    the reference's HDFS Store/Load path (src/io/hdfs_stream.cpp) at GCS."""
+    from multiverso_tpu.core import checkpoint as ckpt
+
+    table = mv_env.create_table(mv_env.ArrayTableOption(
+        size=64, name="gcs_ckpt"))
+    table.add(np.arange(64, dtype=np.float32))
+    ckpt.save_table(table, "gs://ckpts/run1/table.npz")
+
+    table.add(np.ones(64, dtype=np.float32))   # diverge
+    ckpt.load_table(table, "gs://ckpts/run1/table.npz")
+    np.testing.assert_allclose(table.get(), np.arange(64))
+
+
+def test_gcs_aborted_write_preserves_old_object(fake_gcs):
+    """An exception inside the with-body must NOT replace the object with a
+    truncated buffer (regression: review r2 finding)."""
+    with open_stream("gs://bucket/ckpt.bin", "w") as s:
+        s.write(b"good-checkpoint")
+    with pytest.raises(RuntimeError):
+        with open_stream("gs://bucket/ckpt.bin", "w") as s:
+            s.write(b"half-")
+            raise RuntimeError("died mid-write")
+    with open_stream("gs://bucket/ckpt.bin", "r") as s:
+        assert s.read() == b"good-checkpoint"
