@@ -89,16 +89,21 @@ class ServerStore:
             self.state[key] = jax.device_put(leaf, leaf_sharding)
 
         # Opt-in Pallas row data plane (DMA gather / sorted scatter-add,
-        # ops/pallas_rows.py). Narrow eligibility by design: 2-D float32
-        # tables on a single shard with the plain accumulating updater —
-        # the per-row hot path the kernels target. Everything else uses
-        # the XLA gather/scatter path.
+        # ops/pallas_rows.py). Eligibility (widened round 2): 2-D float32
+        # tables, plain-add or SGD updaters (sign-flipped scatter), single
+        # shard. bf16 is EXCLUDED on measured grounds: Mosaic packs 2-byte
+        # types two rows per sublane in HBM ((8,128)(2,1) tiling), so the
+        # kernels' single-row DMA slices fail to compile on real chips
+        # ("Slice shape along dimension 0 must be aligned to tiling").
+        # Multi-shard stays XLA: the row kernel would need per-shard offset
+        # remapping under shard_map, and XLA's sharded scatter already
+        # overlaps the collective with the update.
         self._pallas_rows = bool(
             use_pallas_rows
             and len(self.padded_shape) == 2
-            and self.dtype == np.float32
+            and np.dtype(self.dtype) == np.dtype(np.float32)
             and num_servers == 1
-            and type(updater).__name__ == "Updater")
+            and type(updater).__name__ in ("Updater", "SGDUpdater"))
         self._build_kernels()
         self._lock = threading.Lock()
 
@@ -136,11 +141,16 @@ class ServerStore:
 
             # Mosaic kernels need the interpreter on CPU backends (tests).
             interpret = jax.default_backend() == "cpu"
+            # SGD applies data -= delta (client pre-scales lr).
+            sign = (-1.0 if type(self.updater).__name__ == "SGDUpdater"
+                    else 1.0)
 
             def pallas_rows_update(data, state, row_ids, delta, *opt):
                 del opt
-                return (scatter_add_rows(data, row_ids, delta,
-                                         interpret=interpret), state)
+                return (scatter_add_rows(data, row_ids,
+                                         delta.astype(data.dtype),
+                                         interpret=interpret, sign=sign),
+                        state)
 
             def pallas_access_rows(data, row_ids):
                 return gather_rows(data, row_ids, interpret=interpret)

@@ -8,7 +8,8 @@ per-row DMA with scalar-prefetched indices.
 
 Mosaic constrains mapped block shapes to (8k, 128k) tiles, so arbitrary
 single rows cannot be block-mapped; instead the table stays unmapped
-(``pl.ANY`` -> HBM) and each grid step DMAs a GROUP of 8 rows addressed by
+(``pl.ANY`` -> HBM) and each grid step DMAs a sublane-tile group of rows
+(8 for 4-byte dtypes, 16 for 2-byte — ``group_for_dtype``) addressed by
 the prefetched id array. For scatter:
 
 * ids must be SORTED ascending (callers argsort — XLA does that well), so
@@ -36,15 +37,19 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-GROUP = 8   # rows per grid step (the float32 sublane tile)
+def group_for_dtype(dtype) -> int:
+    """Rows per grid step: the sublane tile is 8 for 4-byte types and 16
+    for 2-byte types (bf16) — sub-tile VMEM scratch would be rejected by
+    Mosaic on real chips."""
+    return 8 if np.dtype(dtype).itemsize >= 4 else 16
 
 
-def _pad_ids_deltas(ids: jax.Array, deltas: jax.Array
+def _pad_ids_deltas(ids: jax.Array, deltas: jax.Array, group: int
                     ) -> Tuple[jax.Array, jax.Array, int]:
-    """Pad to a multiple of GROUP. Padding repeats the last id with a zero
-    delta — harmless accumulate, keeps runs contiguous."""
+    """Pad to a multiple of ``group``. Padding repeats the last id with a
+    zero delta — harmless accumulate, keeps runs contiguous."""
     n = ids.shape[0]
-    pad = (-n) % GROUP
+    pad = (-n) % group
     if pad:
         ids = jnp.concatenate([ids, jnp.broadcast_to(ids[-1], (pad,))])
         deltas = jnp.concatenate(
@@ -55,39 +60,42 @@ def _pad_ids_deltas(ids: jax.Array, deltas: jax.Array
 # ---------------------------------------------------------------------------
 # gather
 # ---------------------------------------------------------------------------
-def _gather_kernel(ids_ref, table_ref, out_ref, rows, sems):
-    g = pl.program_id(0)
-    for k in range(GROUP):
-        pltpu.make_async_copy(
-            table_ref.at[ids_ref[g * GROUP + k]],
-            rows.at[k], sems.at[k]).start()
-    for k in range(GROUP):
-        pltpu.make_async_copy(
-            table_ref.at[ids_ref[g * GROUP + k]],
-            rows.at[k], sems.at[k]).wait()
-    out_ref[:] = rows[:]
+def _make_gather_kernel(group: int):
+    def _gather_kernel(ids_ref, table_ref, out_ref, rows, sems):
+        g = pl.program_id(0)
+        for k in range(group):
+            pltpu.make_async_copy(
+                table_ref.at[ids_ref[g * group + k]],
+                rows.at[k], sems.at[k]).start()
+        for k in range(group):
+            pltpu.make_async_copy(
+                table_ref.at[ids_ref[g * group + k]],
+                rows.at[k], sems.at[k]).wait()
+        out_ref[:] = rows[:]
+    return _gather_kernel
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def gather_rows(table: jax.Array, ids: jax.Array,
                 interpret: bool = False) -> jax.Array:
-    """out[i] = table[ids[i]] — GROUP-row DMA batches per grid step."""
+    """out[i] = table[ids[i]] — group-row DMA batches per grid step."""
+    group = group_for_dtype(table.dtype)
     n = ids.shape[0]
     d = table.shape[1]
-    pad = (-n) % GROUP
+    pad = (-n) % group
     if pad:
         ids = jnp.concatenate([ids, jnp.zeros(pad, ids.dtype)])
     n_padded = n + pad
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n_padded // GROUP,),
+        grid=(n_padded // group,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec((GROUP, d), lambda g, ids_ref: (g, 0)),
-        scratch_shapes=[pltpu.VMEM((GROUP, d), table.dtype),
-                        pltpu.SemaphoreType.DMA((GROUP,))],
+        out_specs=pl.BlockSpec((group, d), lambda g, ids_ref: (g, 0)),
+        scratch_shapes=[pltpu.VMEM((group, d), table.dtype),
+                        pltpu.SemaphoreType.DMA((group,))],
     )
     out = pl.pallas_call(
-        _gather_kernel,
+        _make_gather_kernel(group),
         out_shape=jax.ShapeDtypeStruct((n_padded, d), table.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
@@ -98,69 +106,82 @@ def gather_rows(table: jax.Array, ids: jax.Array,
 # ---------------------------------------------------------------------------
 # scatter-add (ids must be sorted ascending)
 # ---------------------------------------------------------------------------
-def _scatter_kernel(ids_ref, delta_ref, table_in_ref, table_ref, rows, sems):
-    del table_in_ref  # aliased with table_ref (the output)
-    g = pl.program_id(0)
-    base = g * GROUP
+def _make_scatter_kernel(group: int, sign: float):
+    def _scatter_kernel(ids_ref, delta_ref, table_in_ref, table_ref, rows,
+                        sems):
+        del table_in_ref  # aliased with table_ref (the output)
+        g = pl.program_id(0)
+        base = g * group
 
-    # Load the group's rows.
-    for k in range(GROUP):
-        pltpu.make_async_copy(table_ref.at[ids_ref[base + k]],
-                              rows.at[k], sems.at[k]).start()
-    for k in range(GROUP):
-        pltpu.make_async_copy(table_ref.at[ids_ref[base + k]],
-                              rows.at[k], sems.at[k]).wait()
+        # Load the group's rows.
+        for k in range(group):
+            pltpu.make_async_copy(table_ref.at[ids_ref[base + k]],
+                                  rows.at[k], sems.at[k]).start()
+        for k in range(group):
+            pltpu.make_async_copy(table_ref.at[ids_ref[base + k]],
+                                  rows.at[k], sems.at[k]).wait()
 
-    # Fold duplicate-id runs: acc[k] = delta[k] (+ acc[k-1] if same id).
-    acc = [None] * GROUP
-    acc[0] = delta_ref[0, :]
-    for k in range(1, GROUP):
-        same = ids_ref[base + k] == ids_ref[base + k - 1]
-        acc[k] = delta_ref[k, :] + jnp.where(same, acc[k - 1],
-                                             jnp.zeros_like(acc[k - 1]))
+        # Fold duplicate-id runs: acc[k] = delta[k] (+ acc[k-1] if same id).
+        acc = [None] * group
+        acc[0] = delta_ref[0, :]
+        for k in range(1, group):
+            same = ids_ref[base + k] == ids_ref[base + k - 1]
+            acc[k] = delta_ref[k, :] + jnp.where(same, acc[k - 1],
+                                                 jnp.zeros_like(acc[k - 1]))
 
-    # Write back only the LAST row of each run (run end = id changes next).
-    # Lane GROUP-1 ALWAYS flushes: if its run continues into the next group,
-    # the partial sum lands in HBM before that group's (sequential) read, so
-    # the continuation accumulates on top of it instead of dropping it.
-    def _flush(k):
-        rows[k, :] = rows[k, :] + acc[k]
-        pltpu.make_async_copy(rows.at[k],
-                              table_ref.at[ids_ref[base + k]],
-                              sems.at[k]).start()
-        pltpu.make_async_copy(rows.at[k],
-                              table_ref.at[ids_ref[base + k]],
-                              sems.at[k]).wait()
+        # Write back only the LAST row of each run (run end = id changes
+        # next). Lane group-1 ALWAYS flushes: if its run continues into the
+        # next group, the partial sum lands in HBM before that group's
+        # (sequential) read, so the continuation accumulates on top of it
+        # instead of dropping it.
+        def _flush(k):
+            step = acc[k] if sign > 0 else -acc[k]
+            rows[k, :] = rows[k, :] + step.astype(rows.dtype)
+            pltpu.make_async_copy(rows.at[k],
+                                  table_ref.at[ids_ref[base + k]],
+                                  sems.at[k]).start()
+            pltpu.make_async_copy(rows.at[k],
+                                  table_ref.at[ids_ref[base + k]],
+                                  sems.at[k]).wait()
 
-    for k in range(GROUP - 1):
-        is_run_end = ids_ref[base + k] != ids_ref[base + k + 1]
+        for k in range(group - 1):
+            is_run_end = ids_ref[base + k] != ids_ref[base + k + 1]
 
-        @pl.when(is_run_end)
-        def _(k=k):
-            _flush(k)
+            @pl.when(is_run_end)
+            def _(k=k):
+                _flush(k)
 
-    _flush(GROUP - 1)
+        _flush(group - 1)
+    return _scatter_kernel
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "sign"))
 def scatter_add_sorted_rows(table: jax.Array, sorted_ids: jax.Array,
                             sorted_deltas: jax.Array,
-                            interpret: bool = False) -> jax.Array:
-    """table[ids[i]] += deltas[i] for SORTED ids; in-place (donated)."""
-    sorted_ids, sorted_deltas, _ = _pad_ids_deltas(sorted_ids, sorted_deltas)
+                            interpret: bool = False,
+                            sign: float = 1.0) -> jax.Array:
+    """table[ids[i]] += sign*deltas[i] for SORTED ids; in-place (donated).
+    ``sign=-1`` gives the SGD updater's ``data -= delta`` (the client
+    pre-scales by lr, ref ``sgd_updater.h:8-27``)."""
+    if sign not in (1.0, -1.0):
+        raise ValueError(f"sign must be +-1.0 (a direction, not a scale); "
+                         f"got {sign}")
+    group = group_for_dtype(table.dtype)
+    sorted_ids, sorted_deltas, _ = _pad_ids_deltas(sorted_ids,
+                                                   sorted_deltas, group)
     n = sorted_ids.shape[0]
     d = table.shape[1]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(n // GROUP,),
-        in_specs=[pl.BlockSpec((GROUP, d), lambda g, ids_ref: (g, 0)),
+        grid=(n // group,),
+        in_specs=[pl.BlockSpec((group, d), lambda g, ids_ref: (g, 0)),
                   pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[pltpu.VMEM((GROUP, d), table.dtype),
-                        pltpu.SemaphoreType.DMA((GROUP,))],
+        scratch_shapes=[pltpu.VMEM((group, d), table.dtype),
+                        pltpu.SemaphoreType.DMA((group,))],
     )
     return pl.pallas_call(
-        _scatter_kernel,
+        _make_scatter_kernel(group, sign),
         out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
         grid_spec=grid_spec,
         input_output_aliases={2: 0},   # table (after ids, deltas) -> out
@@ -170,9 +191,9 @@ def scatter_add_sorted_rows(table: jax.Array, sorted_ids: jax.Array,
 
 
 def scatter_add_rows(table: jax.Array, ids: jax.Array, deltas: jax.Array,
-                     interpret: bool = False) -> jax.Array:
+                     interpret: bool = False, sign: float = 1.0) -> jax.Array:
     """Unsorted convenience wrapper: argsort (XLA), then the kernel."""
     order = jnp.argsort(ids)
     return scatter_add_sorted_rows(table, jnp.take(ids, order),
                                    jnp.take(deltas, order, axis=0),
-                                   interpret=interpret)
+                                   interpret=interpret, sign=sign)

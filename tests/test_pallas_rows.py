@@ -128,3 +128,75 @@ def test_pallas_flag_ignored_when_ineligible():
         np.testing.assert_allclose(t.get_row(5), np.ones(128))
     finally:
         mv.shutdown()
+
+
+# -- round 2: widened eligibility (bf16 tiles, SGD sign) --------------------
+def test_scatter_add_sgd_sign():
+    """Interpret-mode note: bf16 kernels pass here but are REJECTED by
+    Mosaic on real chips (2-byte HBM tiling packs 2 rows/sublane; 1-row DMA
+    slices misalign), so table eligibility stays f32-only."""
+    import jax.numpy as jnp
+    from multiverso_tpu.ops.pallas_rows import (gather_rows,
+                                                group_for_dtype,
+                                                scatter_add_rows)
+
+    assert group_for_dtype(np.float32) == 8
+    assert group_for_dtype(jnp.bfloat16) == 16
+
+    rng = np.random.default_rng(0)
+    for dtype in (np.float32,):
+        table = jnp.asarray(rng.normal(size=(64, 128)), dtype=dtype)
+        ids = jnp.asarray(np.sort(rng.integers(0, 64, size=40))
+                          .astype(np.int32))
+        deltas = jnp.asarray(rng.normal(size=(40, 128)), dtype=dtype)
+        ref = np.array(table, dtype=np.float32)   # writable copy
+        np.add.at(ref, np.asarray(ids), np.asarray(deltas,
+                                                   dtype=np.float32))
+        got = scatter_add_rows(table, ids, deltas, interpret=True)
+        np.testing.assert_allclose(np.asarray(got, dtype=np.float32), ref,
+                                   rtol=2e-2, atol=2e-2)
+        back = gather_rows(got, ids, interpret=True)
+        np.testing.assert_allclose(np.asarray(back, dtype=np.float32),
+                                   ref[np.asarray(ids)], rtol=2e-2,
+                                   atol=2e-2)
+    # SGD sign: data -= delta
+    table = jnp.zeros((16, 128), jnp.float32)
+    ids = jnp.asarray([2, 2, 5], dtype=jnp.int32)
+    deltas = jnp.ones((3, 128), jnp.float32)
+    got = scatter_add_rows(table, ids, deltas, interpret=True, sign=-1.0)
+    assert np.allclose(np.asarray(got)[2], -2.0)
+    assert np.allclose(np.asarray(got)[5], -1.0)
+
+
+def test_table_pallas_eligibility_widened():
+    """SGD tables now route through the Pallas row path (single shard,
+    sign-flipped scatter); bf16 and adagrad stay on XLA."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.core.options import AddOption
+    from multiverso_tpu.core.table import ServerStore
+    from multiverso_tpu.core.updater import get_updater
+    from multiverso_tpu.core.zoo import Zoo
+
+    mv.init([], devices=jax.devices()[:1])   # single shard for eligibility
+    try:
+        mesh = Zoo.get().mesh
+        st = ServerStore("p1", (32, 128), np.float32,
+                         get_updater(np.float32, "sgd"), mesh, 1,
+                         use_pallas_rows=True)
+        assert st._pallas_rows
+        st_bf = ServerStore("p2", (32, 128), jnp.bfloat16,
+                            get_updater(np.dtype(jnp.bfloat16), "default"),
+                            mesh, 1, use_pallas_rows=True)
+        assert not st_bf._pallas_rows   # bf16: Mosaic 1-row DMA misaligned
+        st_ada = ServerStore("p3", (32, 128), np.float32,
+                             get_updater(np.float32, "adagrad"), mesh, 1,
+                             use_pallas_rows=True)
+        assert not st_ada._pallas_rows
+        # behavior: sgd table applies data -= delta through the kernel
+        ids = jnp.asarray([1, 1, 3], dtype=jnp.int32)
+        st.apply_rows(ids, jnp.ones((3, 128), jnp.float32), AddOption())
+        out = np.asarray(st.read_rows(jnp.asarray([1, 3],
+                                                  dtype=jnp.int32)))
+        assert np.allclose(out[0], -2.0) and np.allclose(out[1], -1.0)
+    finally:
+        mv.shutdown()

@@ -173,8 +173,7 @@ def test_pair_compaction_identity_when_all_valid(mv_env):
     outs = []
     for compact in (False, True):
         step = build_device_block_step(window=1, negative=3, chunk=chunk,
-                                       table_size=997, adagrad=True,
-                                       compact=compact)
+                                       adagrad=True, compact=compact)
         w_in = jnp.asarray(rng0 := np.random.default_rng(1)
                            .normal(size=(V, D)).astype(np.float32))
         w_out = jnp.zeros((V, D), jnp.float32)
@@ -208,8 +207,7 @@ def test_pair_compaction_counts_and_loss_with_masking(mv_env):
     counts, losses = [], []
     for compact in (False, True):
         step = build_device_block_step(window=4, negative=2, chunk=chunk,
-                                       table_size=499, adagrad=False,
-                                       compact=compact)
+                                       adagrad=False, compact=compact)
         zeros = [jnp.zeros((V, D), jnp.float32) for _ in range(4)]
         out = step(*zeros, *args)
         counts.append(int(out[5]))
@@ -286,3 +284,58 @@ def test_analogy_query(mv_env):
     assert len(out) == 3
     assert all(w not in ("a0", "a1", "b0") for w, _ in out)
     assert w2v.analogy("a0", "missing", "b0") == []
+
+
+def test_chunked_dispatch_matches_block_step_bitwise(mv_env):
+    """The host-dispatched chunk pipeline (pair_gen + chunk_step* + tail)
+    must reproduce the in-graph compacted block step bitwise: identical key
+    -> identical pair stream, negatives, masks, and update order."""
+    import jax
+    import jax.numpy as jnp
+    from multiverso_tpu.models.word2vec.model import (
+        build_chunked_pipeline, build_device_block_step,
+        expected_live_chunks)
+
+    rng = np.random.default_rng(5)
+    V, D, S, L, chunk, W, K = 80, 16, 6, 20, 32, 3, 2
+    neg_table = jnp.asarray(rng.integers(0, V, size=1024).astype(np.int32))
+    keep_prob_host = np.full(V, 0.8, dtype=np.float32)
+    keep_prob = jnp.asarray(keep_prob_host)
+    sents = jnp.asarray(rng.integers(0, V, size=(S, L)).astype(np.int32))
+    lengths = jnp.asarray(rng.integers(2, L + 1, size=S).astype(np.int32))
+    key = jax.random.PRNGKey(13)
+    lr = jnp.float32(0.05)
+
+    def init():
+        return [jnp.asarray(np.random.default_rng(1).normal(
+            size=(V, D)).astype(np.float32))] + \
+            [jnp.zeros((V, D), jnp.float32) for _ in range(3)]
+
+    block = build_device_block_step(W, K, chunk, adagrad=True,
+                                    compact=True)
+    ref = block(*init(), neg_table, keep_prob, sents, lengths, key, lr)
+
+    pair_gen, chunk_step, tail_step = build_chunked_pipeline(
+        W, K, chunk, adagrad=True)
+    centers2d, contexts2d, negs, n_pairs = pair_gen(
+        neg_table, keep_prob, sents, lengths, key)
+    n_static = centers2d.shape[0]
+    est = expected_live_chunks(keep_prob_host, np.asarray(sents),
+                               np.asarray(lengths), W, chunk, n_static)
+    tables = init()
+    idx = jnp.arange(n_static)
+    total_loss = jnp.float32(0)
+    for i in range(est):
+        out = chunk_step(*tables, centers2d, contexts2d, negs, n_pairs,
+                         idx[i], jnp.asarray(lr))
+        tables = list(out[:4])
+        total_loss = total_loss + out[4]
+    out = tail_step(*tables, centers2d, contexts2d, negs, n_pairs,
+                    jnp.asarray(lr), start=est)
+    tables = out[:4]
+    total_loss = total_loss + out[4]
+
+    assert int(n_pairs) == int(ref[5])
+    for a, b in zip(tables, ref[:4]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(float(total_loss), float(ref[4]), rtol=1e-6)
