@@ -80,6 +80,11 @@ class Word2VecConfig:
     chunk_dispatch: bool = False
     block_sentences: int = 512      # sentences per device block
     pad_sentence_length: int = 512  # fixed sentence pad (longer ones split)
+    # dp x tp mesh for the device pipeline: sentences sharded over
+    # mesh_data devices, vocab rows over mesh_model. 1 x 1 = single-device
+    # step (tables still row-sharded by the store's own mesh).
+    mesh_data: int = 1
+    mesh_model: int = 1
     max_code_length: int = 40
     seed: int = 0
     delta_scale: Optional[float] = None   # 1/num_workers push scaling
@@ -99,17 +104,52 @@ def _row_gather_negatives(neg_table, key, shape):
     total = 1
     for s in shape:
         total *= s
-    if neg_table.ndim == 1:
-        width = min(128, neg_table.shape[0])
-        rows_tbl = neg_table.shape[0] // width
-        table2d = neg_table[:rows_tbl * width].reshape(rows_tbl, width)
-    else:
-        table2d = neg_table
-        rows_tbl, width = table2d.shape
+    assert neg_table.ndim == 1, "pass the 1-D SHUFFLED sampler table"
+    width = min(128, neg_table.shape[0])
+    rows_tbl = neg_table.shape[0] // width
+    table2d = neg_table[:rows_tbl * width].reshape(rows_tbl, width)
     rows_needed = -(-total // width)
     ridx = jax.random.randint(key, (rows_needed,), 0, rows_tbl)
     flat = jnp.take(table2d, ridx, axis=0).reshape(-1)
     return flat[:total].reshape(shape)
+
+
+def _pair_arrays(sents, lengths, keep_prob, k_keep, k_win, window):
+    """Masked offset-shift pairing (shared by the block step and the
+    chunked pair_gen — the two paths must stay bitwise identical)."""
+    S, L = sents.shape
+    pos = jnp.arange(L)[None, :]
+    valid = (pos < lengths[:, None])
+    keep = jax.random.uniform(k_keep, (S, L)) < keep_prob[sents]
+    valid = valid & keep
+    wpos = jax.random.randint(k_win, (S, L), 1, window + 1)
+    centers, contexts, pmask = [], [], []
+    for d in range(1, window + 1):
+        c = sents[:, :-d].reshape(-1)
+        o = sents[:, d:].reshape(-1)
+        m = ((wpos[:, :-d] >= d) & valid[:, :-d] &
+             valid[:, d:]).reshape(-1)
+        centers += [c, o]
+        contexts += [o, c]
+        pmask += [m, m]
+    return (jnp.concatenate(centers), jnp.concatenate(contexts),
+            jnp.concatenate(pmask))
+
+
+def _compact_stream(centers, contexts, pmask, chunk):
+    """Stable-partition valid pairs to the front; [n, chunk] views +
+    true pair count."""
+    P = centers.shape[0]
+    total = P + (-P) % chunk
+    n = total // chunk
+    n_pairs = pmask.sum().astype(jnp.int32)
+    dest = jnp.cumsum(pmask.astype(jnp.int32)) - 1
+    dest = jnp.where(pmask, dest, total)
+    centers = (jnp.zeros(total, centers.dtype)
+               .at[dest].set(centers, mode="drop").reshape(n, chunk))
+    contexts = (jnp.zeros(total, contexts.dtype)
+                .at[dest].set(contexts, mode="drop").reshape(n, chunk))
+    return centers, contexts, n_pairs, n
 
 
 # ---------------------------------------------------------------------------
@@ -248,9 +288,10 @@ def raw_cbow_hs_step(adagrad: bool):
     return step
 
 
-def build_device_block_step(window: int, negative: int, chunk: int,
-                            adagrad: bool, compact: bool = True):
-    """Whole-block training step with ON-DEVICE pair generation.
+def _make_block_fn(window: int, negative: int, chunk: int,
+                   adagrad: bool, compact: bool):
+    """Unjitted whole-block step — factored out so the sharded builder can
+    apply dp x tp shardings.
 
     The host uploads only raw token ids ([S, L] padded sentences + lengths)
     — everything the reference does on the worker CPU (subsampling, dynamic
@@ -275,48 +316,20 @@ def build_device_block_step(window: int, negative: int, chunk: int,
 
     def block_step(w_in, w_out, g_in, g_out, neg_table, keep_prob, sents,
                    lengths, key, lr):
-        S, L = sents.shape
         k_keep, k_win, k_neg = jax.random.split(key, 3)
-        pos = jnp.arange(L)[None, :]
-        valid = (pos < lengths[:, None])
-        keep = jax.random.uniform(k_keep, (S, L)) < keep_prob[sents]
-        valid = valid & keep
-        wpos = jax.random.randint(k_win, (S, L), 1, window + 1)
-
-        centers, contexts, pmask = [], [], []
-        for d in range(1, window + 1):
-            c = sents[:, :-d].reshape(-1)
-            o = sents[:, d:].reshape(-1)
-            m = ((wpos[:, :-d] >= d) & valid[:, :-d] &
-                 valid[:, d:]).reshape(-1)
-            centers += [c, o]
-            contexts += [o, c]
-            pmask += [m, m]
-        centers = jnp.concatenate(centers)
-        contexts = jnp.concatenate(contexts)
-        pmask = jnp.concatenate(pmask)
-
+        centers, contexts, pmask = _pair_arrays(sents, lengths, keep_prob,
+                                                k_keep, k_win, window)
         P = centers.shape[0]
         pad = (-P) % chunk
-        total = P + pad
-        n = total // chunk
-        n_pairs = pmask.sum()
+        n = (P + pad) // chunk
 
         if compact:
-            # Stable partition of valid pairs to the front: destination =
-            # rank among valid pairs; invalid slots scatter out of bounds
-            # and drop.
-            dest = jnp.cumsum(pmask.astype(jnp.int32)) - 1
-            dest = jnp.where(pmask, dest, total)
-            centers = (jnp.zeros(total, centers.dtype)
-                       .at[dest].set(centers, mode="drop"))
-            contexts = (jnp.zeros(total, contexts.dtype)
-                        .at[dest].set(contexts, mode="drop"))
+            centers, contexts, n_pairs, n = _compact_stream(
+                centers, contexts, pmask, chunk)
         else:
-            centers = jnp.pad(centers, (0, pad))
-            contexts = jnp.pad(contexts, (0, pad))
-        centers = centers.reshape(n, chunk)
-        contexts = contexts.reshape(n, chunk)
+            n_pairs = pmask.sum()
+            centers = jnp.pad(centers, (0, pad)).reshape(n, chunk)
+            contexts = jnp.pad(contexts, (0, pad)).reshape(n, chunk)
         negatives = _row_gather_negatives(neg_table, k_neg,
                                           (n, chunk, negative))
 
@@ -356,7 +369,50 @@ def build_device_block_step(window: int, negative: int, chunk: int,
             (centers, contexts, mask, negatives))
         return (*carry, losses.sum(), n_pairs)
 
-    return jax.jit(block_step, donate_argnums=(0, 1, 2, 3))
+    return block_step
+
+
+def build_device_block_step(window: int, negative: int, chunk: int,
+                            adagrad: bool, compact: bool = True):
+    """Whole-block training step with ON-DEVICE pair generation.
+
+    The host uploads only raw token ids; pairing, subsampling, compaction,
+    negative sampling and the chunk training loop all run in one jitted
+    program (details in :func:`_make_block_fn`'s body)."""
+    return jax.jit(_make_block_fn(window, negative, chunk, adagrad,
+                                  compact),
+                   donate_argnums=(0, 1, 2, 3))
+
+
+def build_sharded_block_step(mesh, window: int, negative: int, chunk: int,
+                             adagrad: bool, compact: bool = True):
+    """The SAME block step jitted over a (data x model) mesh — the dp x tp
+    execution the reference reaches with row-sharded tables across servers
+    plus data-parallel workers (SURVEY.md §2.4):
+
+    * embedding + accumulator tables: vocab rows sharded over ``model``,
+      replicated over ``data`` (``P("model", None)``) — gathers/scatters
+      become XLA collectives over the mesh;
+    * the sentence block: sharded over ``data`` (each data shard generates
+      pairs from its own sentences);
+    * negative table / keep probabilities / RNG key / lr: replicated.
+
+    Semantics are identical to the single-device step (same keys -> same
+    pairs, negatives and update order), so losses match the unsharded run.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    table = NamedSharding(mesh, P("model", None))
+    data2 = NamedSharding(mesh, P("data", None))
+    data1 = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+    fn = _make_block_fn(window, negative, chunk, adagrad, compact)
+    return jax.jit(
+        fn,
+        in_shardings=(table, table, table, table, repl, repl, data2, data1,
+                      repl, repl),
+        out_shardings=(table, table, table, table, repl, repl),
+        donate_argnums=(0, 1, 2, 3))
 
 
 def build_chunked_pipeline(window: int, negative: int, chunk: int,
@@ -381,35 +437,11 @@ def build_chunked_pipeline(window: int, negative: int, chunk: int,
 
     @jax.jit
     def pair_gen(neg_table, keep_prob, sents, lengths, key):
-        S, L = sents.shape
         k_keep, k_win, k_neg = jax.random.split(key, 3)
-        pos = jnp.arange(L)[None, :]
-        valid = (pos < lengths[:, None])
-        keep = jax.random.uniform(k_keep, (S, L)) < keep_prob[sents]
-        valid = valid & keep
-        wpos = jax.random.randint(k_win, (S, L), 1, window + 1)
-        centers, contexts, pmask = [], [], []
-        for d in range(1, window + 1):
-            c = sents[:, :-d].reshape(-1)
-            o = sents[:, d:].reshape(-1)
-            m = ((wpos[:, :-d] >= d) & valid[:, :-d] &
-                 valid[:, d:]).reshape(-1)
-            centers += [c, o]
-            contexts += [o, c]
-            pmask += [m, m]
-        centers = jnp.concatenate(centers)
-        contexts = jnp.concatenate(contexts)
-        pmask = jnp.concatenate(pmask)
-        P = centers.shape[0]
-        total = P + (-P) % chunk
-        n = total // chunk
-        n_pairs = pmask.sum().astype(jnp.int32)
-        dest = jnp.cumsum(pmask.astype(jnp.int32)) - 1
-        dest = jnp.where(pmask, dest, total)
-        centers = (jnp.zeros(total, centers.dtype)
-                   .at[dest].set(centers, mode="drop").reshape(n, chunk))
-        contexts = (jnp.zeros(total, contexts.dtype)
-                    .at[dest].set(contexts, mode="drop").reshape(n, chunk))
+        centers, contexts, pmask = _pair_arrays(sents, lengths, keep_prob,
+                                                k_keep, k_win, window)
+        centers, contexts, n_pairs, n = _compact_stream(
+            centers, contexts, pmask, chunk)
         negatives = _row_gather_negatives(neg_table, k_neg,
                                           (n, chunk, negative))
         return centers, contexts, negatives, n_pairs
@@ -558,6 +590,27 @@ class Word2Vec:
                 (self._pair_gen, self._chunk_step,
                  self._tail_step) = build_chunked_pipeline(
                     cfg.window, cfg.negative, cfg.batch_size, adagrad)
+            self._sharded_mesh = None
+            if cfg.mesh_data * cfg.mesh_model > 1:
+                check(not cfg.chunk_dispatch,
+                      "chunk_dispatch and a dp x tp mesh are mutually "
+                      "exclusive: per-chunk host dispatch would serialize "
+                      "the sharded step; pick one")
+                from jax.sharding import Mesh
+                n = cfg.mesh_data * cfg.mesh_model
+                devs = jax.devices()
+                check(len(devs) >= n,
+                      f"mesh {cfg.mesh_data}x{cfg.mesh_model} needs {n} "
+                      f"devices, have {len(devs)}")
+                check(cfg.block_sentences % cfg.mesh_data == 0,
+                      "block_sentences must divide over mesh_data")
+                self._sharded_mesh = Mesh(
+                    np.asarray(devs[:n]).reshape(cfg.mesh_data,
+                                                 cfg.mesh_model),
+                    ("data", "model"))
+                self._block_step = build_sharded_block_step(
+                    self._sharded_mesh, cfg.window, cfg.negative,
+                    cfg.batch_size, adagrad, compact=cfg.compact_pairs)
             self._key = jax.random.PRNGKey(cfg.seed)
 
         self.total_words = dictionary.total_count * max(cfg.epochs, 1)
@@ -740,6 +793,14 @@ class Word2Vec:
         st_out = self.output_table.store
         st_gin = self.adagrad_in.store
         st_gout = self.adagrad_out.store
+        sharded = getattr(self, "_sharded_mesh", None) is not None
+        if sharded:
+            # Re-lay the tables onto the dp x tp mesh once; the step's
+            # donated outputs keep that sharding for every later block.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            tsh = NamedSharding(self._sharded_mesh, P("model", None))
+            for st in (st_in, st_out, st_gin, st_gout):
+                st.data = jax.device_put(st.data, tsh)
         for _ in range(epochs):
             if corpus_path is not None:
                 sents: Iterable = (self.dict.encode(s)
@@ -760,7 +821,7 @@ class Word2Vec:
             else:
                 buf = None
                 source = blocks
-            chunked = self.cfg.chunk_dispatch
+            chunked = self.cfg.chunk_dispatch and not sharded
             W, chunk = self.cfg.window, self.cfg.batch_size
             try:
                 for mat, lens, words in source:
@@ -778,14 +839,13 @@ class Word2Vec:
                                 self._keep_prob_host, mat, lens, W, chunk,
                                 n_static)
                             lr_dev = jnp.asarray(lr)
-                            idx = jnp.arange(n_static)
                             tables = (st_in.data, st_out.data, st_gin.data,
                                       st_gout.data)
                             block_loss = []
                             for i in range(est):
                                 out = self._chunk_step(
                                     *tables, centers2d, contexts2d, negs,
-                                    n_pairs, idx[i], lr_dev)
+                                    n_pairs, np.int32(i), lr_dev)
                                 tables = out[:4]
                                 block_loss.append(out[4])
                             out = self._tail_step(
