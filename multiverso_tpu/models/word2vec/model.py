@@ -561,6 +561,9 @@ class Word2Vec:
 
         adagrad = cfg.optimizer == "adagrad"
         self._adagrad = adagrad
+        check(cfg.mesh_data * cfg.mesh_model == 1 or cfg.device_pipeline,
+              "mesh_data/mesh_model need device_pipeline=True (the host "
+              "batch path has no sharded step)")
         if cfg.sg and not cfg.hs:
             raw = raw_sg_ns_step(adagrad)
         elif cfg.sg and cfg.hs:
@@ -801,6 +804,11 @@ class Word2Vec:
             tsh = NamedSharding(self._sharded_mesh, P("model", None))
             for st in (st_in, st_out, st_gin, st_gout):
                 st.data = jax.device_put(st.data, tsh)
+            # Replicated operands get laid out once too — otherwise every
+            # block dispatch reshards the ~4MB negative table to the mesh.
+            repl = NamedSharding(self._sharded_mesh, P())
+            self._neg_table = jax.device_put(self._neg_table, repl)
+            self._keep_prob = jax.device_put(self._keep_prob, repl)
         for _ in range(epochs):
             if corpus_path is not None:
                 sents: Iterable = (self.dict.encode(s)

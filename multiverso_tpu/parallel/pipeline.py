@@ -8,6 +8,12 @@ devices run the same SPMD program, and activations hop stage->stage via
 m+s; total ticks M + S - 1). Differentiable end to end (``ppermute`` and the
 schedule scan both have transposes), so ``jax.grad`` through
 :func:`pipeline_apply` trains all stages.
+
+:func:`pipeline_train_1f1b` is the explicit training schedule: one-forward-
+one-backward with rematerialized backward units, holding at most
+``2*(S-1)`` saved microbatch INPUTS per device regardless of M — the O(S)
+activation footprint that GPipe-under-``jax.grad`` (which retains all M
+residuals through the scan transpose) cannot provide.
 """
 
 from __future__ import annotations
@@ -52,13 +58,9 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     Bubble note: fill/drain "garbage ticks" (first/last S-1) execute
     masked compute, but in SPMD those devices would be idle at those ticks
     anyway — the bubble is schedule-inherent (GPipe: (S-1)/(T) overhead),
-    not wasted wall-clock on top of it. The path to shrinking the bubble
-    itself is 1F1B: interleave each microbatch's backward at the stage that
-    just finished its forward, which in JAX means scheduling
-    ``jax.vjp``-obtained backward callables inside the same scan with a
-    second (reverse-direction) activation-grad hop; outputs/grad-inputs
-    then drain with only an S-1 tick tail. Tracked as the next pipeline
-    milestone.
+    not wasted wall-clock on top of it. For training, the O(S) activation
+    footprint (vs O(M) here under ``jax.grad``) is provided by the explicit
+    1F1B schedule in :func:`pipeline_train_1f1b`.
     """
     S = mesh.shape[axis]
     M = microbatches.shape[0]
@@ -124,3 +126,118 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         out_specs=P(),
         check_vma=False)
     return fn(stage_params, microbatches)[:M]
+
+
+def pipeline_train_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                        loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+                        stage_params: Any, microbatches: jax.Array,
+                        targets: jax.Array, mesh: Mesh,
+                        axis: str = STAGE_AXIS):
+    """One-forward-one-backward pipeline training step.
+
+    Returns ``(total_loss, stage_grads)`` where ``total_loss`` is the sum of
+    ``loss_fn(y_m, target_m)`` over the M microbatches and ``stage_grads``
+    matches ``stage_params`` (leading [S] stage axis) — identical (up to
+    float assoc.) to ``jax.grad`` of the sequential chain, but scheduled so
+    each microbatch's backward runs as soon as its forward clears the last
+    stage.
+
+    Schedule (t = tick, s = stage id):
+
+    * forward of microbatch m runs at stage s when  ``t == m + s``;
+    * backward of m runs at stage s when            ``t == m + 2(S-1) - s``;
+    * at the LAST stage the two coincide (its backward consumes the
+      forward's output directly through the loss), and every earlier stage
+      runs its backward ``2*(S-1-s)`` ticks after its forward of the same
+      microbatch. Total ticks: ``M + 2(S-1)``.
+
+    Memory contract (the point of 1F1B): each device keeps a ring of
+    ``R = 2(S-1)`` saved microbatch inputs — independent of M. Backward
+    units REMATERIALIZE the stage forward from the saved input
+    (``jax.vjp`` at backward time), the standard trade (one extra stage
+    forward of FLOPs) for not storing per-microbatch residuals. GPipe via
+    ``jax.grad(pipeline_apply)`` retains all M scan residuals; at
+    transformer scale that difference (O(M) vs O(S) activations) decides
+    whether the step fits HBM.
+
+    The microbatch/target streams are fed replicated (every device indexes
+    the [M, mb, ...] arrays); the sharded-stream conveyor of
+    :func:`pipeline_apply` composes with this schedule but is kept out of
+    the first 1F1B cut for clarity. Parity: the reference has no layer
+    pipeline (SURVEY.md §2.4) — this is TPU-native surplus capability.
+    """
+    S = mesh.shape[axis]
+    M = microbatches.shape[0]
+    T = M + 2 * (S - 1)
+    R = max(2 * (S - 1), 1)          # saved-input ring slots (S=1: dummy 1)
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+    for leaf in jax.tree.leaves(stage_params):
+        check(leaf.shape[0] == S,
+              f"stage_params leading dim {leaf.shape[0]} != "
+              f"{S} pipeline stages on axis '{axis}'")
+
+    def local(params_local, xs, tgts):
+        sid = jax.lax.axis_index(axis)
+        my_params = jax.tree.map(lambda p: p[0], params_local)
+        mb_shape = xs.shape[1:]
+        zero_act = jnp.zeros(mb_shape, xs.dtype)
+        ring = jnp.zeros((R,) + mb_shape, xs.dtype)
+        grads0 = jax.tree.map(jnp.zeros_like, my_params)
+        last = sid == S - 1
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, ring, grads, loss = carry
+            m_f = t - sid                          # forward microbatch id
+            m_b = t - 2 * (S - 1) + sid            # backward microbatch id
+            # (no forward-validity mask needed: out-of-range forwards write
+            # ring slots whose pending window has already drained, and their
+            # garbage activations are gated downstream by valid_b)
+            valid_b = (m_b >= 0) & (m_b < M)
+
+            # ---- read the saved input for the backward unit BEFORE the
+            # forward slot overwrites its ring slot (at stage 0 the window
+            # is exactly R, so read-then-write order is load-bearing).
+            x_saved = ring[m_b % R]
+
+            # ---- forward slot -------------------------------------------
+            x_feed = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(m_f, 0, M - 1), keepdims=False)
+            x_in = jnp.where(sid == 0, x_feed, fwd_buf)
+            y_out = stage_fn(my_params, x_in)
+            ring = ring.at[m_f % R].set(x_in)
+
+            # ---- backward slot ------------------------------------------
+            tgt = jax.lax.dynamic_index_in_dim(
+                tgts, jnp.clip(m_b, 0, M - 1), keepdims=False)
+            # Last stage: backward consumes THIS tick's forward (m_b == m_f
+            # there), so its x_b is x_in and its output-grad comes from the
+            # loss; earlier stages replay the ring and use the received
+            # activation grad.
+            x_b = jnp.where(last, x_in, x_saved)
+            mb_loss, dy_loss = jax.value_and_grad(
+                lambda y: loss_fn(y, tgt))(y_out)
+            g_y = jnp.where(last, dy_loss, bwd_buf)
+            _, vjp = jax.vjp(stage_fn, my_params, x_b)
+            dparams, dx = vjp(g_y)
+            grads = jax.tree.map(
+                lambda g, d: g + jnp.where(valid_b, d, 0.0), grads, dparams)
+            loss = loss + jnp.where(valid_b & last, mb_loss, 0.0)
+
+            # ---- hops ---------------------------------------------------
+            fwd_next = jax.lax.ppermute(y_out, axis, perm_fwd)
+            bwd_next = jax.lax.ppermute(dx, axis, perm_bwd)
+            return (fwd_next, bwd_next, ring, grads, loss), None
+
+        init = (zero_act, zero_act, ring, grads0, jnp.float32(0.0))
+        (_, _, _, grads, loss), _ = jax.lax.scan(tick, init, jnp.arange(T))
+        # stage s's grads live on device s; reassemble via out_specs P(axis)
+        return (jax.lax.psum(loss, axis),
+                jax.tree.map(lambda g: g[None], grads))
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis), stage_params), P(), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P(axis), stage_params)),
+        check_vma=False)
+    return fn(stage_params, microbatches, targets)
