@@ -129,10 +129,15 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
 
 def pipeline_train_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
-                        loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+                        loss_fn: Callable[..., jax.Array],
                         stage_params: Any, microbatches: jax.Array,
                         targets: jax.Array, mesh: Mesh,
-                        axis: str = STAGE_AXIS):
+                        axis: str = STAGE_AXIS,
+                        stream_spec: P = None,
+                        target_spec: P = None,
+                        reduce_axes: tuple = (),
+                        head_params: Any = None,
+                        return_input_grads: bool = False):
     """One-forward-one-backward pipeline training step.
 
     Returns ``(total_loss, stage_grads)`` where ``total_loss`` is the sum of
@@ -158,13 +163,36 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     forward of FLOPs) for not storing per-microbatch residuals. GPipe via
     ``jax.grad(pipeline_apply)`` retains all M scan residuals; at
     transformer scale that difference (O(M) vs O(S) activations) decides
-    whether the step fits HBM.
+    whether the step fits HBM. Scope: the contract covers the schedule's
+    TEMP memory (scan carries — what the residuals would have been). The
+    INPUT streams xs/targets are replicated over the stage axis (O(M)
+    argument bytes, raw tokens/activations), and ``return_input_grads``
+    adds an O(M) dxs carry plus one stage-axis psum of it;
+    :func:`pipeline_apply`'s stage-sharded conveyor shows the shape of the
+    stream-side fix if argument bytes ever dominate.
 
-    The microbatch/target streams are fed replicated (every device indexes
-    the [M, mb, ...] arrays); the sharded-stream conveyor of
-    :func:`pipeline_apply` composes with this schedule but is kept out of
-    the first 1F1B cut for clarity. Parity: the reference has no layer
-    pipeline (SURVEY.md §2.4) — this is TPU-native surplus capability.
+    Composition knobs (PP x SP/DP in ONE shard_map program — e.g. the
+    long-context LM pipelines transformer-block stages whose interiors run
+    :func:`~multiverso_tpu.parallel.sequence.ring_attention_block` over the
+    mesh's ``"seq"`` axis):
+
+    * ``stream_spec`` / ``target_spec``: PartitionSpecs for the [M, ...]
+      microbatch / target streams over the OTHER mesh axes (e.g.
+      ``P(None, None, "seq", None)``); default replicated (targets default
+      to ``stream_spec``; pass both when their ranks differ). ``stage_fn``
+      then sees per-device blocks and may use collectives over those axes.
+    * ``reduce_axes``: mesh axes the batch/sequence is split over; losses
+      and parameter grads are ``psum``-reduced across them (``loss_fn``
+      must be ADDITIVE over sharded dims — a sum, not a mean).
+    * ``head_params``: optional trainable pytree consumed by
+      ``loss_fn(head_params, y, target)`` at the last stage (e.g. the LM's
+      output projection). Adds ``head_grads`` to the return.
+    * ``return_input_grads``: also return d(loss)/d(microbatches) — the
+      stream grads at stage 0 — so a pre-pipeline embedding can train.
+
+    Return value: ``(loss, stage_grads[, head_grads][, input_grads])``.
+    Parity: the reference has no layer pipeline (SURVEY.md §2.4) — this is
+    TPU-native surplus capability.
     """
     S = mesh.shape[axis]
     M = microbatches.shape[0]
@@ -172,22 +200,32 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
     R = max(2 * (S - 1), 1)          # saved-input ring slots (S=1: dummy 1)
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
     perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+    stream_spec = P() if stream_spec is None else stream_spec
+    target_spec = stream_spec if target_spec is None else target_spec
+    with_head = head_params is not None
     for leaf in jax.tree.leaves(stage_params):
         check(leaf.shape[0] == S,
               f"stage_params leading dim {leaf.shape[0]} != "
               f"{S} pipeline stages on axis '{axis}'")
 
-    def local(params_local, xs, tgts):
+    def mb_loss_fn(head, y, tgt):
+        return loss_fn(head, y, tgt) if with_head else loss_fn(y, tgt)
+
+    def local(params_local, head, xs, tgts):
         sid = jax.lax.axis_index(axis)
         my_params = jax.tree.map(lambda p: p[0], params_local)
         mb_shape = xs.shape[1:]
         zero_act = jnp.zeros(mb_shape, xs.dtype)
         ring = jnp.zeros((R,) + mb_shape, xs.dtype)
         grads0 = jax.tree.map(jnp.zeros_like, my_params)
+        hgrads0 = jax.tree.map(jnp.zeros_like, head)
+        # the [M, ...] stream-grad buffer only exists when requested — it
+        # would otherwise break the O(S)-not-O(M) temp-memory contract
+        dxs0 = jnp.zeros_like(xs) if return_input_grads else jnp.zeros(())
         last = sid == S - 1
 
         def tick(carry, t):
-            fwd_buf, bwd_buf, ring, grads, loss = carry
+            fwd_buf, bwd_buf, ring, grads, hgrads, dxs, loss = carry
             m_f = t - sid                          # forward microbatch id
             m_b = t - 2 * (S - 1) + sid            # backward microbatch id
             # (no forward-validity mask needed: out-of-range forwards write
@@ -215,29 +253,59 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
             # loss; earlier stages replay the ring and use the received
             # activation grad.
             x_b = jnp.where(last, x_in, x_saved)
-            mb_loss, dy_loss = jax.value_and_grad(
-                lambda y: loss_fn(y, tgt))(y_out)
+            (mb_loss, (dhead, dy_loss)) = jax.value_and_grad(
+                mb_loss_fn, argnums=(0, 1))(head, y_out, tgt)
             g_y = jnp.where(last, dy_loss, bwd_buf)
             _, vjp = jax.vjp(stage_fn, my_params, x_b)
             dparams, dx = vjp(g_y)
+            gate_b = valid_b & last
             grads = jax.tree.map(
                 lambda g, d: g + jnp.where(valid_b, d, 0.0), grads, dparams)
-            loss = loss + jnp.where(valid_b & last, mb_loss, 0.0)
+            hgrads = jax.tree.map(
+                lambda g, d: g + jnp.where(gate_b, d, 0.0), hgrads, dhead)
+            loss = loss + jnp.where(gate_b, mb_loss, 0.0)
+            if return_input_grads:
+                # stream grads surface at stage 0's backward
+                dxs_updated = jax.lax.dynamic_update_index_in_dim(
+                    dxs, dx, jnp.clip(m_b, 0, M - 1), 0)
+                dxs = jnp.where(valid_b & (sid == 0), dxs_updated, dxs)
 
             # ---- hops ---------------------------------------------------
             fwd_next = jax.lax.ppermute(y_out, axis, perm_fwd)
             bwd_next = jax.lax.ppermute(dx, axis, perm_bwd)
-            return (fwd_next, bwd_next, ring, grads, loss), None
+            return (fwd_next, bwd_next, ring, grads, hgrads, dxs,
+                    loss), None
 
-        init = (zero_act, zero_act, ring, grads0, jnp.float32(0.0))
-        (_, _, _, grads, loss), _ = jax.lax.scan(tick, init, jnp.arange(T))
-        # stage s's grads live on device s; reassemble via out_specs P(axis)
-        return (jax.lax.psum(loss, axis),
-                jax.tree.map(lambda g: g[None], grads))
+        init = (zero_act, zero_act, ring, grads0, hgrads0, dxs0,
+                jnp.float32(0.0))
+        (_, _, _, grads, hgrads, dxs, loss), _ = jax.lax.scan(
+            tick, init, jnp.arange(T))
+        # stage s's grads live on device s; reassemble via out_specs P(axis).
+        # Batch-sharded axes carry partial sums: reduce params/head/loss.
+        for ax in reduce_axes:
+            grads = jax.tree.map(lambda g: jax.lax.psum(g, ax), grads)
+            hgrads = jax.tree.map(lambda g: jax.lax.psum(g, ax), hgrads)
+        loss = jax.lax.psum(loss, (axis,) + tuple(reduce_axes))
+        hgrads = jax.tree.map(lambda g: jax.lax.psum(g, axis), hgrads)
+        if return_input_grads:
+            dxs = jax.lax.psum(dxs, axis)
+        return (loss, jax.tree.map(lambda g: g[None], grads), hgrads, dxs)
 
+    head_in = head_params if with_head else ()
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: P(axis), stage_params), P(), P()),
-        out_specs=(P(), jax.tree.map(lambda _: P(axis), stage_params)),
+        in_specs=(jax.tree.map(lambda _: P(axis), stage_params),
+                  jax.tree.map(lambda _: P(), head_in),
+                  stream_spec, target_spec),
+        out_specs=(P(), jax.tree.map(lambda _: P(axis), stage_params),
+                   jax.tree.map(lambda _: P(), head_in),
+                   stream_spec if return_input_grads else P()),
         check_vma=False)
-    return fn(stage_params, microbatches, targets)
+    loss, grads, hgrads, dxs = fn(stage_params, head_in, microbatches,
+                                  targets)
+    out = (loss, grads)
+    if with_head:
+        out += (hgrads,)
+    if return_input_grads:
+        out += (dxs,)
+    return out

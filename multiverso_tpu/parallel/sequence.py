@@ -42,6 +42,61 @@ def _block_attn(q, k, v, scale, mask=None):
     return o, m, l
 
 
+def ring_attention_block(q_blk: jax.Array, k_blk: jax.Array,
+                         v_blk: jax.Array, axis: str, n: int,
+                         causal: bool = False) -> jax.Array:
+    """The per-device ring-attention body, for use INSIDE a shard_map.
+
+    ``q_blk/k_blk/v_blk``: this device's [B, H, S/n, D] sequence block on a
+    mesh whose ``axis`` has size ``n``. Exposed separately so programs that
+    already run under a shard_map spanning ``axis`` (e.g. the 1F1B pipeline
+    composing PP x SP, ``parallel/pipeline.py``) can run ring attention
+    without nesting shard_maps. :func:`ring_attention` is the standalone
+    wrapper.
+    """
+    scale = 1.0 / np.sqrt(q_blk.shape[-1])
+    my = jax.lax.axis_index(axis)
+    Sq = q_blk.shape[2]
+
+    def body(carry, step):
+        o_acc, m_acc, l_acc, k_cur, v_cur = carry
+        if causal:
+            # ppermute sends i -> i+1, so after `step` rotations this
+            # device holds the K/V block that originated on device
+            # (my - step) mod n.
+            k_blk_idx = jnp.mod(my - step, n)
+            q_pos = my * Sq + jnp.arange(Sq)[:, None]
+            k_pos = k_blk_idx * Sq + jnp.arange(Sq)[None, :]
+            # Finite large-negative (not -inf): a fully-masked row
+            # would otherwise produce exp(-inf - -inf) = nan in the
+            # streaming softmax; -1e30 underflows cleanly and the
+            # merge's beta factor zeroes the block's contribution.
+            mask = jnp.where(k_pos > q_pos, -1e30, 0.0)
+        else:
+            mask = None
+        o, m, l = _block_attn(q_blk, k_cur, v_cur, scale, mask)
+        m_new = jnp.maximum(m_acc, m)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m - m_new)
+        o_acc = o_acc * alpha + o * beta
+        l_acc = l_acc * alpha + l * beta
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        return (o_acc, m_new, l_acc, k_nxt, v_nxt), None
+
+    B, H, _, D = q_blk.shape
+    # Fresh accumulators are "unvarying" over the mesh axis until marked;
+    # the carry must match the ppermute outputs' varying type.
+    init = (jax.lax.pvary(jnp.zeros((B, H, Sq, D), q_blk.dtype), axis),
+            jax.lax.pvary(jnp.full((B, H, Sq, 1), -jnp.inf,
+                                   q_blk.dtype), axis),
+            jax.lax.pvary(jnp.zeros((B, H, Sq, 1), q_blk.dtype), axis),
+            k_blk, v_blk)
+    (o, _, l, _, _), _ = jax.lax.scan(body, init, jnp.arange(n))
+    return o / jnp.maximum(l, 1e-20)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis: str = SEQ_AXIS, causal: bool = False) -> jax.Array:
     """Attention over a sequence sharded across ``axis``.
@@ -54,49 +109,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     holds K/V block ``(i + step) % n`` at step ``step``).
     """
     n = mesh.shape[axis]
-    scale = 1.0 / np.sqrt(q.shape[-1])
 
     def local(q_blk, k_blk, v_blk):
-        my = jax.lax.axis_index(axis)
-        Sq = q_blk.shape[2]
-
-        def body(carry, step):
-            o_acc, m_acc, l_acc, k_cur, v_cur = carry
-            if causal:
-                # ppermute sends i -> i+1, so after `step` rotations this
-                # device holds the K/V block that originated on device
-                # (my - step) mod n.
-                k_blk_idx = jnp.mod(my - step, n)
-                q_pos = my * Sq + jnp.arange(Sq)[:, None]
-                k_pos = k_blk_idx * Sq + jnp.arange(Sq)[None, :]
-                # Finite large-negative (not -inf): a fully-masked row
-                # would otherwise produce exp(-inf - -inf) = nan in the
-                # streaming softmax; -1e30 underflows cleanly and the
-                # merge's beta factor zeroes the block's contribution.
-                mask = jnp.where(k_pos > q_pos, -1e30, 0.0)
-            else:
-                mask = None
-            o, m, l = _block_attn(q_blk, k_cur, v_cur, scale, mask)
-            m_new = jnp.maximum(m_acc, m)
-            alpha = jnp.exp(m_acc - m_new)
-            beta = jnp.exp(m - m_new)
-            o_acc = o_acc * alpha + o * beta
-            l_acc = l_acc * alpha + l * beta
-            perm = [(i, (i + 1) % n) for i in range(n)]
-            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
-            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return (o_acc, m_new, l_acc, k_nxt, v_nxt), None
-
-        B, H, Sq, D = q_blk.shape
-        # Fresh accumulators are "unvarying" over the mesh axis until marked;
-        # the carry must match the ppermute outputs' varying type.
-        init = (jax.lax.pvary(jnp.zeros((B, H, Sq, D), q_blk.dtype), axis),
-                jax.lax.pvary(jnp.full((B, H, Sq, 1), -jnp.inf,
-                                       q_blk.dtype), axis),
-                jax.lax.pvary(jnp.zeros((B, H, Sq, 1), q_blk.dtype), axis),
-                k_blk, v_blk)
-        (o, _, l, _, _), _ = jax.lax.scan(body, init, jnp.arange(n))
-        return o / jnp.maximum(l, 1e-20)
+        return ring_attention_block(q_blk, k_blk, v_blk, axis, n,
+                                    causal=causal)
 
     spec = P(None, None, axis, None)
     fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
