@@ -32,3 +32,97 @@ def run_app(body: Callable[[List[str]], int],
         return 1
     finally:
         mv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Distributed-launch helpers shared by the app CLIs (-world_size=N): the
+# single-host `mpirun -np N` analog of the reference's deployment
+# (deploy/docker/Dockerfile:103-109 there).
+# ---------------------------------------------------------------------------
+def spawn_ranks(module: str, args: List[str], world: int,
+                rank_flag: str) -> int:
+    """Launcher: re-exec ``python -m <module>`` once per rank with a shared
+    rendezvous dir. Runs BEFORE any runtime or jax init — the launcher only
+    forks and waits."""
+    import os
+    import subprocess
+    import tempfile
+
+    rdv = next((a.split("=", 1)[1] for a in args
+                if a.startswith("-rendezvous_dir=")), "")
+    if rdv:
+        # Namespace each run: stale addr/done files from a previous run in
+        # the same dir would poison the address exchange and the shutdown
+        # barrier.
+        rdv = tempfile.mkdtemp(prefix="run_", dir=rdv)
+    else:
+        rdv = tempfile.mkdtemp(prefix="mvapp_")
+    base = [a for a in args
+            if not a.startswith(("-world_size", f"-{rank_flag}",
+                                 "-rendezvous_dir"))]
+    procs = []
+    for r in range(world):
+        cmd = [sys.executable, "-m", module, *base,
+               f"-world_size={world}", f"-{rank_flag}={r}",
+               f"-rendezvous_dir={rdv}"]
+        procs.append(subprocess.Popen(cmd))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc |= p.returncode
+    return rc
+
+
+def pin_cpu_for_local_rank(args: List[str], device_flag: str) -> None:
+    """Spawned ranks pin jax to CPU BEFORE any backend init (the axon
+    sitecustomize force-selects the tunneled TPU; N local ranks would
+    contend for the one chip). ``-<device_flag>=default`` keeps the
+    auto-selection for one-rank-per-host deployments."""
+    if f"-{device_flag}=default" in args:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already up; use what we have
+
+
+def rendezvous(rdv: str, rank: int, world: int, address,
+               timeout_s: float = 120.0) -> List:
+    """File-based address exchange (the Controller registration analog for
+    externally-spawned ranks, ref src/controller.cpp:38-72)."""
+    import os
+    import time
+
+    with open(os.path.join(rdv, f"addr{rank}.tmp"), "w") as f:
+        f.write(f"{address[0]}:{address[1]}")
+    os.replace(os.path.join(rdv, f"addr{rank}.tmp"),
+               os.path.join(rdv, f"addr{rank}"))
+    peers: List = [None] * world
+    deadline = time.time() + timeout_s
+    for r in range(world):
+        path = os.path.join(rdv, f"addr{r}")
+        while not os.path.exists(path):
+            if time.time() > deadline:
+                raise TimeoutError(f"rank {r} never registered in {rdv}")
+            time.sleep(0.05)
+        host, port = open(path).read().split(":")
+        peers[r] = (host, int(port))
+    return peers
+
+
+def wait_all_done(rdv: str, rank: int, world: int,
+                  timeout_s: float = 600.0) -> None:
+    """Hold this rank's table shards up until every peer finished (the
+    MV_Barrier before shutdown, ref distributed_wordembedding.cpp:232)."""
+    import os
+    import time
+
+    with open(os.path.join(rdv, f"done{rank}"), "w") as f:
+        f.write("ok")
+    deadline = time.time() + timeout_s
+    for r in range(world):
+        while not os.path.exists(os.path.join(rdv, f"done{r}")):
+            if time.time() > deadline:
+                raise TimeoutError(f"rank {r} never finished")
+            time.sleep(0.05)

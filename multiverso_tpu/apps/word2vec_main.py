@@ -41,26 +41,27 @@ configure.define_int("block_sentences", 512,
                      "sentences per device block (device pipeline)")
 configure.define_int("pad_sentence_length", 512,
                      "sentence pad length (device pipeline)")
+# Distributed mode (the reference's `mpirun -np N ./wordembedding ...`,
+# deploy/docker recipe): -world_size=N spawns N worker ranks on this host,
+# each owning 1/N of the PS-sharded tables and training on a 1/N corpus
+# shard (pull-train-push). -rank/-rendezvous_dir are set internally on the
+# spawned children (or by an external launcher across hosts).
+configure.define_int("world_size", 1, "number of distributed worker ranks")
+configure.define_int("w2v_rank", -1, "this rank (set by the launcher)")
+configure.define_string("rendezvous_dir", "",
+                        "shared dir for address exchange")
 
 
-def _body(argv: List[str]) -> int:
-    del argv
-    from multiverso_tpu.models.word2vec import (Dictionary, Word2Vec,
-                                                Word2VecConfig, read_corpus)
+def _cfg_from_flags(device_pipeline: bool) -> "Word2VecConfig":
+    """The one flag->config mapping, shared by the local and distributed
+    bodies. ``device_pipeline=False`` for distributed ranks: the pull-
+    train-push DistributedWord2Vec path generates pairs host-side to know
+    its touched-row sets up front."""
+    from multiverso_tpu.models.word2vec import Word2VecConfig
 
-    train_file = configure.get_flag("train_file")
-    if not train_file:
-        log.error("missing -train_file")
-        return 1
     sg = not configure.get_flag("cbow")
     hs = configure.get_flag("hs")
-    log.info("building vocabulary from %s", train_file)
-    dictionary = Dictionary.build(read_corpus(train_file),
-                                  min_count=configure.get_flag("min_count"))
-    log.info("vocab=%d total_words=%d", len(dictionary),
-             dictionary.total_count)
-
-    cfg = Word2VecConfig(
+    return Word2VecConfig(
         embedding_size=configure.get_flag("size"),
         window=configure.get_flag("window"),
         negative=configure.get_flag("negative"),
@@ -73,11 +74,77 @@ def _body(argv: List[str]) -> int:
         optimizer=configure.get_flag("w2v_optimizer"),
         block_words=configure.get_flag("data_block_size"),
         pipeline=configure.get_flag("is_pipeline"),
-        device_pipeline=(configure.get_flag("use_device_pipeline")
+        device_pipeline=(device_pipeline and
+                         configure.get_flag("use_device_pipeline")
                          and sg and not hs),
         block_sentences=configure.get_flag("block_sentences"),
         pad_sentence_length=configure.get_flag("pad_sentence_length"),
     )
+
+
+def _body_distributed(world: int, rank: int) -> int:
+    from multiverso_tpu.apps._runner import rendezvous, wait_all_done
+    from multiverso_tpu.models.word2vec import Dictionary, read_corpus
+    from multiverso_tpu.models.word2vec.distributed import DistributedWord2Vec
+    from multiverso_tpu.parallel.ps_service import PSService
+
+    train_file = configure.get_flag("train_file")
+    if not train_file:
+        log.error("missing -train_file")
+        return 1
+    rdv = configure.get_flag("rendezvous_dir")
+    if not rdv:
+        log.error("distributed rank needs -rendezvous_dir")
+        return 1
+    dictionary = Dictionary.build(read_corpus(train_file),
+                                  min_count=configure.get_flag("min_count"))
+    log.info("rank %d/%d: vocab=%d", rank, world, len(dictionary))
+    cfg = _cfg_from_flags(device_pipeline=False)
+    svc = PSService()
+    try:
+        peers = rendezvous(rdv, rank, world, svc.address)
+        w2v = DistributedWord2Vec(cfg, dictionary, svc, peers, rank=rank)
+        sents = (dictionary.encode(s) for i, s in
+                 enumerate(read_corpus(train_file)) if i % world == rank)
+        stats = w2v.train(sents)
+        log.info("rank %d trained: %.0f words/sec", rank,
+                 stats["words_per_sec"])
+        if rank == 0:
+            emb = w2v.embeddings().astype("float32")
+            out = configure.get_flag("output_file")
+            with open(out, "w") as f:
+                f.write(f"{len(dictionary)} {cfg.embedding_size}\n")
+                for i, vec in enumerate(emb):
+                    f.write(dictionary.words[i] + " " +
+                            " ".join(f"{x:.6f}" for x in vec) + "\n")
+            log.info("rank 0 saved %s", out)
+        wait_all_done(rdv, rank, world)
+    finally:
+        svc.close()
+    Dashboard.display()
+    return 0
+
+
+def _body(argv: List[str]) -> int:
+    del argv
+    from multiverso_tpu.models.word2vec import (Dictionary, Word2Vec,
+                                                read_corpus)
+
+    world = configure.get_flag("world_size")
+    rank = configure.get_flag("w2v_rank")
+    if world > 1 and rank >= 0:
+        return _body_distributed(world, rank)
+
+    train_file = configure.get_flag("train_file")
+    if not train_file:
+        log.error("missing -train_file")
+        return 1
+    log.info("building vocabulary from %s", train_file)
+    dictionary = Dictionary.build(read_corpus(train_file),
+                                  min_count=configure.get_flag("min_count"))
+    log.info("vocab=%d total_words=%d", len(dictionary),
+             dictionary.total_count)
+    cfg = _cfg_from_flags(device_pipeline=True)
     w2v = Word2Vec(cfg, dictionary)
     stats = w2v.train(corpus_path=train_file)
     log.info("trained: %.0f words/sec", stats["words_per_sec"])
@@ -86,9 +153,30 @@ def _body(argv: List[str]) -> int:
     return 0
 
 
+configure.define_string("w2v_device", "cpu",
+                        "distributed ranks: jax platform (cpu|default). "
+                        "N local ranks must not contend for one TPU chip; "
+                        "'default' keeps the platform auto-selection for "
+                        "one-rank-per-host deployments")
+
+
 def main(argv=None) -> int:
-    from multiverso_tpu.apps._runner import run_app
-    return run_app(_body, argv)
+    from multiverso_tpu.apps._runner import (pin_cpu_for_local_rank,
+                                             run_app, spawn_ranks)
+
+    args = argv if argv is not None else sys.argv[1:]
+    # Launcher path runs BEFORE run_app: it must not start the runtime (or
+    # touch jax) just to fork workers. Raw-argv scan: flags not parsed yet.
+    world = next((int(a.split("=", 1)[1]) for a in args
+                  if a.startswith("-world_size=")), 1)
+    has_rank = any(a.startswith("-w2v_rank=") and not a.endswith("=-1")
+                   for a in args)
+    if world > 1 and not has_rank:
+        return spawn_ranks("multiverso_tpu.apps.word2vec_main", args, world,
+                           rank_flag="w2v_rank")
+    if has_rank:
+        pin_cpu_for_local_rank(args, device_flag="w2v_device")
+    return run_app(_body, args)
 
 
 if __name__ == "__main__":

@@ -21,9 +21,11 @@ from multiverso_tpu.utils.log import log
 
 
 class LogReg:
-    def __init__(self, cfg: LogRegConfig):
+    def __init__(self, cfg: LogRegConfig, model=None):
+        """``model``: inject a pre-built model (e.g. a PSModel over a
+        cross-process DistributedArrayTable); default builds from cfg."""
         self.cfg = cfg
-        self.model = make_model(cfg)
+        self.model = model if model is not None else make_model(cfg)
         _, predict = get_objective(cfg.objective)
         self._predict = jax.jit(predict)
         if cfg.init_model_file:

@@ -9,7 +9,7 @@ one MXU matmul; the background thread is the ``ASyncBuffer`` analog.
 
 from __future__ import annotations
 
-from typing import Iterator, List, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -38,7 +38,8 @@ class SampleReader:
 
     def __init__(self, path: str, num_feature: int, minibatch_size: int,
                  input_format: str = "libsvm", bias: bool = True,
-                 prefetch: bool = True):
+                 prefetch: bool = True,
+                 shard: Optional[Tuple[int, int]] = None):
         check(input_format in ("libsvm", "dense"),
               f"unknown input format '{input_format}'")
         self.path = path
@@ -48,12 +49,18 @@ class SampleReader:
         self.bias = bias
         self.prefetch = prefetch
         self.width = num_feature + (1 if bias else 0)
+        # (rank, world): stream only every world-th sample — the
+        # distributed ranks' data split
+        self.shard = shard
 
     def _batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         with open(self.path) as f:
             rows_x: List = []
             rows_y: List[float] = []
-            for line in f:
+            for lineno, line in enumerate(f):
+                if self.shard is not None and \
+                        lineno % self.shard[1] != self.shard[0]:
+                    continue
                 line = line.strip()
                 if not line:
                     continue

@@ -140,12 +140,13 @@ def pipeline_train_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
                         return_input_grads: bool = False):
     """One-forward-one-backward pipeline training step.
 
-    Returns ``(total_loss, stage_grads)`` where ``total_loss`` is the sum of
-    ``loss_fn(y_m, target_m)`` over the M microbatches and ``stage_grads``
-    matches ``stage_params`` (leading [S] stage axis) — identical (up to
-    float assoc.) to ``jax.grad`` of the sequential chain, but scheduled so
-    each microbatch's backward runs as soon as its forward clears the last
-    stage.
+    Returns ``(loss, stage_grads[, head_grads][, input_grads])`` (the
+    optional entries appear when ``head_params`` / ``return_input_grads``
+    are set): ``loss`` is the sum of ``loss_fn(y_m, target_m)`` over the M
+    microbatches and ``stage_grads`` matches ``stage_params`` (leading [S]
+    stage axis) — identical (up to float assoc.) to ``jax.grad`` of the
+    sequential chain, but scheduled so each microbatch's backward runs as
+    soon as its forward clears the last stage.
 
     Schedule (t = tick, s = stage id):
 
