@@ -1,0 +1,169 @@
+"""Device-resident open-addressing key directory (int64 keys -> slot ids).
+
+The hybrid :class:`~multiverso_tpu.tables.device_kv_table.DeviceKVTable`
+keeps its key directory in a host dict — a Python loop per batch. This
+module provides the fully device-resident alternative the roadmap's
+lightLDA-scale KV workloads want: the directory is three jax arrays
+(key halves + slot), lookups are one jitted vectorized linear-probe loop,
+and batch inserts use the standard GPU-hash-table recipe — rounds of
+(probe, claim-by-scatter-min, winners-insert) until every key owns a slot.
+Duplicate keys within a batch converge because losers re-probe and find the
+winner's entry the next round.
+
+Design notes (TPU-first):
+
+* Pure XLA under ``jit`` (gathers + scatter-min + ``while_loop``), not a
+  Pallas kernel: probing is data-dependent CONTROL, not a bandwidth-bound
+  data plane — exactly what ``lax.while_loop`` compiles well, and it stays
+  differentiable-adjacent/shardable for free. The value slab it indexes is
+  where the bytes move, and that path already runs the jitted updaters.
+* Keys are split into int32 halves (device int64 is off by default in
+  jax); the mix folds both halves, so plain int32 keys and true 64-bit
+  keys both hash well.
+* Linear probing with power-of-two capacity (mask, no div). Probes stop at
+  the first EMPTY slot — absence proof, and the insert position.
+* Load factor <= 0.5 by construction (directory is 2x the slot capacity),
+  so expected probe chains stay O(1).
+
+Parity: the reference's server-side ``unordered_map`` lives in
+``kv_table.h:86-106``; this is its accelerator-resident analog (reference
+has no equivalent — surplus capability).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EMPTY = jnp.int32(-1)          # slot entry for an unoccupied bucket
+
+
+class DirState(NamedTuple):
+    """Directory arrays. ``slot[i] < 0`` means bucket i is empty."""
+    k_hi: jax.Array             # [C] int32
+    k_lo: jax.Array             # [C] int32
+    slot: jax.Array             # [C] int32
+    next_slot: jax.Array        # [] int32 — next unused value-slab row
+
+
+def make_state(capacity_slots: int) -> DirState:
+    """Directory sized to the next power of two >= 2x the slot capacity."""
+    c = 1
+    while c < 2 * max(capacity_slots, 1):
+        c *= 2
+    return DirState(
+        k_hi=jnp.zeros(c, jnp.int32),
+        k_lo=jnp.zeros(c, jnp.int32),
+        slot=jnp.full(c, _EMPTY, jnp.int32),
+        next_slot=jnp.int32(0),
+    )
+
+
+def split_keys(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """int64 host keys -> (hi, lo) int32 halves."""
+    keys = np.asarray(keys, dtype=np.int64)
+    return ((keys >> 32).astype(np.int32),
+            (keys & 0xFFFFFFFF).astype(np.int32))
+
+
+def _mix(hi: jax.Array, lo: jax.Array) -> jax.Array:
+    """fmix32-style avalanche over both halves (wrapping int32 math)."""
+    x = lo ^ (hi * jnp.int32(-1640531527))        # 0x9E3779B9 golden ratio
+    x = (x ^ (x >> 16)) * jnp.int32(0x45D9F3B)
+    x = (x ^ (x >> 16)) * jnp.int32(0x45D9F3B)
+    return x ^ (x >> 16)
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def lookup(state: DirState, hi: jax.Array, lo: jax.Array) -> jax.Array:
+    """Vectorized probe: returns slots [B] (-1 for absent keys)."""
+    slots, _, _ = _probe(state, hi, lo)
+    return slots
+
+
+def _probe(state: DirState, hi, lo):
+    """Probe every key until match or first empty bucket.
+    Returns (slots [B] (-1 miss), empty_pos [B] (claimable bucket),
+    overflow [] (probe chain exhausted the table — full))."""
+    C = state.slot.shape[0]
+    mask = jnp.int32(C - 1)
+    B = hi.shape[0]
+    idx0 = _mix(hi, lo) & mask
+
+    def cond(c):
+        _, _, active, steps = c
+        return jnp.logical_and(active.any(), steps < C)
+
+    def body(c):
+        idx, res, active, steps = c
+        cur_slot = jnp.take(state.slot, idx)
+        cur_hi = jnp.take(state.k_hi, idx)
+        cur_lo = jnp.take(state.k_lo, idx)
+        is_empty = cur_slot < 0
+        is_match = (~is_empty) & (cur_hi == hi) & (cur_lo == lo)
+        res = jnp.where(active & is_match, cur_slot, res)
+        stop = is_match | is_empty
+        active = active & ~stop
+        idx = jnp.where(active, (idx + 1) & mask, idx)
+        return idx, res, active, steps + 1
+
+    idx, res, active, steps = jax.lax.while_loop(
+        cond, body,
+        (idx0, jnp.full(B, -1, jnp.int32), jnp.ones(B, bool),
+         jnp.int32(0)))
+    # idx now parks at the stopping bucket: the match position or the
+    # first empty (claimable) one. `active` still set => table full.
+    return res, idx, active.any()
+
+
+@jax.jit
+def insert(state: DirState, hi: jax.Array, lo: jax.Array
+           ) -> Tuple[DirState, jax.Array, jax.Array]:
+    """Resolve every key to a slot, allocating for unseen keys.
+
+    Returns (new_state, slots [B], overflow []). Rounds of: probe ->
+    losers-of-previous-rounds claim their empty bucket by scatter-min of
+    batch index -> winners write (key, fresh slot). Each round settles at
+    least one contender per bucket (and duplicate keys find the winner's
+    entry on re-probe), so the loop terminates in <= B rounds; typical is
+    1-2.
+    """
+    B = hi.shape[0]
+    C = state.slot.shape[0]
+    batch_idx = jnp.arange(B, dtype=jnp.int32)
+
+    def cond(c):
+        state, slots, overflow, rounds = c
+        return jnp.logical_and((slots < 0).any(),
+                               jnp.logical_and(~overflow, rounds <= B))
+
+    def body(c):
+        state, slots, overflow, rounds = c
+        res, empty_pos, full = _probe(state, hi, lo)
+        slots = jnp.where(slots < 0, res, slots)
+        pending = slots < 0
+        # claim: lowest batch index wins each contested empty bucket
+        claim = jnp.full(C, B, jnp.int32).at[
+            jnp.where(pending, empty_pos, C)].min(batch_idx, mode="drop")
+        winner = pending & (jnp.take(claim, empty_pos) == batch_idx)
+        new_ids = state.next_slot + jnp.cumsum(winner.astype(jnp.int32)) - 1
+        wpos = jnp.where(winner, empty_pos, C)       # drop non-winners
+        state = DirState(
+            k_hi=state.k_hi.at[wpos].set(hi, mode="drop"),
+            k_lo=state.k_lo.at[wpos].set(lo, mode="drop"),
+            slot=state.slot.at[wpos].set(new_ids, mode="drop"),
+            next_slot=state.next_slot +
+            winner.sum(dtype=jnp.int32),
+        )
+        slots = jnp.where(winner, new_ids, slots)
+        return state, slots, overflow | full, rounds + 1
+
+    state, slots, overflow, _ = jax.lax.while_loop(
+        cond, body,
+        (state, jnp.full(B, -1, jnp.int32), jnp.bool_(False),
+         jnp.int32(0)))
+    return state, slots, overflow | (slots < 0).any()
