@@ -100,18 +100,28 @@ class MatrixTableOption(TableOption):
 class KVTableOption(TableOption):
     """Distributed key->value map (ref include/multiverso/table/kv_table.h).
 
-    ``device=True`` selects the HBM-slab variant (host key directory over
+    ``device=True`` selects the HBM-slab variant (key directory over
     device-resident values; supports ``value_dim`` vectors and updaters).
+    ``device_directory=True`` additionally moves the key->slot directory
+    itself onto the device (jitted open-addressing hash,
+    :mod:`multiverso_tpu.ops.device_hash`) — no host Python loop per batch.
     """
     value_dtype: Any = np.float32
     capacity: int = 1 << 16         # slot capacity (device variant)
     device: bool = False
+    device_directory: bool = False
     value_dim: int = 1
 
     def __init__(self, value_dtype: Any = np.float32, capacity: int = 1 << 16,
-                 device: bool = False, value_dim: int = 1, **kw: Any):
+                 device: bool = False, value_dim: int = 1,
+                 device_directory: bool = False, **kw: Any):
         super().__init__(**kw)
         self.value_dtype = value_dtype
         self.capacity = int(capacity)
         self.device = bool(device)
+        self.device_directory = bool(device_directory)
+        if self.device_directory and not self.device:
+            raise ValueError(
+                "KVTableOption(device_directory=True) requires device=True "
+                "— the jitted directory only exists for the HBM-slab table")
         self.value_dim = int(value_dim)

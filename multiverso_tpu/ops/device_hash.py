@@ -48,10 +48,16 @@ class DirState(NamedTuple):
     k_lo: jax.Array             # [C] int32
     slot: jax.Array             # [C] int32
     next_slot: jax.Array        # [] int32 — next unused value-slab row
+    capacity: jax.Array         # [] int32 — value-slab rows (<= C//2)
 
 
 def make_state(capacity_slots: int) -> DirState:
-    """Directory sized to the next power of two >= 2x the slot capacity."""
+    """Directory sized to the next power of two >= 2x the slot capacity.
+
+    ``capacity_slots`` is remembered so :func:`insert` reports overflow as
+    soon as allocations would exceed the value slab the caller sized — not
+    only when a probe chain exhausts the (2x larger) directory.
+    """
     c = 1
     while c < 2 * max(capacity_slots, 1):
         c *= 2
@@ -60,6 +66,7 @@ def make_state(capacity_slots: int) -> DirState:
         k_lo=jnp.zeros(c, jnp.int32),
         slot=jnp.full(c, _EMPTY, jnp.int32),
         next_slot=jnp.int32(0),
+        capacity=jnp.int32(capacity_slots),
     )
 
 
@@ -71,11 +78,19 @@ def split_keys(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def _mix(hi: jax.Array, lo: jax.Array) -> jax.Array:
-    """fmix32-style avalanche over both halves (wrapping int32 math)."""
-    x = lo ^ (hi * jnp.int32(-1640531527))        # 0x9E3779B9 golden ratio
-    x = (x ^ (x >> 16)) * jnp.int32(0x45D9F3B)
-    x = (x ^ (x >> 16)) * jnp.int32(0x45D9F3B)
-    return x ^ (x >> 16)
+    """fmix32-style avalanche over both halves.
+
+    Runs in uint32 so the right shifts are logical, as the fmix32 recipe
+    requires — a sign-extending shift on int32 would smear the high bit
+    across the shifted-in positions and weaken avalanche for keys with the
+    top bit set (longer probe chains, not wrong answers).
+    """
+    uhi = jax.lax.bitcast_convert_type(hi, jnp.uint32)
+    ulo = jax.lax.bitcast_convert_type(lo, jnp.uint32)
+    x = ulo ^ (uhi * jnp.uint32(0x9E3779B9))      # golden-ratio spread
+    x = (x ^ (x >> 16)) * jnp.uint32(0x45D9F3B)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x45D9F3B)
+    return jax.lax.bitcast_convert_type(x ^ (x >> 16), jnp.int32)
 
 
 @functools.partial(jax.jit, donate_argnums=())
@@ -151,19 +166,82 @@ def insert(state: DirState, hi: jax.Array, lo: jax.Array
             jnp.where(pending, empty_pos, C)].min(batch_idx, mode="drop")
         winner = pending & (jnp.take(claim, empty_pos) == batch_idx)
         new_ids = state.next_slot + jnp.cumsum(winner.astype(jnp.int32)) - 1
+        # Slab overflow: allocations this round would exceed the value-slab
+        # capacity the caller sized. Gate the whole round's writes so no
+        # out-of-bounds slot id ever lands in the directory; the loop cond
+        # exits on overflow and pending keys come back as -1.
+        n_new = winner.sum(dtype=jnp.int32)
+        slab_full = state.next_slot + n_new > state.capacity
+        winner = winner & ~slab_full
         wpos = jnp.where(winner, empty_pos, C)       # drop non-winners
         state = DirState(
             k_hi=state.k_hi.at[wpos].set(hi, mode="drop"),
             k_lo=state.k_lo.at[wpos].set(lo, mode="drop"),
             slot=state.slot.at[wpos].set(new_ids, mode="drop"),
-            next_slot=state.next_slot +
-            winner.sum(dtype=jnp.int32),
+            next_slot=state.next_slot + jnp.where(slab_full, 0, n_new),
+            capacity=state.capacity,
         )
         slots = jnp.where(winner, new_ids, slots)
-        return state, slots, overflow | full, rounds + 1
+        return state, slots, overflow | full | slab_full, rounds + 1
 
     state, slots, overflow, _ = jax.lax.while_loop(
         cond, body,
         (state, jnp.full(B, -1, jnp.int32), jnp.bool_(False),
          jnp.int32(0)))
     return state, slots, overflow | (slots < 0).any()
+
+
+@jax.jit
+def insert_preassigned(state: DirState, hi: jax.Array, lo: jax.Array,
+                       slot_ids: jax.Array
+                       ) -> Tuple[DirState, jax.Array]:
+    """Place (key, slot_id) pairs into the directory without allocating.
+
+    Checkpoint-restore path: :func:`insert`'s allocation order under bucket
+    contention is round-dependent, so re-inserting saved keys does not
+    reproduce a saved key->slot mapping. This writes the *saved* slot ids
+    verbatim. Returns (new_state, overflow). Keys must be distinct, and a
+    key already present with a different slot id is reported as overflow
+    (entries are never rewritten) — restore into a fresh directory.
+    """
+    B = hi.shape[0]
+    C = state.slot.shape[0]
+    batch_idx = jnp.arange(B, dtype=jnp.int32)
+
+    def cond(c):
+        state, placed, overflow, rounds = c
+        return jnp.logical_and((~placed).any(),
+                               jnp.logical_and(~overflow, rounds <= B))
+
+    def body(c):
+        state, placed, overflow, rounds = c
+        res, empty_pos, full = _probe(state, hi, lo)
+        # A key already present with a DIFFERENT slot id cannot be honored
+        # (linear-probe entries are never rewritten) — report it as
+        # overflow rather than silently keeping the stale mapping.
+        conflict = (res >= 0) & (res != slot_ids)
+        placed = placed | (res >= 0)
+        overflow = overflow | conflict.any()
+        pending = ~placed
+        claim = jnp.full(C, B, jnp.int32).at[
+            jnp.where(pending, empty_pos, C)].min(batch_idx, mode="drop")
+        winner = pending & (jnp.take(claim, empty_pos) == batch_idx)
+        wpos = jnp.where(winner, empty_pos, C)
+        state = DirState(
+            k_hi=state.k_hi.at[wpos].set(hi, mode="drop"),
+            k_lo=state.k_lo.at[wpos].set(lo, mode="drop"),
+            slot=state.slot.at[wpos].set(slot_ids, mode="drop"),
+            next_slot=jnp.maximum(
+                state.next_slot,
+                jnp.where(winner, slot_ids + 1, 0).max()
+                if B else state.next_slot),
+            capacity=state.capacity,
+        )
+        placed = placed | winner
+        return state, placed, overflow | full, rounds + 1
+
+    overflow0 = (slot_ids >= state.capacity).any() if B else jnp.bool_(False)
+    state, placed, overflow, _ = jax.lax.while_loop(
+        cond, body,
+        (state, jnp.zeros(B, bool), jnp.bool_(overflow0), jnp.int32(0)))
+    return state, overflow | (~placed).any()

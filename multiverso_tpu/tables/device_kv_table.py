@@ -1,12 +1,17 @@
-"""Device-resident KV table: host key directory over an HBM value slab.
+"""Device-resident KV table: key directory over an HBM value slab.
 
 The plain :class:`KVTable` keeps values host-side — faithful to the
 reference's metadata use (``kv_table.h``), but wrong for KV workloads whose
 values are large vectors (lightLDA-scale topic rows). This hybrid keeps the
 **values in device HBM** (a sharded slab served by the same jitted updater
-data plane as the matrix tables) while the **key -> slot directory stays on
-the host** — directory ops are branchy pointer-chasing XLA should never see,
-and they're tiny next to the value traffic.
+data plane as the matrix tables). The **key -> slot directory** has two
+backings, selected by ``KVTableOption.device_directory``:
+
+* host dict (default) — branchy pointer-chasing XLA should never see;
+  fine when batches are small relative to value traffic.
+* device hash (:mod:`multiverso_tpu.ops.device_hash`) — a jitted
+  open-addressing directory; resolve is one XLA dispatch per batch instead
+  of a host Python loop, which is what lightLDA-scale key batches want.
 
 Capacity is fixed at creation (slots are never reclaimed — matching the
 reference's grow-only server maps); exceeding it is a fatal check.
@@ -23,6 +28,7 @@ from multiverso_tpu.core.options import AddOption, KVTableOption
 from multiverso_tpu.core.table import ServerStore
 from multiverso_tpu.core.updater import get_updater
 from multiverso_tpu.core.zoo import Zoo
+from multiverso_tpu.ops import device_hash
 from multiverso_tpu.utils.log import check
 
 
@@ -38,6 +44,9 @@ class DeviceKVTable:
                                  (self.capacity, self.value_dim),
                                  option.value_dtype, updater, zoo.mesh,
                                  zoo.num_workers())
+        self._device_dir = bool(getattr(option, "device_directory", False))
+        self._dir_state = (device_hash.make_state(self.capacity)
+                           if self._device_dir else None)
         self._slots: Dict[int, int] = {}
         self._next_slot = 0
         self._lock = threading.Lock()
@@ -47,6 +56,8 @@ class DeviceKVTable:
     def _resolve(self, keys: np.ndarray, allocate: bool) -> np.ndarray:
         """keys -> slot ids; unknown keys get -1 (get) or a fresh slot
         (add)."""
+        if self._device_dir:
+            return self._resolve_device(keys, allocate)
         out = np.empty(len(keys), dtype=np.int32)
         with self._lock:
             for i, k in enumerate(keys.tolist()):
@@ -64,8 +75,37 @@ class DeviceKVTable:
                 out[i] = slot
         return out
 
+    def _resolve_device(self, keys: np.ndarray, allocate: bool) -> np.ndarray:
+        n = len(keys)
+        if n == 0:
+            return np.empty(0, dtype=np.int32)
+        # Pad to the next power of two so jit specializes on a handful of
+        # batch lengths, not every ragged key count. Padding repeats the
+        # first key: a duplicate converges to the same slot, so an insert
+        # allocates nothing extra and a lookup is harmless.
+        padded = 1
+        while padded < n:
+            padded *= 2
+        keys = np.concatenate(
+            [np.asarray(keys, dtype=np.int64),
+             np.full(padded - n, keys[0], dtype=np.int64)])
+        hi, lo = device_hash.split_keys(keys)
+        with self._lock:
+            if allocate:
+                state, slots, overflow = device_hash.insert(
+                    self._dir_state, hi, lo)
+                check(not bool(overflow),
+                      f"DeviceKVTable '{self.name}' capacity "
+                      f"{self.capacity} exhausted")
+                self._dir_state = state
+            else:
+                slots = device_hash.lookup(self._dir_state, hi, lo)
+        return np.asarray(slots)[:n]
+
     def __len__(self) -> int:
         with self._lock:
+            if self._device_dir:
+                return int(self._dir_state.next_slot)
             return len(self._slots)
 
     # -- ops ---------------------------------------------------------------
@@ -94,8 +134,20 @@ class DeviceKVTable:
     # -- checkpointing -----------------------------------------------------
     def store_state(self) -> Dict[str, np.ndarray]:
         with self._lock:
-            keys = np.asarray(list(self._slots.keys()), dtype=np.int64)
-            slots = np.asarray(list(self._slots.values()), dtype=np.int32)
+            if self._device_dir:
+                # Extract the (key, slot) pairs from the directory arrays so
+                # the payload format matches the host-dict variant (a
+                # checkpoint is portable across directory backings).
+                s = self._dir_state
+                occ = np.asarray(s.slot) >= 0
+                k_hi = np.asarray(s.k_hi)[occ].astype(np.int64)
+                k_lo = np.asarray(s.k_lo)[occ].astype(np.int64)
+                keys = (k_hi << 32) | (k_lo & 0xFFFFFFFF)
+                slots = np.asarray(s.slot)[occ]
+            else:
+                keys = np.asarray(list(self._slots.keys()), dtype=np.int64)
+                slots = np.asarray(list(self._slots.values()),
+                                   dtype=np.int32)
         payload = self.store.store_state()
         payload["kv_keys"] = keys
         payload["kv_slots"] = slots
@@ -103,12 +155,27 @@ class DeviceKVTable:
 
     def load_state(self, payload: Dict[str, np.ndarray]) -> None:
         self.store.load_state(payload)
+        keys = payload["kv_keys"]
+        slots = payload["kv_slots"]
         with self._lock:
-            self._slots = dict(zip(payload["kv_keys"].tolist(),
-                                   payload["kv_slots"].tolist()))
-            self._next_slot = (int(payload["kv_slots"].max()) + 1
-                               if len(payload["kv_slots"]) else 0)
+            if self._device_dir:
+                self._dir_state = device_hash.make_state(self.capacity)
+                if len(keys):
+                    hi, lo = device_hash.split_keys(np.asarray(keys))
+                    state, overflow = device_hash.insert_preassigned(
+                        self._dir_state, hi, lo,
+                        np.asarray(slots, dtype=np.int32))
+                    check(not bool(overflow),
+                          f"DeviceKVTable '{self.name}': checkpoint exceeds "
+                          f"capacity {self.capacity}")
+                    self._dir_state = state
+            else:
+                self._slots = dict(zip(keys.tolist(), slots.tolist()))
+                self._next_slot = (int(slots.max()) + 1
+                                   if len(slots) else 0)
 
     def close(self) -> None:
         with self._lock:
             self._slots.clear()
+            if self._device_dir:
+                self._dir_state = device_hash.make_state(self.capacity)

@@ -1,0 +1,217 @@
+"""Device-resident open-addressing directory (ops/device_hash).
+
+Covers the advisor round-2 findings: slab overflow must trip as soon as
+allocations exceed the caller-sized value slab (not only when the 2x
+directory fills), duplicate-key batches, multi-round contention, and the
+unsigned fmix32 avalanche for high-bit keys.
+"""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.options import KVTableOption
+from multiverso_tpu.ops import device_hash as dh
+from multiverso_tpu.tables.device_kv_table import DeviceKVTable
+
+
+def _split(keys):
+    return dh.split_keys(np.asarray(keys, dtype=np.int64))
+
+
+def test_lookup_miss_on_empty():
+    st = dh.make_state(8)
+    hi, lo = _split([1, 2, 1 << 40])
+    slots = np.asarray(dh.lookup(st, hi, lo))
+    assert (slots == -1).all()
+
+
+def test_insert_then_lookup_roundtrip():
+    st = dh.make_state(16)
+    keys = [5, 17, -3, 1 << 35, 0]
+    hi, lo = _split(keys)
+    st, slots, overflow = dh.insert(st, hi, lo)
+    assert not bool(overflow)
+    slots = np.asarray(slots)
+    # distinct keys -> distinct slots in [0, n)
+    assert sorted(slots.tolist()) == list(range(len(keys)))
+    found = np.asarray(dh.lookup(st, hi, lo))
+    np.testing.assert_array_equal(found, slots)
+    # unrelated keys still miss
+    hi2, lo2 = _split([1234, 9999])
+    assert (np.asarray(dh.lookup(st, hi2, lo2)) == -1).all()
+
+
+def test_duplicate_keys_within_batch_converge():
+    st = dh.make_state(8)
+    keys = [42, 7, 42, 42, 7]
+    hi, lo = _split(keys)
+    st, slots, overflow = dh.insert(st, hi, lo)
+    assert not bool(overflow)
+    slots = np.asarray(slots)
+    assert slots[0] == slots[2] == slots[3]
+    assert slots[1] == slots[4]
+    assert slots[0] != slots[1]
+    assert int(st.next_slot) == 2          # only two distinct keys allocated
+
+
+def test_multi_round_contention_dense_batch():
+    """A batch filling the slab exactly: heavy bucket contention, several
+    claim rounds, every key must still land on a unique slot."""
+    cap = 64
+    st = dh.make_state(cap)
+    keys = np.arange(cap, dtype=np.int64) * 7919 + 1  # arbitrary spread
+    hi, lo = _split(keys)
+    st, slots, overflow = dh.insert(st, hi, lo)
+    assert not bool(overflow)
+    slots = np.asarray(slots)
+    assert sorted(slots.tolist()) == list(range(cap))
+    np.testing.assert_array_equal(np.asarray(dh.lookup(st, hi, lo)), slots)
+
+
+def test_slab_overflow_detected_before_directory_full():
+    """ADVICE r2 (medium): 12 distinct keys into make_state(8) previously
+    returned slot ids up to 11 with overflow=False — out-of-bounds into an
+    8-row value slab. Now overflow trips and no slot id >= capacity leaks."""
+    st = dh.make_state(8)
+    hi, lo = _split(np.arange(12, dtype=np.int64) + 100)
+    st, slots, overflow = dh.insert(st, hi, lo)
+    assert bool(overflow)
+    slots = np.asarray(slots)
+    assert slots.max() < 8
+    assert int(st.next_slot) <= 8
+    # directory never stores an out-of-slab slot id
+    assert np.asarray(st.slot).max() < 8
+
+
+def test_incremental_fill_then_overflow():
+    st = dh.make_state(4)
+    hi, lo = _split([1, 2])
+    st, s1, ov = dh.insert(st, hi, lo)
+    assert not bool(ov)
+    hi, lo = _split([3, 4])
+    st, s2, ov = dh.insert(st, hi, lo)
+    assert not bool(ov)
+    hi, lo = _split([5])
+    st, s3, ov = dh.insert(st, hi, lo)
+    assert bool(ov)
+    # existing entries undisturbed
+    hi, lo = _split([1, 2, 3, 4])
+    np.testing.assert_array_equal(
+        np.asarray(dh.lookup(st, hi, lo)),
+        np.concatenate([np.asarray(s1), np.asarray(s2)]))
+
+
+def test_reinsert_existing_allocates_nothing():
+    st = dh.make_state(8)
+    hi, lo = _split([11, 22])
+    st, first, _ = dh.insert(st, hi, lo)
+    st, again, overflow = dh.insert(st, hi, lo)
+    assert not bool(overflow)
+    np.testing.assert_array_equal(np.asarray(first), np.asarray(again))
+    assert int(st.next_slot) == 2
+
+
+def test_high_bit_keys_mix_unsigned():
+    """Keys with the int32 high bit set probe fine (logical-shift mix)."""
+    st = dh.make_state(32)
+    keys = [-1, -2, -(1 << 40), (1 << 63) - 1, -(1 << 62)]
+    hi, lo = _split(keys)
+    st, slots, overflow = dh.insert(st, hi, lo)
+    assert not bool(overflow)
+    assert sorted(np.asarray(slots).tolist()) == list(range(len(keys)))
+    np.testing.assert_array_equal(np.asarray(dh.lookup(st, hi, lo)),
+                                  np.asarray(slots))
+
+
+def test_insert_preassigned_reproduces_mapping():
+    """Checkpoint-restore: saved (key, slot) pairs rebuild verbatim."""
+    st = dh.make_state(16)
+    keys = np.arange(10, dtype=np.int64) * 1_000_003
+    hi, lo = _split(keys)
+    st, slots, _ = dh.insert(st, hi, lo)
+    slots = np.asarray(slots)
+    # rebuild into a fresh directory in scrambled order
+    perm = np.random.RandomState(0).permutation(10)
+    st2 = dh.make_state(16)
+    st2, overflow = dh.insert_preassigned(
+        st2, hi[perm], lo[perm], slots[perm].astype(np.int32))
+    assert not bool(overflow)
+    np.testing.assert_array_equal(np.asarray(dh.lookup(st2, hi, lo)), slots)
+    assert int(st2.next_slot) == 10
+
+
+def test_insert_preassigned_overflow_on_bad_slot():
+    st = dh.make_state(4)
+    hi, lo = _split([1])
+    st, overflow = dh.insert_preassigned(st, hi, lo,
+                                         np.asarray([7], dtype=np.int32))
+    assert bool(overflow)
+
+
+def test_insert_preassigned_conflict_reported():
+    """A key already present with a different slot id must not be silently
+    kept — restore requires a fresh directory."""
+    st = dh.make_state(8)
+    hi, lo = _split([42])
+    st, slots, _ = dh.insert(st, hi, lo)
+    assert int(np.asarray(slots)[0]) == 0
+    st2, overflow = dh.insert_preassigned(st, hi, lo,
+                                          np.asarray([5], dtype=np.int32))
+    assert bool(overflow)
+
+
+def test_device_directory_requires_device_flag():
+    with pytest.raises(ValueError):
+        KVTableOption(device_directory=True, capacity=8)
+
+
+# -- DeviceKVTable wiring ---------------------------------------------------
+
+def _dir_table(**kw):
+    return DeviceKVTable(KVTableOption(device=True, device_directory=True,
+                                       **kw))
+
+
+def test_kv_device_directory_semantics(mv_env):
+    t = _dir_table(capacity=64)
+    t.add([10, 99, 10**12], [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(t.get([10, 99, 10**12]), [1.0, 2.0, 3.0])
+    t.add([99], [10.0])
+    np.testing.assert_allclose(t.get([99]), [12.0])
+    np.testing.assert_allclose(t.get([555]), [0.0])   # miss reads zero
+    assert len(t) == 3                                 # gets don't allocate
+
+
+def test_kv_device_directory_capacity_fatal(mv_env):
+    from multiverso_tpu.utils.log import FatalError
+    t = _dir_table(capacity=2)
+    t.add([1, 2], [1.0, 1.0])
+    with pytest.raises(FatalError):
+        t.add([3], [1.0])
+
+
+def test_kv_device_directory_checkpoint_roundtrip(mv_env):
+    import os
+    import tempfile
+
+    from multiverso_tpu.core import checkpoint as ckpt
+
+    t = _dir_table(capacity=32, name="dkvdir")
+    t.add([100, 200, 300], [1.0, 2.0, 3.0])
+    uri = f"file://{os.path.join(tempfile.mkdtemp(), 'dkvdir.npz')}"
+    ckpt.save_table(t, uri)
+    t.add([100, 400], [50.0, 7.0])
+    ckpt.load_table(t, uri)
+    np.testing.assert_allclose(t.get([100, 200, 300, 400]),
+                               [1.0, 2.0, 3.0, 0.0])
+    assert len(t) == 3
+
+
+def test_factory_routes_device_directory(mv_env):
+    t = mv.create_table(KVTableOption(device=True, device_directory=True,
+                                      capacity=16, value_dim=4))
+    assert isinstance(t, DeviceKVTable)
+    assert t._device_dir
+    t.add([3], np.ones((1, 4), dtype=np.float32))
+    np.testing.assert_allclose(t.get([3]), np.ones((1, 4)))
