@@ -22,8 +22,32 @@ import time
 import numpy as np
 
 
+_evidence_fh = None
+
+
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+    if _evidence_fh is not None:
+        try:
+            _evidence_fh.write(msg + "\n")
+            _evidence_fh.flush()
+        except OSError:
+            pass
+
+
+def _open_evidence(here: str) -> None:
+    """Persist the full bench narrative as BENCH_EVIDENCE.txt so a
+    successful run leaves auditable per-phase detail next to the one-line
+    JSON record (VERDICT r2: driver-verifiable perf story)."""
+    global _evidence_fh
+    try:
+        _evidence_fh = open(os.path.join(here, "BENCH_EVIDENCE.txt"), "w")
+        _evidence_fh.write(
+            "# bench.py evidence log — "
+            + time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()) + "\n")
+        _evidence_fh.flush()
+    except OSError:
+        _evidence_fh = None
 
 
 # TPU v5e (v5 lite) per-chip peaks — the yardstick for the utilization
@@ -243,6 +267,27 @@ def _probe_backend(timeout_s: int = 90) -> bool:
         return False
 
 
+def _probe_backend_with_retry() -> bool:
+    """Tunnel flaps are transient more often than not: retry the probe with
+    backoff over several minutes before conceding an outage (VERDICT r2
+    next-round #1a). Worst case ~13 min (5 sleeps + 6 x 90s probes); a live
+    tunnel returns on the first probe in a few seconds."""
+    delays = [0, 30, 60, 120, 180, 240]
+    for attempt, delay in enumerate(delays, start=1):
+        if delay:
+            _log(f"backend probe: retrying in {delay}s "
+                 f"(attempt {attempt}/{len(delays)})")
+            time.sleep(delay)
+        t0 = time.perf_counter()
+        if _probe_backend():
+            _log(f"backend probe OK on attempt {attempt} "
+                 f"({time.perf_counter() - t0:.1f}s)")
+            return True
+        _log(f"backend probe failed/timed out on attempt {attempt} "
+             f"({time.perf_counter() - t0:.1f}s)")
+    return False
+
+
 def bench_pallas_rows() -> None:
     """Pallas vs XLA row scatter-add on the same table shape (stderr only)."""
     import time as _time
@@ -280,11 +325,11 @@ def bench_pallas_rows() -> None:
 
 
 def main() -> None:
-    import multiverso_tpu as mv
-
     here = os.path.dirname(os.path.abspath(__file__))
-    if not _probe_backend():
-        _log("backend unreachable (tunneled TPU down?) — recording zeros")
+    _open_evidence(here)
+    if not _probe_backend_with_retry():
+        _log("backend unreachable after retry schedule (tunneled TPU "
+             "down) — recording zeros")
         recorded, src = None, "BENCH_BASELINE.json"
         for name in ("BENCH_LATEST.json", "BENCH_BASELINE.json"):
             path = os.path.join(here, name)
@@ -300,13 +345,23 @@ def main() -> None:
         print(json.dumps({
             "metric": "w2v_words_per_sec", "value": 0.0,
             "unit": "words/sec/chip", "vs_baseline": 0.0,
-            "error": "jax backend unreachable within probe timeout "
-                     "(tunnel outage); last measured value on this chip: "
+            "error": "jax backend unreachable after 6 probes with backoff "
+                     "over ~13 min (tunnel outage; see BENCH_EVIDENCE.txt); "
+                     "last measured value on this chip: "
                      f"{recorded} ({src}, docs/BENCHMARK.md)",
         }))
         return
 
+    import multiverso_tpu as mv
+
     mv.init([])
+    try:
+        import jax
+        dev = jax.devices()[0]
+        _log(f"backend: {dev.platform} ({len(jax.devices())} device(s), "
+             f"{getattr(dev, 'device_kind', '?')})")
+    except Exception:  # noqa: BLE001 - informational only
+        pass
     try:
         updates_per_sec = bench_matrix_table()
         try:
