@@ -31,6 +31,9 @@ mv.barrier()
 out = mv.aggregate(np.full(8, float(rank + 1), dtype=np.float32))
 # 1.0 + 2.0 from the two ranks
 np.testing.assert_allclose(out, np.full(8, 3.0))
+# 2-D model-average shape through the same psum path
+mat = mv.aggregate(np.full((4, 3), float(rank + 1), dtype=np.float32))
+np.testing.assert_allclose(mat, np.full((4, 3), 3.0))
 mv.barrier()
 mv.shutdown()
 print(f"RANK{rank}_OK")
