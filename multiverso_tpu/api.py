@@ -163,6 +163,27 @@ def create_distributed_array_table(table_id: int, size: int, rank: int,
     return table
 
 
+def create_distributed_matrix_table(table_id: int, num_row: int,
+                                    num_col: int, rank: int, dtype=None,
+                                    updater: str = "default"):
+    """Distributed (row-sharded across processes) matrix table over the
+    bound service + connected peers (ref ``matrix_table.cpp:24-45`` row
+    sharding, served here by the DCN PS service)."""
+    import numpy as _np
+
+    from multiverso_tpu.parallel.ps_service import DistributedMatrixTable
+
+    zoo = Zoo.get()
+    check(zoo.ps_service is not None, "call mv.net_bind() first")
+    check(len(zoo.ps_peers) > 0, "call mv.net_connect() first")
+    table = DistributedMatrixTable(table_id, num_row, num_col,
+                                   zoo.ps_service, list(zoo.ps_peers), rank,
+                                   dtype=dtype or _np.float32,
+                                   updater=updater)
+    zoo.register_table(table)   # so shutdown closes its peer connections
+    return table
+
+
 def finish_train(worker_id: Optional[int] = None) -> None:
     """``Zoo::FinishTrain`` analog (ref src/zoo.cpp:152-161): release this
     worker from every table's BSP clocks so stragglers can drain to

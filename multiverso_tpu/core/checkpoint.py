@@ -70,28 +70,62 @@ def load_table(table: Any, uri: str) -> None:
         table.store.load_state(payload)
 
 
+def _ps_rank(zoo: Zoo) -> int:
+    """This process's rank in the DCN PS world (0 when no service bound or
+    the service never adopted a rank — PSService.rank starts as None)."""
+    svc = getattr(zoo, "ps_service", None)
+    rank = getattr(svc, "rank", None) if svc is not None else None
+    return rank if rank is not None else 0
+
+
+def _meta_name(rank: int) -> str:
+    """Distributed tables shard per PS rank, so each rank writes its own
+    manifest into the shared checkpoint dir (rank 0 keeps the plain name
+    for single-process compatibility)."""
+    return "meta.json" if rank == 0 else f"meta.r{rank}.json"
+
+
 def save_all(directory: str, step: int = 0) -> str:
-    """Checkpoint every registered table into ``directory/ckpt_{step}/``."""
+    """Checkpoint every registered table into ``directory/ckpt_{step}/``.
+
+    Distributed tables (``DistributedArrayTable``/``DistributedMatrixTable``)
+    contribute only this rank's shard, filename-qualified via their
+    ``checkpoint_suffix``; on ranks > 0 every OTHER table's file is
+    qualified with the rank too (it is per-process replica state), so
+    concurrent ranks saving into a shared directory never collide."""
     zoo = Zoo.get()
     check(zoo.started, "runtime not started")
+    rank = _ps_rank(zoo)
     root = os.path.join(directory, f"ckpt_{step:012d}")
     names: List[str] = []
+    files: Dict[str, str] = {}
     for i, table in enumerate(zoo.tables):
         name = getattr(table, "name", f"table_{i}")
-        save_table(table, os.path.join(root, f"{name}.npz"))
+        suffix = getattr(table, "checkpoint_suffix",
+                         f"-r{rank}" if rank else "")
+        fname = f"{name}{suffix}.npz"
+        save_table(table, os.path.join(root, fname))
         names.append(name)
-    meta = {"step": step, "time": time.time(), "tables": names}
-    with open_stream(os.path.join(root, "meta.json"), "w") as s:
+        files[name] = fname
+    meta = {"step": step, "time": time.time(), "tables": names,
+            "files": files}
+    with open_stream(os.path.join(root, _meta_name(_ps_rank(zoo))),
+                     "w") as s:
         s.write(json.dumps(meta).encode())
     return root
 
 
 def load_all(checkpoint_dir: str) -> int:
     """Restore every registered table from a ``ckpt_*`` directory; returns
-    the step."""
+    the step. Each rank reads its own manifest (falling back to rank 0's
+    for checkpoints written by a single process)."""
     zoo = Zoo.get()
-    with open_stream(os.path.join(checkpoint_dir, "meta.json"), "r") as s:
+    meta_path = os.path.join(checkpoint_dir, _meta_name(_ps_rank(zoo)))
+    if not exists(meta_path):
+        meta_path = os.path.join(checkpoint_dir, "meta.json")
+    with open_stream(meta_path, "r") as s:
         meta = json.loads(s.read().decode())
+    files = meta.get("files", {})
     by_name = {getattr(t, "name", f"table_{i}"): t
                for i, t in enumerate(zoo.tables)}
     for name in meta["tables"]:
@@ -99,7 +133,8 @@ def load_all(checkpoint_dir: str) -> int:
         if table is None:
             log.error("checkpoint has unknown table '%s'; skipping", name)
             continue
-        load_table(table, os.path.join(checkpoint_dir, f"{name}.npz"))
+        fname = files.get(name, f"{name}.npz")
+        load_table(table, os.path.join(checkpoint_dir, fname))
     return int(meta["step"])
 
 
@@ -141,9 +176,12 @@ class CheckpointManager:
             if re.fullmatch(r"ckpt_\d{12}", d))
         for stale in ckpts[:-self.keep_last]:
             full = os.path.join(self.directory, stale)
-            for f in os.listdir(full):
-                os.unlink(os.path.join(full, f))
-            os.rmdir(full)
+            try:   # concurrent ranks may prune the same shared directory
+                for f in os.listdir(full):
+                    os.unlink(os.path.join(full, f))
+                os.rmdir(full)
+            except OSError:
+                pass
 
     def restore_latest(self) -> Optional[int]:
         path = latest_checkpoint(self.directory)

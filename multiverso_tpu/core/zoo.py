@@ -75,6 +75,7 @@ class Zoo:
         self.tables: List[Any] = []
         self._barrier_count = 0
         self._num_local_workers = 1
+        self._local_mesh: Optional[jax.sharding.Mesh] = None
         # Explicit net bind/connect state (MV_NetBind/MV_NetConnect parity)
         self.ps_service: Optional[Any] = None
         self.ps_peers: List[Any] = []
@@ -158,6 +159,7 @@ class Zoo:
             self.ps_service = None
         self.ps_peers = []
         self.mesh = None
+        self._local_mesh = None
         self.started = False
 
     # -- identity (ref include/multiverso/zoo.h:38-50) ---------------------
@@ -185,6 +187,21 @@ class Zoo:
     @property
     def num_local_workers(self) -> int:
         return self._num_local_workers
+
+    @property
+    def local_mesh(self) -> jax.sharding.Mesh:
+        """Mesh over THIS process's devices only. The DCN PS tables shard
+        across processes via the TCP service, so their per-process stores
+        must never sit on a process-spanning mesh — a store op would
+        otherwise compile to a global collective that hangs unless every
+        rank calls it in lockstep. In a single-process world this is
+        ``self.mesh``."""
+        if self.size() == 1:
+            return self.mesh
+        if self._local_mesh is None:
+            self._local_mesh = mesh_lib.build_mesh(
+                devices=jax.local_devices(), spec="")
+        return self._local_mesh
 
     # -- barrier (ref src/zoo.cpp:164-176) ---------------------------------
     def barrier(self) -> None:

@@ -25,6 +25,7 @@ clocks). BSP across processes should use the collective path instead.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import queue as _queue_mod
 import selectors
 import socket
@@ -35,7 +36,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from multiverso_tpu.core.actor import Message, MsgType
-from multiverso_tpu.core.options import AddOption
+from multiverso_tpu.core.options import AddOption, GetOption
 from multiverso_tpu.core.table import ServerStore
 from multiverso_tpu.core.updater import get_updater
 from multiverso_tpu.core.zoo import Zoo
@@ -45,6 +46,71 @@ from multiverso_tpu.runtime.ffi import DeltaBuffer
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check, log
 from multiverso_tpu.utils.quantization import OneBitsFilter, SparseFilter
+
+
+class _TableSyncGate:
+    """SyncServer clock gating for one table shard (ref src/server.cpp:68-222).
+
+    Per-worker vector clocks for adds and gets; a request that is ahead of
+    the lagging workers is CACHED (per-worker FIFO, preserving each
+    worker's program order) and drained when the laggards catch up — the
+    reference's cache-and-drain, which is mandatory here for the same
+    reason it was there: the single dispatcher thread must never block on
+    a gate whose unlocking traffic arrives through the same loop. Worker
+    identity travels in the request exactly as the reference's
+    AddOption/GetOption worker_id does (``updater.h:10-110``,
+    ``sparse_matrix_table.cpp:36-43``): Adds carry it in the option
+    scalars, BSP Gets append a worker-id blob; a message without either
+    falls back to the sending rank.
+    """
+
+    def __init__(self, num_workers: int):
+        from multiverso_tpu.core.sync_coordinator import VectorClock
+        self._n = num_workers
+        self._adds = VectorClock(num_workers)
+        self._gets = VectorClock(num_workers)
+        self.cached: Dict[int, "collections.deque"] = \
+            collections.defaultdict(collections.deque)
+
+    def worker_of(self, msg: Message) -> int:
+        if msg.type == MsgType.Request_Add and len(msg.data) > 1:
+            w = int(msg.data[1][0])         # AddOption scalars, worker_id
+        elif msg.type == MsgType.Request_Get and len(msg.data) > 1 \
+                and msg.data[1].size:
+            w = int(msg.data[1][0])         # GetOption analog blob
+        else:
+            w = max(msg.src, 0)
+        return w % self._n    # an unstamped id must not kill the dispatcher
+
+    def admissible(self, msg: Message) -> bool:
+        if self.cached[self.worker_of(msg)]:
+            return False        # program order: earlier ops still cached
+        return self.head_admissible(msg)
+
+    def head_admissible(self, msg: Message) -> bool:
+        """Clock algebra only (used when draining a worker's queue head).
+
+        Add from w applies only while w's get count is not ahead of the
+        global min (ref ProcessAdd caching rule); Get from w serves only
+        while w's add count is not ahead (ref ProcessGet) — so every
+        worker's i-th Get sees identical parameters (src/server.cpp:61-67).
+        """
+        w = self.worker_of(msg)
+        if msg.type == MsgType.Request_Add:
+            return self._gets.value(w) <= self._gets.min()
+        return self._adds.value(w) <= self._adds.min()
+
+    def tick(self, msg: Message) -> None:
+        if msg.type == MsgType.Request_Add:
+            self._adds.tick(self.worker_of(msg))
+        else:
+            self._gets.tick(self.worker_of(msg))
+
+    def finish(self, worker: int) -> None:
+        """Server_Finish_Train: clocks to infinity so stragglers drain
+        (ref src/server.cpp:190-213)."""
+        self._adds.finish(worker % self._n)
+        self._gets.finish(worker % self._n)
 
 
 class PSService:
@@ -66,6 +132,7 @@ class PSService:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  register_timeout: float = 30.0):
         self._tables: Dict[int, Tuple[ServerStore, int]] = {}
+        self._sync: Dict[int, _TableSyncGate] = {}
         self._directory: Dict[int, Tuple[str, int]] = {}
         self.rank: Optional[int] = None
         self._lock = threading.Lock()
@@ -101,8 +168,15 @@ class PSService:
 
     # -- shard registry -----------------------------------------------------
     def register_shard(self, table_id: int, store: ServerStore,
-                       row_offset: int = 0) -> None:
+                       row_offset: int = 0, sync_workers: int = 0) -> None:
+        """``sync_workers > 0`` arms BSP clock gating for this table
+        (SyncServer mode, selected by ``-sync=true`` exactly as the
+        reference chooses its server subclass, src/server.cpp:224-231)."""
         with self._lock:
+            # Gate BEFORE table: _gate_for's lock-free fast path treats
+            # "in _tables but not in _sync" as a registered async table.
+            if sync_workers > 0:
+                self._sync.setdefault(table_id, _TableSyncGate(sync_workers))
             self._tables[table_id] = (store, row_offset)
             self._registered.notify_all()
 
@@ -173,14 +247,63 @@ class PSService:
             if item is None:
                 return
             sock, msg = item
-            try:
-                reply = self._dispatch_control(msg)
-                if reply is not None:
-                    sock.settimeout(60)     # a peer that never reads its
-                    send_message(sock, reply)  # replies gets disconnected
-                    sock.settimeout(None)
-            except OSError:
-                self._to_drop.append(sock)  # IO thread owns the teardown
+            gate = self._gate_for(msg)
+            if gate is not None and not gate.admissible(msg):
+                gate.cached[gate.worker_of(msg)].append((sock, msg))
+                continue
+            self._serve(sock, msg, gate)
+            if gate is not None or msg.type == MsgType.Server_Finish_Train:
+                self._drain_sync_caches()
+
+    def _gate_for(self, msg: Message) -> Optional[_TableSyncGate]:
+        """Sync gate for a table op, or None (async table / control msg).
+        For an unregistered table, waits for shard registration so an early
+        peer request can't race the gate's creation; once registered, the
+        lock-free dict reads are the hot path (GIL-atomic; entries are only
+        ever added)."""
+        if msg.type not in (MsgType.Request_Add, MsgType.Request_Get):
+            return None
+        gate = self._sync.get(msg.table_id)
+        if gate is not None:
+            return gate
+        if msg.table_id in self._tables:
+            return None     # registered, async table
+        with self._lock:
+            self._registered.wait_for(lambda: msg.table_id in self._tables,
+                                      self._register_timeout)
+            return self._sync.get(msg.table_id)
+
+    def _serve(self, sock: socket.socket, msg: Message,
+               gate: Optional[_TableSyncGate]) -> None:
+        """Apply + reply + (sync mode) tick the worker's clock. Clock ticks
+        AFTER application, mirroring the reference's single-threaded server
+        actor which applies and clocks atomically."""
+        try:
+            reply = self._dispatch_control(msg)
+            if gate is not None and reply is not None:
+                gate.tick(msg)      # applied: clock moves even if the
+            if reply is not None:   # reply send below fails
+                sock.settimeout(60)     # a peer that never reads its
+                send_message(sock, reply)  # replies gets disconnected
+                sock.settimeout(None)
+        except OSError:
+            self._to_drop.append(sock)  # IO thread owns the teardown
+
+    def _drain_sync_caches(self) -> None:
+        """Re-examine cached out-of-clock requests after any clock movement;
+        each served message may unlock others, so loop to fixpoint (ref
+        SyncServer's drain of its MtQueues, src/server.cpp:141-188)."""
+        progress = True
+        while progress:
+            progress = False
+            with self._lock:
+                gates = list(self._sync.values())
+            for gate in gates:
+                for q in list(gate.cached.values()):
+                    while q and gate.head_admissible(q[0][1]):
+                        sock, msg = q.popleft()
+                        self._serve(sock, msg, gate)
+                        progress = True
 
     def _dispatch(self, msg: Message) -> Optional[Message]:
         # Peers may send traffic before this process has registered the
@@ -277,6 +400,18 @@ class PSService:
                 self._directory[rank] = (host, port)
             log.info("directory: rank %d re-registered at %s:%d",
                      rank, host, port)
+            return msg.create_reply()
+        if msg.type == MsgType.Server_Finish_Train:
+            # The named worker is done: its clocks go to infinity on every
+            # sync table so laggards can't wait on it (src/server.cpp:190-213;
+            # trigger Zoo::FinishTrain, src/zoo.cpp:152-161). Drained by the
+            # dispatch loop right after this returns.
+            w = (int(msg.data[0][0]) if msg.data and msg.data[0].size
+                 else max(msg.src, 0))
+            with self._lock:
+                gates = list(self._sync.values())
+            for gate in gates:
+                gate.finish(w)
             return msg.create_reply()
         if msg.type == MsgType.Control_Lookup:
             rank = int(msg.data[0][0])
@@ -533,6 +668,14 @@ class DistributedTableBase:
         self.rank = rank
         self.world = len(peers)
         self._service = service
+        # BSP across processes (-sync=true, ref src/server.cpp:224-231):
+        # every op — including this rank's own — serializes through the
+        # clock-gated dispatch of the owning shard's service, so the
+        # LocalForward shortcut is disabled and delta staging (which merges
+        # N adds into one message, changing the clock count) is off.
+        zoo = Zoo.get()
+        self._bsp = bool(zoo.sync_mode) and self.world > 1
+        self._n_local = max(1, zoo.num_local_workers)
         self._clients: Dict[int, PeerClient] = {}
         self._peers = peers
         # Join the central membership directory (rank 0, the Controller
@@ -552,8 +695,17 @@ class DistributedTableBase:
         self._stage_opt: Optional[AddOption] = None
         self._onebit_filters: Dict[int, OneBitsFilter] = {}
 
+    def _gid(self, worker_id: int) -> int:
+        """Global BSP worker id: contiguous per process (rank * local + k;
+        accepts either a local index or this process's global id)."""
+        return self.rank * self._n_local + (worker_id % self._n_local)
+
+    def _sync_workers(self) -> int:
+        """Gate size for register_shard: every (process, local worker)."""
+        return self.world * self._n_local if self._bsp else 0
+
     def _init_staging(self, rows: int, cols: int, stageable: bool) -> None:
-        if stageable:
+        if stageable and not self._bsp:
             self._stage_buf = DeltaBuffer(rows, cols)
 
     def _client(self, server: int) -> PeerClient:
@@ -680,6 +832,71 @@ class DistributedTableBase:
             cls._msg_counter += 1
             return cls._msg_counter
 
+    def _get_opt_blob(self, option: "Optional[GetOption]"
+                      ) -> List[np.ndarray]:
+        """BSP Gets carry the worker's clock identity as an extra blob (the
+        reference's GetOption, ``sparse_matrix_table.cpp:36-43``); async
+        mode sends the bare keys."""
+        if not self._bsp:
+            return []
+        wid = option.worker_id if option is not None else 0
+        return [np.asarray([self._gid(wid)], dtype=np.int32)]
+
+    def finish_train(self, worker_id: Optional[int] = None) -> None:
+        """Release this worker from every server's BSP clocks
+        (``Zoo::FinishTrain`` -> ``Server_Finish_Train``, ref
+        src/zoo.cpp:152-161, src/server.cpp:190-213). No-op in async
+        mode. ``worker_id`` is a local index (or this process's global
+        id); each local worker retires separately."""
+        if not self._bsp:
+            return
+        gid = self._gid(worker_id if worker_id is not None else 0)
+        parts = []
+        for s in range(self.world):
+            msg = Message(src=self.rank, type=MsgType.Server_Finish_Train,
+                          table_id=self.table_id,
+                          msg_id=self._next_msg_id(),
+                          data=[np.asarray([gid], dtype=np.int32)])
+            try:
+                parts.append((s, msg, self._request_or_retry(s, msg)))
+            except OSError:
+                continue    # dead server can't be holding anyone's gate
+        _PendingOp(parts, retrier=self._retry_request).wait()
+
+    # -- checkpointing -----------------------------------------------------
+    @property
+    def checkpoint_suffix(self) -> str:
+        """Each rank checkpoints only its own shard; ``save_all`` qualifies
+        the filename with this so shards don't collide on a shared
+        filesystem (ref ``table_interface.h:61-75``: Store/Load are
+        per-server-table there too)."""
+        return f"-shard{self.rank}of{self.world}"
+
+    def _shard_offset(self) -> int:
+        raise NotImplementedError
+
+    def store_state(self) -> Dict[str, np.ndarray]:
+        """Serialize this rank's shard (params + updater state) plus shard
+        placement metadata, via the local ServerStore."""
+        self.flush(wait=True)     # staged/in-flight adds land first
+        payload = self.local_store.store_state()
+        payload["shard_meta"] = np.asarray(
+            [self.table_id, self.rank, self.world, self._shard_offset()],
+            dtype=np.int64)
+        return payload
+
+    def load_state(self, payload: Dict[str, np.ndarray]) -> None:
+        payload = dict(payload)
+        meta = payload.pop("shard_meta", None)
+        if meta is not None:
+            _, rank, world, offset = (int(x) for x in np.asarray(meta))
+            check(world == self.world and offset == self._shard_offset(),
+                  f"checkpoint shard (rank {rank}/{world}, offset {offset}) "
+                  f"does not match table shard (rank {self.rank}/"
+                  f"{self.world}, offset {self._shard_offset()}) — "
+                  "restore requires the same world size")
+        self.local_store.load_state(payload)
+
     def reconnect(self, server: int,
                   address: Optional[Tuple[str, int]] = None) -> None:
         """Elastic re-admission: point this table at a restarted peer
@@ -710,14 +927,21 @@ class DistributedArrayTable(DistributedTableBase):
                  service: PSService, peers: List[Tuple[str, int]],
                  rank: int, dtype=np.float32, updater: str = "default"):
         super().__init__(table_id, service, peers, rank)
+        self.name = f"dist_array_{table_id}"
         self.size = size
         self.offsets = reference_server_offsets(size, self.world)
         zoo = Zoo.get()
         local_size = self.offsets[rank + 1] - self.offsets[rank]
+        # Per-worker updater state (AdaGrad G^2, ref adagrad_updater.h:17-20)
+        # must span the DCN worker universe — every (process, local worker)
+        # — not zoo.num_workers(), which is local-only when the ranks run
+        # separate JAX runtimes (process_count()==1 per rank).
         self.local_store = ServerStore(
             f"dist_array_{table_id}", (max(local_size, 1),), dtype,
-            get_updater(dtype, updater), zoo.mesh, zoo.num_workers())
-        service.register_shard(table_id, self.local_store)
+            get_updater(dtype, updater), zoo.local_mesh,
+            self.world * self._n_local)
+        service.register_shard(table_id, self.local_store,
+                               sync_workers=self._sync_workers())
         from multiverso_tpu.parallel.async_engine import _stageable
         self._init_staging(size, 1, _stageable(self.local_store.updater))
 
@@ -725,6 +949,11 @@ class DistributedArrayTable(DistributedTableBase):
     def _send_add(self, delta: np.ndarray, option: AddOption) -> _PendingOp:
         """Partition + LocalForward + fire one wire message per remote
         server. Returns the reply future WITHOUT waiting."""
+        # Globalize the worker id unconditionally: it indexes per-worker
+        # updater state (AdaGrad) on the serving shard and, in sync mode,
+        # the BSP clocks — a rank-local id would alias across ranks.
+        option = dataclasses.replace(
+            option, worker_id=self._gid(option.worker_id))
         mode = _wire_mode()
         parts = []
         for s in range(self.world):
@@ -732,7 +961,7 @@ class DistributedArrayTable(DistributedTableBase):
             if hi <= lo:
                 continue
             piece = delta[lo:hi]
-            if s == self.rank:
+            if s == self.rank and not self._bsp:
                 self.local_store.apply_dense(piece, option)  # LocalForward
                 continue
             onebit = None
@@ -749,6 +978,9 @@ class DistributedArrayTable(DistributedTableBase):
                                 *pack_payload(piece, mode, onebit)])
             parts.append((s, msg, self._request_or_retry(s, msg)))
         return _PendingOp(parts, retrier=self._retry_request)
+
+    def _shard_offset(self) -> int:
+        return int(self.offsets[self.rank])
 
     def _flush_staged_locked(self) -> _PendingOp:
         merged, n = self._stage_buf.drain_dense()
@@ -789,7 +1021,7 @@ class DistributedArrayTable(DistributedTableBase):
             msg_id = self._track(op)
         return msg_id
 
-    def _get_op(self) -> _PendingOp:
+    def _get_op(self, option: "Optional[GetOption]" = None) -> _PendingOp:
         self.flush()   # staged adds precede the get on each FIFO stream
         out = np.zeros(self.size, dtype=np.float32)
         parts = []
@@ -797,13 +1029,14 @@ class DistributedArrayTable(DistributedTableBase):
             lo, hi = self.offsets[s], self.offsets[s + 1]
             if hi <= lo:
                 continue
-            if s == self.rank:
+            if s == self.rank and not self._bsp:
                 out[lo:hi] = np.asarray(self.local_store.read())[:hi - lo]
                 continue
             msg = Message(src=self.rank, type=MsgType.Request_Get,
                           table_id=self.table_id,
                           msg_id=self._next_msg_id(),
-                          data=[np.empty(0, np.int32)])
+                          data=[np.empty(0, np.int32),
+                                *self._get_opt_blob(option)])
             parts.append((s, msg, self._request_or_retry(s, msg)))
         servers = [s for s, _, _ in parts]
 
@@ -815,16 +1048,16 @@ class DistributedArrayTable(DistributedTableBase):
 
         return _PendingOp(parts, assemble, retrier=self._retry_request)
 
-    def get(self) -> np.ndarray:
+    def get(self, option: "Optional[GetOption]" = None) -> np.ndarray:
         with self._op_lock:
-            op = self._get_op()
+            op = self._get_op(option)
         return op.wait()
 
-    def get_async(self) -> int:
+    def get_async(self, option: "Optional[GetOption]" = None) -> int:
         """Issues the wire requests and returns immediately; ``wait``
         assembles the replies (ref GetAsync, ``src/table.cpp:41-60``)."""
         with self._op_lock:
-            return self._track(self._get_op())
+            return self._track(self._get_op(option))
 
 
 class DistributedMatrixTable(DistributedTableBase):
@@ -834,6 +1067,7 @@ class DistributedMatrixTable(DistributedTableBase):
                  service: PSService, peers: List[Tuple[str, int]],
                  rank: int, dtype=np.float32, updater: str = "default"):
         super().__init__(table_id, service, peers, rank)
+        self.name = f"dist_matrix_{table_id}"
         self.num_row = num_row
         self.num_col = num_col
         self.row_offsets = reference_server_offsets(num_row, self.world)
@@ -841,12 +1075,17 @@ class DistributedMatrixTable(DistributedTableBase):
         local_rows = self.row_offsets[rank + 1] - self.row_offsets[rank]
         self.local_store = ServerStore(
             f"dist_matrix_{table_id}", (max(local_rows, 1), num_col), dtype,
-            get_updater(dtype, updater), zoo.mesh, zoo.num_workers())
+            get_updater(dtype, updater), zoo.local_mesh,
+            self.world * self._n_local)   # DCN worker universe (see array)
         service.register_shard(table_id, self.local_store,
-                               row_offset=self.row_offsets[rank])
+                               row_offset=self.row_offsets[rank],
+                               sync_workers=self._sync_workers())
         from multiverso_tpu.parallel.async_engine import _stageable
         self._init_staging(num_row, num_col,
                            _stageable(self.local_store.updater))
+
+    def _shard_offset(self) -> int:
+        return int(self.row_offsets[self.rank])
 
     def _route(self, rows: np.ndarray) -> Dict[int, np.ndarray]:
         out: Dict[int, List[int]] = {}
@@ -860,10 +1099,14 @@ class DistributedMatrixTable(DistributedTableBase):
     # -- internals ---------------------------------------------------------
     def _send_add_rows(self, rows: np.ndarray, deltas: np.ndarray,
                        option: AddOption) -> _PendingOp:
+        # Globalize the worker id (per-worker updater state + BSP clocks;
+        # see _send_add).
+        option = dataclasses.replace(
+            option, worker_id=self._gid(option.worker_id))
         parts = []
         for s, ix in self._route(rows).items():
             keys, piece = rows[ix], deltas[ix]
-            if s == self.rank:
+            if s == self.rank and not self._bsp:
                 self.local_store.apply_rows(
                     keys - self.row_offsets[s], piece, option)
                 continue
@@ -930,20 +1173,22 @@ class DistributedMatrixTable(DistributedTableBase):
             msg_id = self._track(op)
         return msg_id
 
-    def _get_rows_op(self, rows: np.ndarray) -> _PendingOp:
+    def _get_rows_op(self, rows: np.ndarray,
+                     option: "Optional[GetOption]" = None) -> _PendingOp:
         self.flush()
         out = np.zeros((len(rows), self.num_col), dtype=np.float32)
         parts = []
         indices = []
         for s, ix in self._route(rows).items():
             keys = rows[ix]
-            if s == self.rank:
+            if s == self.rank and not self._bsp:
                 out[ix] = np.asarray(self.local_store.read_rows(
                     keys - self.row_offsets[s]))
                 continue
             msg = Message(src=self.rank, type=MsgType.Request_Get,
                           table_id=self.table_id,
-                          msg_id=self._next_msg_id(), data=[keys])
+                          msg_id=self._next_msg_id(),
+                          data=[keys, *self._get_opt_blob(option)])
             parts.append((s, msg, self._request_or_retry(s, msg)))
             indices.append(ix)
 
@@ -954,15 +1199,17 @@ class DistributedMatrixTable(DistributedTableBase):
 
         return _PendingOp(parts, assemble, retrier=self._retry_request)
 
-    def get_rows(self, row_ids) -> np.ndarray:
+    def get_rows(self, row_ids,
+                 option: "Optional[GetOption]" = None) -> np.ndarray:
         rows = np.asarray(row_ids, dtype=np.int32)
         with self._op_lock:
-            op = self._get_rows_op(rows)
+            op = self._get_rows_op(rows, option)
         return op.wait()
 
-    def get_rows_async(self, row_ids) -> int:
+    def get_rows_async(self, row_ids,
+                       option: "Optional[GetOption]" = None) -> int:
         """Wire requests fired, id returned before replies arrive — the
         pipelined-pull primitive (ref ``ps_model.cpp:236-271``)."""
         rows = np.asarray(row_ids, dtype=np.int32)
         with self._op_lock:
-            return self._track(self._get_rows_op(rows))
+            return self._track(self._get_rows_op(rows, option))

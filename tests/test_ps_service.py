@@ -154,6 +154,106 @@ def test_two_process_async_ps(tmp_path):
         assert f"RANK{r}_OK" in out
 
 
+_CKPT_WORKER = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.core.checkpoint import CheckpointManager
+
+rank = int(sys.argv[1]); rendezvous = sys.argv[2]; ckpt_dir = sys.argv[3]
+mv.init([])
+addr = mv.net_bind()
+with open(os.path.join(rendezvous, f"addr{rank}"), "w") as f:
+    f.write(f"{addr[0]}:{addr[1]}")
+other = os.path.join(rendezvous, f"addr{1 - rank}")
+for _ in range(600):
+    if os.path.exists(other):
+        break
+    time.sleep(0.05)
+host, port = open(other).read().split(":")
+peers = [None, None]
+peers[rank] = addr
+peers[1 - rank] = (host, int(port))
+mv.net_connect(peers)
+table = mv.create_distributed_matrix_table(9, 20, 4, rank=rank)
+
+# both ranks push rows landing on BOTH shards (rows 0-9 rank0, 10-19 rank1)
+rows = [2, 15]
+table.add_rows(rows, np.full((2, 4), float(rank + 1), dtype=np.float32))
+expected = np.full((2, 4), 3.0)
+for _ in range(600):
+    if np.allclose(table.get_rows(rows), expected):
+        break
+    time.sleep(0.05)
+else:
+    raise SystemExit(f"rank {rank} never saw merged rows")
+
+def rendezvous_phase(tag):
+    with open(os.path.join(rendezvous, f"{tag}{rank}"), "w") as f:
+        f.write("ok")
+    peer = os.path.join(rendezvous, f"{tag}{1 - rank}")
+    for _ in range(600):
+        if os.path.exists(peer):
+            return
+        time.sleep(0.05)
+    raise SystemExit(f"peer never reached phase {tag}")
+
+mgr = CheckpointManager(ckpt_dir, save_every_steps=1)
+path = mgr.maybe_save(step=1)
+assert path, "maybe_save skipped"
+rendezvous_phase("saved")        # both shards + manifests on disk
+
+# diverge (sync adds land on both shards before returning) ...
+table.add_rows(rows, np.full((2, 4), 100.0, dtype=np.float32))
+rendezvous_phase("mutated")
+# ... then restore each rank's own shard: state returns to the checkpoint
+step = mgr.restore_latest()
+assert step == 1, step
+rendezvous_phase("restored")
+got = table.get_rows(rows)
+np.testing.assert_allclose(got, expected)
+print(f"CKPT_RANK{rank}_OK")
+
+with open(os.path.join(rendezvous, f"done{rank}"), "w") as f:
+    f.write("ok")
+peer_done = os.path.join(rendezvous, f"done{1 - rank}")
+for _ in range(600):
+    if os.path.exists(peer_done):
+        break
+    time.sleep(0.05)
+mv.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_two_process_checkpoint_manager(tmp_path):
+    """VERDICT r2 #3: CheckpointManager round-trips a world with
+    DistributedMatrixTables — each rank saves its own shard (suffixed
+    file + per-rank manifest) into a shared directory and restores it."""
+    script = tmp_path / "ckptworker.py"
+    script.write_text(_CKPT_WORKER)
+    ckpt_dir = tmp_path / "ckpts"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(tmp_path), str(ckpt_dir)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for r in range(2)]
+    for r, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            pytest.fail("ckpt worker timed out")
+        assert p.returncode == 0, f"rank {r} failed:\n{err[-2000:]}"
+        assert f"CKPT_RANK{r}_OK" in out
+
+
 def test_heartbeat_failure_detection(mv_env):
     from multiverso_tpu.parallel.ps_service import PeerClient
 
@@ -205,21 +305,25 @@ def test_elastic_rank_restart_and_readmission(mv_env):
     t0.add(np.arange(40, dtype=np.float32))
     np.testing.assert_allclose(t0.get(), np.arange(40))
 
-    # rank 1 checkpoints its shard, then dies
-    shard_snapshot = t1.local_store.store_state()
+    # rank 1 checkpoints its shard through the checkpoint layer (the
+    # DistributedTableBase store_state/load_state surface), then dies
+    import tempfile
+
+    from multiverso_tpu.core import checkpoint as ckpt
+    uri = f"file://{os.path.join(tempfile.mkdtemp(), 'shard1.npz')}"
+    ckpt.save_table(t1, uri)
     svc1.close()
     time.sleep(0.2)
     with pytest.raises(Exception):
         for _ in range(50):
             t0.add(np.ones(40, dtype=np.float32))
             time.sleep(0.05)
-    state_before_restart = t0.local_store.store_state()["data"]
 
     # rank 1 restarts at a NEW address, restores its shard, re-registers
     svc1b = PSService()
-    t1b = DistributedArrayTable(6, 40, svc1b, 
+    t1b = DistributedArrayTable(6, 40, svc1b,
                                 [peers[0], svc1b.address], rank=1)
-    t1b.local_store.load_state(shard_snapshot)
+    ckpt.load_table(t1b, uri)
     t0.reconnect(1, svc1b.address)
 
     # traffic resumes; rank-1 shard content survived the restart
@@ -520,7 +624,11 @@ def test_elastic_auto_readmission_no_manual_reconnect(mv_env):
     t0.add(np.arange(40, dtype=np.float32))
     np.testing.assert_allclose(t0.get(), np.arange(40))
 
-    shard_snapshot = t1.local_store.store_state()
+    import tempfile
+
+    from multiverso_tpu.core import checkpoint as ckpt
+    uri = f"file://{os.path.join(tempfile.mkdtemp(), 'shard1.npz')}"
+    ckpt.save_table(t1, uri)
     svc1.close()                 # rank 1 dies
     time.sleep(0.3)
 
@@ -528,7 +636,7 @@ def test_elastic_auto_readmission_no_manual_reconnect(mv_env):
     svc1b = PSService()
     t1b = DistributedArrayTable(60, 40, svc1b,
                                 [peers[0], svc1b.address], rank=1)
-    t1b.local_store.load_state(shard_snapshot)
+    ckpt.load_table(t1b, uri)
 
     # rank 0 still points at the DEAD address; the failed request must
     # rediscover the new one through the directory automatically
