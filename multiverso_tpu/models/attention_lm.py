@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from multiverso_tpu.parallel.sequence import ring_attention
+from multiverso_tpu.parallel.sequence import (ring_attention,
+                                              ulysses_attention)
 from multiverso_tpu.utils.log import check, log
 
 Params = Dict[str, jax.Array]
@@ -38,6 +39,10 @@ class LMConfig:
     seq_parallel: Optional[int] = None
     moe_experts: int = 0                  # >0: MoE MLP (expert parallelism)
     moe_aux_weight: float = 0.01
+    # "ring": K/V rotation, O(S/n) memory (default). "ulysses": all-to-all
+    # head<->seq layout swap — fewer collective rounds when heads divide
+    # the seq axis, at O(S) score memory per device.
+    sp_mode: str = "ring"
     remat: bool = False                   # rematerialize each layer block
     # >0: train with the 1F1B layer pipeline over a ("stage", "seq") mesh
     # (PP x SP in one program); layers must divide by it. Batches fed to
@@ -100,8 +105,10 @@ def forward(params: Params, tokens: jax.Array, cfg: LMConfig,
         def heads(t):
             return t.reshape(B, S, H, dh).transpose(0, 2, 1, 3)
 
-        o = ring_attention(heads(q), heads(k), heads(v), mesh,
-                           causal=True)                    # [B,H,S,dh]
+        attn = (ulysses_attention if cfg.sp_mode == "ulysses"
+                else ring_attention)
+        o = attn(heads(q), heads(k), heads(v), mesh,
+                 causal=True)                              # [B,H,S,dh]
         o = o.transpose(0, 2, 1, 3).reshape(B, S, D)
         x = x + o @ params[f"attn_out_{i}"]
         h = _ln(x)

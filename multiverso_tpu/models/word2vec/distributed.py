@@ -38,7 +38,8 @@ from multiverso_tpu.models.word2vec.model import (Word2VecConfig,
                                                   raw_cbow_ns_step,
                                                   raw_sg_hs_step,
                                                   raw_sg_ns_step)
-from multiverso_tpu.parallel.ps_service import (DistributedMatrixTable,
+from multiverso_tpu.parallel.ps_service import (DistributedArrayTable,
+                                                DistributedMatrixTable,
                                                 PSService)
 from multiverso_tpu.utils.log import check, log
 
@@ -53,6 +54,7 @@ class DistributedWord2Vec:
     TABLE_OUT = 101
     TABLE_G_IN = 102
     TABLE_G_OUT = 103
+    TABLE_WORD_COUNT = 104   # the reference's 5th table (src/constant.h:16-20)
 
     def __init__(self, cfg: Word2VecConfig, dictionary: Dictionary,
                  service: PSService, peers: List[Tuple[str, int]],
@@ -82,6 +84,15 @@ class DistributedWord2Vec:
                                                service, peers, rank)
             self.g_out = DistributedMatrixTable(self.TABLE_G_OUT, out_rows,
                                                 D, service, peers, rank)
+        # Global word-count table: every worker pushes its per-block word
+        # count and the lr schedule decays on the GLOBAL sum — the
+        # reference's word-count KV table + lr thread
+        # (distributed_wordembedding.cpp:92-134). A rank-local count would
+        # leave N-rank SGD stuck at (1 - 1/N) of its schedule.
+        self.word_count = DistributedArrayTable(self.TABLE_WORD_COUNT, 1,
+                                                service, peers, rank)
+        self.global_trained_words = 0.0
+        self._synced_words = 0
         self._initialized = False
         self.generator = BatchGenerator(
             dictionary, batch_size=cfg.batch_size, window=cfg.window,
@@ -106,9 +117,20 @@ class DistributedWord2Vec:
     def _current_lr(self) -> float:
         if self._adagrad:
             return self.cfg.learning_rate
-        frac = min(self.trained_words / max(self.total_words, 1), 1.0)
+        progress = max(self.global_trained_words, float(self.trained_words))
+        frac = min(progress / max(self.total_words, 1), 1.0)
         return max(self.cfg.learning_rate * (1.0 - frac),
                    self.cfg.learning_rate * 1e-4)
+
+    def _sync_word_count(self) -> None:
+        """Push this worker's new words; pull the global count (the
+        reference's word-count thread cadence collapsed to per-block)."""
+        delta = self.trained_words - self._synced_words
+        if delta > 0:
+            self.word_count.add_async(
+                np.asarray([float(delta)], dtype=np.float32))
+            self._synced_words = self.trained_words
+        self.global_trained_words = float(self.word_count.get()[0])
 
     # -- one data block -------------------------------------------------------
     @staticmethod
@@ -249,9 +271,11 @@ class DistributedWord2Vec:
             for block in BlockStream(iter(sentences), self.cfg.block_words,
                                      prefetch=self.cfg.pipeline):
                 self.trained_words += self._train_block(block)
+                self._sync_word_count()
         # Drain staged pushes so peers (e.g. the saving master) see this
         # worker's last deltas after their barrier.
-        for table in (self.w_in, self.w_out, self.g_in, self.g_out):
+        for table in (self.w_in, self.w_out, self.g_in, self.g_out,
+                      self.word_count):
             if table is not None:
                 table.flush(wait=True)
         elapsed = time.perf_counter() - t0

@@ -121,12 +121,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
-                      axis: str = SEQ_AXIS) -> jax.Array:
+                      axis: str = SEQ_AXIS, causal: bool = False
+                      ) -> jax.Array:
     """All-to-all sequence parallelism (the Ulysses layout swap).
 
     Inputs [B, H, S, D] sharded on S with H divisible by the axis size.
     First all-to-all: seq-sharded -> head-sharded (full sequence per
-    device); dense attention; second all-to-all: back to seq-sharded.
+    device); dense attention (optionally causal — after the layout swap
+    every device holds the FULL sequence, so the mask is the plain lower
+    triangle, no ring-step reconstruction needed); second all-to-all: back
+    to seq-sharded.
     """
     n = mesh.shape[axis]
     scale = 1.0 / np.sqrt(q.shape[-1])
@@ -143,6 +147,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
 
         qh, kh, vh = seq_to_head(q_blk), seq_to_head(k_blk), seq_to_head(v_blk)
         s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if causal:
+            S = s.shape[-1]
+            mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+            s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
         return head_to_seq(o)

@@ -104,3 +104,19 @@ def test_remat_matches_baseline_loss():
     (la,) = lm_a.fit([batch])
     (lb,) = lm_b.fit([batch])
     np.testing.assert_allclose(la, lb, rtol=1e-5)
+
+
+def test_lm_ulysses_mode_matches_ring_loss():
+    """sp_mode='ulysses' is a first-class training path: same loss as the
+    ring program on identical params/batch (heads must divide the seq
+    axis: 8 heads over the 8-device mesh)."""
+    from multiverso_tpu.models.attention_lm import AttentionLM, LMConfig
+
+    batch = np.tile(np.arange(16, dtype=np.int32), (2, 9))[:, :128]
+    ring = AttentionLM(LMConfig(vocab=32, dim=64, heads=8, layers=2,
+                                seq=128, seed=5, sp_mode="ring"))
+    uly = AttentionLM(LMConfig(vocab=32, dim=64, heads=8, layers=2,
+                               seq=128, seed=5, sp_mode="ulysses"))
+    l_ring = ring.fit([batch])
+    l_uly = uly.fit([batch])
+    np.testing.assert_allclose(l_uly, l_ring, rtol=1e-4, atol=1e-5)
