@@ -41,7 +41,8 @@ class MsgType(enum.IntEnum):
     Reply_Lookup = -35
     Heartbeat = 40
     Heartbeat_Reply = -40
-    Exit = 99
+    Reply_Error = -99   # server-side rejection (e.g. unknown table); wakes
+    Exit = 99           # the waiter loudly instead of hanging a BSP wait
 
 
 class Message:

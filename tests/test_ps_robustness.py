@@ -1,0 +1,210 @@
+"""PSService dispatch robustness (VERDICT r3 next-round #5 + ADVICE r3).
+
+The service must stay live under misbehaving peers and loose timing:
+* a peer that never reads its replies only fills ITS OWN write buffer —
+  other clients' table ops proceed unimpeded (reply writes live on the IO
+  thread, not the dispatcher);
+* a retransmitted Add (elastic retry after a lost reply) is answered from
+  the reply cache, not re-applied — exactly-once, not at-least-once;
+* a request arriving before its table registers is parked and replayed,
+  never blocking the dispatcher;
+* BSP ops wait without a deadline (the reference Waiter blocks), and
+  row-routed tables tick every server's clock uniformly so sparse access
+  patterns can't wedge the gates (ADVICE r3 medium #2);
+* Server_Finish_Train is scoped to its table (ADVICE r3 low #4).
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.actor import Message, MsgType
+from multiverso_tpu.core.options import AddOption, GetOption
+from multiverso_tpu.parallel.net import recv_message, send_message
+from multiverso_tpu.parallel.ps_service import (DistributedArrayTable,
+                                                DistributedMatrixTable,
+                                                PSService, _opt_to_array,
+                                                pack_payload, unpack_payload)
+
+
+@pytest.fixture
+def one_rank_world(mv_env):
+    svc = PSService()
+    yield svc, [svc.address]
+    svc.close()
+
+
+def test_stalled_peer_does_not_block_other_clients(one_rank_world):
+    """A peer that sends Gets but never reads the replies must not freeze
+    the dispatcher: a well-behaved client's ops complete promptly while
+    the stalled peer's replies pile up in its own write buffer."""
+    svc, peers = one_rank_world
+    size = 20000     # 80KB replies: a handful exceeds the socket buffers
+    table = DistributedArrayTable(1, size, svc, peers, rank=0)
+    table.add(np.ones(size, dtype=np.float32))
+
+    stalled = socket.create_connection(svc.address, timeout=10)
+    # Shrink the receive window so the server-side write buffer backs up
+    # after very few replies (forcing the old code's blocking-send path).
+    stalled.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    for i in range(40):
+        send_message(stalled, Message(
+            src=9, type=MsgType.Request_Get, table_id=1, msg_id=10_000 + i,
+            data=[np.empty(0, np.int32)]))
+    # ... and never read a single reply.
+
+    time.sleep(0.5)   # let the dispatcher chew through the stalled Gets
+    t0 = time.monotonic()
+    with socket.create_connection(svc.address, timeout=10) as good:
+        for i in range(5):
+            send_message(good, Message(
+                src=8, type=MsgType.Request_Get, table_id=1,
+                msg_id=20_000 + i, data=[np.empty(0, np.int32)]))
+            reply = recv_message(good)
+            assert reply is not None and reply.type == MsgType.Reply_Get
+            np.testing.assert_allclose(
+                unpack_payload(reply.data).ravel()[:size], 1.0)
+    elapsed = time.monotonic() - t0
+    # Old code: each stalled reply could hold the dispatcher up to 60s.
+    assert elapsed < 10.0, f"good client starved for {elapsed:.1f}s"
+    stalled.close()
+
+
+def test_duplicate_add_is_applied_exactly_once(one_rank_world):
+    """Resending an identical Add (same src, msg_id — the elastic retrier's
+    behavior after a lost reply) must answer from the reply cache without
+    touching the table again."""
+    svc, peers = one_rank_world
+    size = 8
+    table = DistributedArrayTable(2, size, svc, peers, rank=0)
+    delta = np.full(size, 3.0, dtype=np.float32)
+    msg = Message(src=7, type=MsgType.Request_Add, table_id=2, msg_id=555,
+                  data=[np.empty(0, np.int32), _opt_to_array(AddOption()),
+                        *pack_payload(delta, "none")])
+    with socket.create_connection(svc.address, timeout=10) as conn:
+        send_message(conn, msg)
+        assert recv_message(conn).type == MsgType.Reply_Add
+        send_message(conn, msg)     # retransmit on the same connection
+        assert recv_message(conn).type == MsgType.Reply_Add
+    # A second connection models the retry-after-reconnect path.
+    with socket.create_connection(svc.address, timeout=10) as conn:
+        send_message(conn, msg)
+        assert recv_message(conn).type == MsgType.Reply_Add
+    np.testing.assert_allclose(table.get(), delta)   # once, not thrice
+
+
+def test_early_request_parks_until_registration(one_rank_world):
+    """A Get that arrives before register_shard is deferred (the dispatcher
+    keeps serving other traffic) and replayed once the table appears."""
+    svc, peers = one_rank_world
+    conn = socket.create_connection(svc.address, timeout=10)
+    send_message(conn, Message(src=4, type=MsgType.Request_Get, table_id=77,
+                               msg_id=1234, data=[np.empty(0, np.int32)]))
+    time.sleep(0.3)
+    # The dispatcher must NOT be blocked on table 77: a registered-table op
+    # on another connection completes while 77's Get is parked.
+    probe = DistributedArrayTable(3, 4, svc, peers, rank=0)
+    probe.add(np.ones(4, dtype=np.float32))
+    np.testing.assert_allclose(probe.get(), 1.0)
+
+    late = DistributedArrayTable(77, 6, svc, peers, rank=0)
+    late.add(np.full(6, 2.0, dtype=np.float32))
+    conn.settimeout(15)
+    reply = recv_message(conn)     # the parked Get finally answers
+    assert reply is not None and reply.msg_id == 1234
+    assert unpack_payload(reply.data).ravel().shape[0] >= 6
+    conn.close()
+
+
+def test_bsp_waits_have_no_deadline_async_keeps_one(mv_env):
+    """ADVICE r3 medium #1: sync-mode ops wait indefinitely (straggler skew
+    is routine); async mode keeps the 60s fail-loud deadline."""
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    try:
+        t = DistributedArrayTable(1, 8, svc0, peers, rank=0)
+        assert t._op_timeout == 60.0
+    finally:
+        svc0.close(); svc1.close()
+    mv.shutdown()
+    mv.init(["-sync=true"], num_local_workers=1)
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    try:
+        t = DistributedArrayTable(1, 8, svc0, peers, rank=0)
+        assert t._bsp and t._op_timeout is None
+    finally:
+        svc0.close(); svc1.close()
+
+
+@pytest.fixture
+def sync_world():
+    mv.init(["-sync=true"], num_local_workers=1)
+    svc0 = PSService()
+    svc1 = PSService()
+    yield svc0, svc1, [svc0.address, svc1.address]
+    svc0.close()
+    svc1.close()
+    mv.shutdown()
+
+
+def _rows_loop(table, wid, rows, rounds, views, errors):
+    deltas = np.ones((len(rows), table.num_col), dtype=np.float32)
+    try:
+        for i in range(rounds):
+            table.add_rows(rows, deltas, AddOption(worker_id=wid))
+            got = table.get_rows(rows, GetOption(worker_id=wid))
+            views.append((i, got.copy()))
+    except Exception as e:  # noqa: BLE001 - surfaced by the main thread
+        errors.append(e)
+
+
+def test_bsp_row_routed_matrix_does_not_wedge(sync_world):
+    """ADVICE r3 medium #2: each worker touches rows on only ONE server
+    (w2v-style sparse access). Empty clock-tick messages to the untouched
+    servers keep every gate's vector clock uniform, so the ops drain
+    instead of caching forever."""
+    svc0, svc1, peers = sync_world
+    # rows 0-9 on rank 0, 10-19 on rank 1
+    m0 = DistributedMatrixTable(5, 20, 4, svc0, peers, rank=0)
+    m1 = DistributedMatrixTable(5, 20, 4, svc1, peers, rank=1)
+    assert m0._bsp
+    rounds = 3
+    views0, views1, errors = [], [], []
+    threads = [
+        threading.Thread(target=_rows_loop,
+                         args=(m0, 0, [1, 3], rounds, views0, errors)),
+        threading.Thread(target=_rows_loop,
+                         args=(m1, 0, [15, 17], rounds, views1, errors)),
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+        assert not th.is_alive(), "BSP row-routed worker wedged"
+    assert not errors, errors
+    # Disjoint rows: each worker's i-th view shows exactly its own adds.
+    for i, got in views0:
+        np.testing.assert_allclose(got, float(i + 1))
+    for i, got in views1:
+        np.testing.assert_allclose(got, float(i + 1))
+
+
+def test_finish_train_scoped_to_one_table(sync_world):
+    """Retiring a worker from table A must not set its clocks to infinity
+    on table B (ADVICE r3 low #4)."""
+    svc0, svc1, peers = sync_world
+    ta0 = DistributedArrayTable(6, 8, svc0, peers, rank=0)
+    DistributedArrayTable(6, 8, svc1, peers, rank=1)
+    tb0 = DistributedArrayTable(7, 8, svc0, peers, rank=0)
+    DistributedArrayTable(7, 8, svc1, peers, rank=1)
+    ta0.finish_train(0)
+    inf = float("inf")
+    for svc in (svc0, svc1):
+        assert svc._sync[6]._adds.value(0) == inf     # retired on A
+        assert svc._sync[7]._adds.value(0) == 0.0     # still live on B
+    tb0.close(); ta0.close()
