@@ -11,7 +11,9 @@ framework re-implements TPU-first.
 
 from multiverso_tpu.api import (aggregate, barrier, create_table,
                                 create_distributed_array_table,
+                                create_distributed_kv_table,
                                 create_distributed_matrix_table,
+                                create_distributed_sparse_matrix_table,
                                 finish_train, get_flag, init, net_bind,
                                 net_connect,
                                 is_master_worker, num_servers, num_workers,
@@ -28,7 +30,8 @@ __all__ = [
     "num_servers", "worker_id", "server_id", "is_master_worker",
     "set_flag", "get_flag", "create_table", "aggregate", "finish_train",
     "net_bind", "net_connect", "create_distributed_array_table",
-    "create_distributed_matrix_table",
+    "create_distributed_matrix_table", "create_distributed_kv_table",
+    "create_distributed_sparse_matrix_table",
     "AddOption", "GetOption", "ArrayTableOption", "MatrixTableOption",
     "KVTableOption",
 ]

@@ -184,6 +184,50 @@ def create_distributed_matrix_table(table_id: int, num_row: int,
     return table
 
 
+def create_distributed_kv_table(table_id: int, rank: int, dtype=None):
+    """Distributed (hash-partitioned across processes) key->value table
+    over the bound service + connected peers (ref
+    ``include/multiverso/table/kv_table.h:42-66`` — key % num_servers
+    routing, += merge server-side)."""
+    import numpy as _np
+
+    from multiverso_tpu.parallel.ps_service import DistributedKVTable
+
+    zoo = Zoo.get()
+    check(zoo.ps_service is not None, "call mv.net_bind() first")
+    check(len(zoo.ps_peers) > 0, "call mv.net_connect() first")
+    table = DistributedKVTable(table_id, zoo.ps_service,
+                               list(zoo.ps_peers), rank,
+                               dtype=dtype or _np.int64)
+    zoo.register_table(table)
+    return table
+
+
+def create_distributed_sparse_matrix_table(table_id: int, num_row: int,
+                                           num_col: int, rank: int,
+                                           dtype=None,
+                                           updater: str = "default"):
+    """Distributed row-sharded matrix with SERVER-SIDE per-worker
+    staleness: incremental whole-table Gets ship only rows touched since
+    this worker's last pull (ref ``src/table/sparse_matrix_table.cpp:
+    184-258``)."""
+    import numpy as _np
+
+    from multiverso_tpu.parallel.ps_service import \
+        DistributedSparseMatrixTable
+
+    zoo = Zoo.get()
+    check(zoo.ps_service is not None, "call mv.net_bind() first")
+    check(len(zoo.ps_peers) > 0, "call mv.net_connect() first")
+    table = DistributedSparseMatrixTable(table_id, num_row, num_col,
+                                         zoo.ps_service,
+                                         list(zoo.ps_peers), rank,
+                                         dtype=dtype or _np.float32,
+                                         updater=updater)
+    zoo.register_table(table)
+    return table
+
+
 def finish_train(worker_id: Optional[int] = None) -> None:
     """``Zoo::FinishTrain`` analog (ref src/zoo.cpp:152-161): release this
     worker from every table's BSP clocks so stragglers can drain to

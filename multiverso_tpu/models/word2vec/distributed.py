@@ -38,7 +38,7 @@ from multiverso_tpu.models.word2vec.model import (Word2VecConfig,
                                                   raw_cbow_ns_step,
                                                   raw_sg_hs_step,
                                                   raw_sg_ns_step)
-from multiverso_tpu.parallel.ps_service import (DistributedArrayTable,
+from multiverso_tpu.parallel.ps_service import (DistributedKVTable,
                                                 DistributedMatrixTable,
                                                 PSService)
 from multiverso_tpu.utils.log import check, log
@@ -88,11 +88,16 @@ class DistributedWord2Vec:
         # count and the lr schedule decays on the GLOBAL sum — the
         # reference's word-count KV table + lr thread
         # (distributed_wordembedding.cpp:92-134). A rank-local count would
-        # leave N-rank SGD stuck at (1 - 1/N) of its schedule.
-        self.word_count = DistributedArrayTable(self.TABLE_WORD_COUNT, 1,
-                                                service, peers, rank)
+        # leave N-rank SGD stuck at (1 - 1/N) of its schedule. This IS a
+        # KV table as in the reference (src/constant.h:16-20): int64
+        # server-side accumulation, exact past 2^24 words where float32
+        # would drift.
+        self.word_count = DistributedKVTable(self.TABLE_WORD_COUNT,
+                                             service, peers, rank,
+                                             dtype=np.int64)
         self.global_trained_words = 0.0
         self._synced_words = 0
+        self._wc_pending: Optional[int] = None
         self._initialized = False
         self.generator = BatchGenerator(
             dictionary, batch_size=cfg.batch_size, window=cfg.window,
@@ -123,14 +128,26 @@ class DistributedWord2Vec:
                    self.cfg.learning_rate * 1e-4)
 
     def _sync_word_count(self) -> None:
-        """Push this worker's new words; pull the global count (the
-        reference's word-count thread cadence collapsed to per-block)."""
+        """Push this worker's new words; pull the global count
+        ASYNCHRONOUSLY — consume the get fired before the block just
+        trained and fire the next one, so the PS round-trip overlaps
+        compute instead of serializing the loop on it (the reference
+        decouples this with a background word-count/lr thread,
+        distributed_wordembedding.cpp:92-134; here the same one-block
+        staleness without the thread)."""
         delta = self.trained_words - self._synced_words
         if delta > 0:
-            self.word_count.add_async(
-                np.asarray([float(delta)], dtype=np.float32))
+            self.word_count.add_async([0], [int(delta)])
             self._synced_words = self.trained_words
-        self.global_trained_words = float(self.word_count.get()[0])
+        if self._wc_pending is not None:
+            self.global_trained_words = float(
+                self.word_count.wait(self._wc_pending)[0])
+            self._wc_pending = self.word_count.get_async([0])
+        else:
+            # No pipeline primed (first block, or post-train refresh after
+            # train() drained it): synchronous pull, then prime.
+            self.global_trained_words = float(self.word_count.get([0])[0])
+            self._wc_pending = self.word_count.get_async([0])
 
     # -- one data block -------------------------------------------------------
     @staticmethod
@@ -278,6 +295,11 @@ class DistributedWord2Vec:
                       self.word_count):
             if table is not None:
                 table.flush(wait=True)
+        # Retire the pipelined word-count get: training ends with the
+        # pipeline unprimed, so the next _sync_word_count pulls fresh.
+        if self._wc_pending is not None:
+            self.word_count.wait(self._wc_pending)
+            self._wc_pending = None
         elapsed = time.perf_counter() - t0
         self.words_per_sec = self.trained_words / max(elapsed, 1e-9)
         return {"words": self.trained_words,
