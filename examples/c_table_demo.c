@@ -1,0 +1,103 @@
+/* Foreign-host FFI demo: a plain C program attaches to Python-served PS
+ * shards through libmvtpu_host.so's extern "C" table surface (the
+ * reference's c_api.h parity boundary) — creates handles for an array, a
+ * matrix, and a KV table, Adds known patterns, Gets them back, and
+ * asserts the values it reads include what the PYTHON side wrote.
+ *
+ * Usage: c_table_demo "host:port;host:port" <array_id> <matrix_id> <kv_id>
+ * Exit 0 + "C_DEMO_OK" on success. Driven by tests/test_c_api_ffi.py.
+ */
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define ASIZE 10
+#define MROWS 8
+#define MCOLS 3
+
+#define CHECK(cond, msg)                        \
+  do {                                          \
+    if (!(cond)) {                              \
+      fprintf(stderr, "FAIL: %s\n", msg);       \
+      return 1;                                 \
+    }                                           \
+  } while (0)
+
+typedef int (*connect_fn)(const char *, void **);
+typedef void (*close_fn)(void *);
+typedef int (*new_array_fn)(void *, int, long long, void **);
+typedef int (*array_io_fn)(void *, float *, long long);
+typedef int (*array_add_fn)(void *, const float *, long long);
+typedef int (*new_matrix_fn)(void *, int, long long, long long, void **);
+typedef int (*matrix_add_fn)(void *, const float *, const int *, long long);
+typedef int (*matrix_get_fn)(void *, float *, const int *, long long);
+typedef int (*new_kv_fn)(void *, int, void **);
+typedef int (*kv_add_fn)(void *, const long long *, const long long *,
+                         long long);
+typedef int (*kv_get_fn)(void *, const long long *, long long *, long long);
+
+int main(int argc, char **argv) {
+  CHECK(argc == 6, "usage: demo <libpath> <peers> <aid> <mid> <kid>");
+  void *lib = dlopen(argv[1], RTLD_NOW);
+  CHECK(lib != NULL, dlerror());
+  connect_fn mv_connect = (connect_fn)dlsym(lib, "MV_ConnectClient");
+  close_fn mv_close = (close_fn)dlsym(lib, "MV_CloseClient");
+  new_array_fn new_array = (new_array_fn)dlsym(lib, "MV_NewArrayTable");
+  array_add_fn array_add = (array_add_fn)dlsym(lib, "MV_AddArrayTable");
+  array_io_fn array_get = (array_io_fn)dlsym(lib, "MV_GetArrayTable");
+  new_matrix_fn new_matrix = (new_matrix_fn)dlsym(lib, "MV_NewMatrixTable");
+  matrix_add_fn matrix_add =
+      (matrix_add_fn)dlsym(lib, "MV_AddMatrixTableByRows");
+  matrix_get_fn matrix_get =
+      (matrix_get_fn)dlsym(lib, "MV_GetMatrixTableByRows");
+  new_kv_fn new_kv = (new_kv_fn)dlsym(lib, "MV_NewKVTable");
+  kv_add_fn kv_add = (kv_add_fn)dlsym(lib, "MV_AddKVTable");
+  kv_get_fn kv_get = (kv_get_fn)dlsym(lib, "MV_GetKVTable");
+  CHECK(mv_connect && mv_close && new_array && array_add && array_get &&
+            new_matrix && matrix_add && matrix_get && new_kv && kv_add &&
+            kv_get,
+        "missing MV_* symbol");
+
+  void *client = NULL;
+  CHECK(mv_connect(argv[2], &client) == 0, "connect failed");
+  int aid = atoi(argv[3]), mid = atoi(argv[4]), kid = atoi(argv[5]);
+
+  /* array: Python pre-seeded each slot with 100+i; we add i and expect
+   * 100+2i — proving the C host both READS Python writes and WRITES
+   * values Python will read. */
+  void *at = NULL;
+  CHECK(new_array(client, aid, ASIZE, &at) == 0, "new array");
+  float delta[ASIZE], got[ASIZE];
+  for (int i = 0; i < ASIZE; ++i) delta[i] = (float)i;
+  CHECK(array_add(at, delta, ASIZE) == 0, "array add");
+  CHECK(array_get(at, got, ASIZE) == 0, "array get");
+  for (int i = 0; i < ASIZE; ++i)
+    CHECK(got[i] == 100.0f + 2.0f * i, "array value mismatch");
+
+  /* matrix rows spanning both shards */
+  void *mt = NULL;
+  CHECK(new_matrix(client, mid, MROWS, MCOLS, &mt) == 0, "new matrix");
+  int rows[3] = {1, 3, 6};
+  float rdelta[3 * MCOLS], rgot[3 * MCOLS];
+  for (int i = 0; i < 3 * MCOLS; ++i) rdelta[i] = (float)(i + 1);
+  CHECK(matrix_add(mt, rdelta, rows, 3) == 0, "matrix add rows");
+  CHECK(matrix_get(mt, rgot, rows, 3) == 0, "matrix get rows");
+  for (int i = 0; i < 3 * MCOLS; ++i)
+    CHECK(rgot[i] == rdelta[i] + 10.0f, "matrix value mismatch");
+
+  /* kv: += merge on a hash-partitioned map; Python pre-added 1000 each */
+  void *kt = NULL;
+  CHECK(new_kv(client, kid, &kt) == 0, "new kv");
+  long long keys[3] = {4, 7, 1000000007LL};
+  long long vals[3] = {40, 70, 7};
+  long long kgot[3] = {0, 0, 0};
+  CHECK(kv_add(kt, keys, vals, 3) == 0, "kv add");
+  CHECK(kv_get(kt, keys, kgot, 3) == 0, "kv get");
+  CHECK(kgot[0] == 1040 && kgot[1] == 1070 && kgot[2] == 1007,
+        "kv value mismatch");
+
+  mv_close(client);
+  printf("C_DEMO_OK\n");
+  return 0;
+}

@@ -1482,15 +1482,25 @@ class DistributedMatrixTable(DistributedTableBase):
             f"dist_matrix_{table_id}", (max(local_rows, 1), num_col), dtype,
             get_updater(dtype, updater), zoo.local_mesh,
             self.world * self._n_local)   # DCN worker universe (see array)
+        # ONE registration carrying the sparse arming too (subclass hook):
+        # register-then-overwrite would open a window where peers' STALE
+        # gets find the table but not its bitmap and get dropped.
         service.register_shard(table_id, self.local_store,
                                row_offset=self.row_offsets[rank],
-                               sync_workers=self._sync_workers())
+                               sync_workers=self._sync_workers(),
+                               sparse_workers=self._sparse_slots(),
+                               sparse_rows=local_rows)
         from multiverso_tpu.parallel.async_engine import _stageable
         self._init_staging(num_row, num_col,
                            _stageable(self.local_store.updater))
 
     def _shard_offset(self) -> int:
         return int(self.row_offsets[self.rank])
+
+    def _sparse_slots(self) -> int:
+        """Per-worker staleness slots to arm on the serving shard; 0 =
+        plain matrix table (DistributedSparseMatrixTable overrides)."""
+        return 0
 
     def _route(self, rows: np.ndarray) -> Dict[int, np.ndarray]:
         out: Dict[int, List[int]] = {}
@@ -1710,11 +1720,11 @@ class DistributedKVTable(DistributedTableBase):
         return 0    # hash-partitioned: no contiguous offset
 
     def _route_keys(self, keys: np.ndarray) -> Dict[int, np.ndarray]:
-        """``key % num_servers`` (ref kv_table.h:48-50), by index."""
-        out: Dict[int, List[int]] = {}
-        for i, k in enumerate(keys.tolist()):
-            out.setdefault(int(k) % self.world, []).append(i)
-        return {s: np.asarray(ix, dtype=np.int64) for s, ix in out.items()}
+        """``key % num_servers`` (ref kv_table.h:48-50), by index —
+        vectorized: bulk KV ops must not pay a Python loop per key."""
+        owners = keys % self.world
+        return {int(s): np.flatnonzero(owners == s)
+                for s in np.unique(owners)}
 
     def _send_add(self, keys: np.ndarray, values: np.ndarray,
                   option: AddOption) -> _PendingOp:
@@ -1819,20 +1829,27 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
         check(updater == "default",
               "DistributedSparseMatrixTable requires the plain-add "
               f"updater; got '{updater}'")
+        # Set BEFORE super().__init__: the parent's single register_shard
+        # consults _sparse_slots() (no register-then-overwrite window),
+        # and _send_add_rows touches the cache.
+        self._incr_cache: Dict[int, np.ndarray] = {}
+        self.last_incremental_rows = 0   # observability (tests/monitor)
         super().__init__(table_id, num_row, num_col, service, peers, rank,
                          dtype=dtype, updater=updater)
         self.name = f"dist_sparse_matrix_{table_id}"
-        # Arm staleness tracking on the local shard for the DCN worker
-        # universe (re-registration overwrites the plain entry). Bitmap
-        # spans the REAL local rows — 0 for a degenerate empty shard.
-        service.register_shard(
-            table_id, self.local_store,
-            row_offset=self.row_offsets[rank],
-            sync_workers=self._sync_workers(),
-            sparse_workers=self.world * self._n_local,
-            sparse_rows=self.row_offsets[rank + 1] - self.row_offsets[rank])
-        self._incr_cache: Dict[int, np.ndarray] = {}
-        self.last_incremental_rows = 0   # observability (tests/monitor)
+
+    def _sparse_slots(self) -> int:
+        """Arm the serving shard's staleness bitmap for the DCN worker
+        universe (bitmap spans the REAL local rows — 0 on an empty
+        shard)."""
+        return self.world * self._n_local
+
+    def _cache_for(self, wid: int) -> np.ndarray:
+        cache = self._incr_cache.get(wid)
+        if cache is None:
+            cache = self._incr_cache[wid] = np.zeros(
+                (self.num_row, self.num_col), dtype=np.float32)
+        return cache
 
     def _send_add_rows(self, rows: np.ndarray, deltas: np.ndarray,
                        option: AddOption) -> _PendingOp:
@@ -1845,20 +1862,21 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
         cache here, client-side."""
         option = dataclasses.replace(
             option, worker_id=self._gid(option.worker_id))
-        cache = self._incr_cache.get(option.worker_id)
-        if cache is None:
-            cache = self._incr_cache[option.worker_id] = np.zeros(
-                (self.num_row, self.num_col), dtype=np.float32)
-        np.add.at(cache, np.asarray(rows, dtype=np.int64),
+        np.add.at(self._cache_for(option.worker_id),
+                  np.asarray(rows, dtype=np.int64),
                   np.asarray(deltas, dtype=np.float32))
         parts = []
         routed = self._route(rows)
         for s, ix in routed.items():
+            # clip=0.0: the freshness contract requires the server to
+            # apply EXACTLY the delta the client mirrored into its cache —
+            # the lossy user clip threshold would diverge them silently.
             msg = Message(src=self.rank, type=MsgType.Request_Add,
                           table_id=self.table_id,
                           msg_id=self._next_msg_id(),
                           data=[rows[ix], _opt_to_array(option),
-                                *pack_payload(deltas[ix], _wire_mode())])
+                                *pack_payload(deltas[ix], _wire_mode(),
+                                              clip=0.0)])
             parts.append((s, msg, self._request_or_retry(s, msg)))
         parts.extend(self._bsp_tick_parts(MsgType.Request_Add, routed,
                                           option=option))
@@ -1866,35 +1884,42 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
 
     def get(self, option: "Optional[GetOption]" = None) -> np.ndarray:
         """Incremental whole-table get: each shard returns only the rows
-        stale for this worker; fresh rows come from the local cache."""
-        self.flush()
-        wid = self._gid(option.worker_id if option is not None else 0)
-        cache = self._incr_cache.get(wid)
-        if cache is None:
-            cache = self._incr_cache[wid] = np.zeros(
-                (self.num_row, self.num_col), dtype=np.float32)
-        parts = []
-        for s in range(self.world):
-            msg = Message(src=self.rank, type=MsgType.Request_Get,
-                          table_id=self.table_id,
-                          msg_id=self._next_msg_id(),
-                          data=[np.asarray([STALE_GET_KEY], np.int32),
-                                np.asarray([wid], np.int32)])
-            parts.append((s, msg, self._request_or_retry(s, msg)))
+        stale for this worker; fresh rows come from the local cache.
 
-        def assemble(replies: List[Message]) -> np.ndarray:
-            pulled = 0
-            for reply in replies:
-                rows = reply.data[0]
-                if rows.size:
-                    cache[rows] = unpack_payload(reply.data[1:])
-                pulled += int(rows.size)
-            self.last_incremental_rows = pulled
-            return cache.copy()
+        Async mode holds ``_op_lock`` through the wait: a concurrent
+        ``add_rows`` mutates the same cache and marks its rows fresh
+        server-side, so a stale-get reply raced past it would overwrite
+        the cache with pre-add values that no future get re-pulls. BSP
+        waits outside the lock (the clock gates already enforce per-worker
+        program order, and a gated wait under the lock could deadlock
+        against another local worker's add on the same handle)."""
+        with self._op_lock:
+            self.flush()
+            wid = self._gid(option.worker_id if option is not None else 0)
+            cache = self._cache_for(wid)
+            parts = []
+            for s in range(self.world):
+                msg = Message(src=self.rank, type=MsgType.Request_Get,
+                              table_id=self.table_id,
+                              msg_id=self._next_msg_id(),
+                              data=[np.asarray([STALE_GET_KEY], np.int32),
+                                    np.asarray([wid], np.int32)])
+                parts.append((s, msg, self._request_or_retry(s, msg)))
 
-        return _PendingOp(parts, assemble,
-                          retrier=self._retry_request).wait(
-                              self._op_timeout)
+            def assemble(replies: List[Message]) -> np.ndarray:
+                pulled = 0
+                for reply in replies:
+                    rows = reply.data[0]
+                    if rows.size:
+                        cache[rows] = unpack_payload(reply.data[1:])
+                    pulled += int(rows.size)
+                self.last_incremental_rows = pulled
+                return cache.copy()
+
+            op = _PendingOp(parts, assemble, retrier=self._retry_request)
+            if not self._bsp:
+                return op.wait(self._op_timeout)
+        return op.wait(self._op_timeout)
 
     def load_state(self, payload: Dict[str, np.ndarray]) -> None:
         super().load_state(payload)

@@ -18,7 +18,8 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libmvtpu_host.so")
-_SRC = os.path.join(_DIR, "src", "mv_runtime.cpp")
+_SRCS = [os.path.join(_DIR, "src", "mv_runtime.cpp"),
+         os.path.join(_DIR, "src", "mv_client.cpp")]
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()
@@ -32,7 +33,7 @@ def _build() -> None:
     # No -ffast-math: it links crtfastmath.o, which flips FTZ/DAZ for the
     # whole process at dlopen and silently changes numpy/JAX numerics.
     cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread",
-           "-fno-math-errno", "-shared", "-o", _SO, _SRC]
+           "-fno-math-errno", "-shared", "-o", _SO, *_SRCS]
     result = subprocess.run(cmd, capture_output=True, text=True)
     if result.returncode != 0:
         raise NativeRuntimeUnavailable(
@@ -45,7 +46,8 @@ def load() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         stale = (not os.path.exists(_SO) or
-                 os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+                 os.path.getmtime(_SO) < max(os.path.getmtime(s)
+                                             for s in _SRCS))
         if stale:
             _build()
         lib = ctypes.CDLL(_SO)

@@ -1,0 +1,93 @@
+"""Foreign-host C FFI against Python-served PS shards (VERDICT r3 #2).
+
+The reference's ``c_api`` is an ``extern "C"`` boundary any language can
+dlopen (include/multiverso/c_api.h:16-54). Here the equivalent boundary is
+the framed TCP wire protocol spoken by ``src/mv_client.cpp`` inside
+``libmvtpu_host.so``: this test COMPILES a plain C program
+(examples/c_table_demo.c), runs it against two Python PSService shards,
+and asserts full cross-language visibility — C reads what Python wrote,
+Python reads what C wrote.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.parallel.ps_service import (DistributedArrayTable,
+                                                DistributedKVTable,
+                                                DistributedMatrixTable,
+                                                PSService)
+from multiverso_tpu.runtime import ffi
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEMO_SRC = os.path.join(REPO, "examples", "c_table_demo.c")
+
+
+@pytest.fixture(scope="module")
+def demo_binary(tmp_path_factory):
+    ffi.load()      # (re)build libmvtpu_host.so with the client compiled in
+    out = tmp_path_factory.mktemp("cdemo") / "c_table_demo"
+    cc = os.environ.get("CC", "gcc")
+    subprocess.run([cc, "-O2", "-Wall", "-o", str(out), DEMO_SRC, "-ldl"],
+                   check=True, capture_output=True, text=True)
+    return str(out)
+
+
+def test_c_client_against_python_shards(demo_binary, mv_env):
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    AID, MID, KID = 201, 202, 203
+    try:
+        a0 = DistributedArrayTable(AID, 10, svc0, peers, rank=0)
+        a1 = DistributedArrayTable(AID, 10, svc1, peers, rank=1)
+        m0 = DistributedMatrixTable(MID, 8, 3, svc0, peers, rank=0)
+        DistributedMatrixTable(MID, 8, 3, svc1, peers, rank=1)
+        k0 = DistributedKVTable(KID, svc0, peers, rank=0)
+        DistributedKVTable(KID, svc1, peers, rank=1)
+
+        # Python-side seeds the C program asserts against.
+        a0.add(np.arange(100, 110, dtype=np.float32))      # array: 100+i
+        m0.add_rows([1, 3, 6], np.full((3, 3), 10.0, dtype=np.float32))
+        k0.add([4, 7, 1000000007], [1000, 1000, 1000])
+
+        peer_str = ";".join(f"{h}:{p}" for h, p in peers)
+        so = os.path.join(REPO, "multiverso_tpu", "runtime",
+                          "libmvtpu_host.so")
+        proc = subprocess.run(
+            [demo_binary, so, peer_str, str(AID), str(MID), str(KID)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, \
+            f"C demo failed:\n{proc.stdout}\n{proc.stderr}"
+        assert "C_DEMO_OK" in proc.stdout
+
+        # ...and Python sees every value the C host pushed.
+        np.testing.assert_allclose(
+            a1.get(), np.arange(100, 110, dtype=np.float32)
+            + np.arange(10, dtype=np.float32))
+        np.testing.assert_allclose(
+            m0.get_rows([1, 3, 6]),
+            np.arange(1, 10, dtype=np.float32).reshape(3, 3) + 10.0)
+        np.testing.assert_array_equal(k0.get([4, 7, 1000000007]),
+                                      [1040, 1070, 1007])
+    finally:
+        svc0.close()
+        svc1.close()
+
+
+def test_c_client_symbols_exported():
+    """The flat MV_* surface is present in the shared object (parity rows
+    for Lua/C#/CLR hosts rest on this boundary being real)."""
+    import ctypes
+    ffi.load()
+    so = os.path.join(REPO, "multiverso_tpu", "runtime",
+                      "libmvtpu_host.so")
+    lib = ctypes.CDLL(so)
+    for sym in ("MV_ConnectClient", "MV_CloseClient", "MV_NumServers",
+                "MV_NewArrayTable", "MV_GetArrayTable", "MV_AddArrayTable",
+                "MV_NewMatrixTable", "MV_AddMatrixTableByRows",
+                "MV_GetMatrixTableByRows", "MV_NewKVTable", "MV_AddKVTable",
+                "MV_GetKVTable", "MV_FreeTable"):
+        assert hasattr(lib, sym), f"missing symbol {sym}"
