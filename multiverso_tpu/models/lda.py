@@ -85,10 +85,13 @@ class LDA:
                           ) -> np.ndarray:
         K = self.cfg.num_topics
         topics = self._rng.integers(0, K, size=len(words)).astype(np.int32)
-        # Seed the global tables with the initial counts.
-        wt = np.zeros((self.V, K), dtype=np.float32)
-        np.add.at(wt, (words, topics), 1.0)
-        self.word_topic.add(wt)
+        # Seed the global tables with the initial counts — touched rows
+        # only: at lightLDA scale (V=1M, K=1000) a dense [V, K] push is
+        # 4GB; the corpus vocabulary is what actually has counts.
+        uw, inv = np.unique(words, return_inverse=True)
+        wt_rows = np.zeros((len(uw), K), dtype=np.float32)
+        np.add.at(wt_rows, (inv, topics), 1.0)
+        self.word_topic.add_rows(uw.astype(np.int32), wt_rows)
         tk = np.bincount(topics, minlength=K).astype(np.float32)
         self.topic.add(tk)
         np.add.at(self.doc_topic, (docs, topics), 1.0)
@@ -110,19 +113,24 @@ class LDA:
                 w = words[start:start + B]
                 d = docs[start:start + B]
                 t = topics[start:start + B]
-                # Pull fresh global counts for this block's words.
-                n_wk = self.word_topic.get_rows(w)
+                # Pull fresh global counts for this block's UNIQUE words —
+                # per-block traffic is O(unique x K) both directions (the
+                # lightLDA scale contract), then fan out to tokens locally.
+                uw, inv = np.unique(w, return_inverse=True)
+                n_wk = self.word_topic.get_rows(uw)[inv]
                 n_k = self.topic.get()
                 n_dk = self.doc_topic[d]
                 self._key, sub = jax.random.split(self._key)
                 new_t = np.asarray(self._step(
                     jnp.asarray(n_wk), jnp.asarray(n_k), jnp.asarray(n_dk),
                     jnp.asarray(t), sub))
-                # Push count deltas (new - old) to the tables.
-                delta_w = np.zeros((self.V, K), dtype=np.float32)
-                np.add.at(delta_w, (w, new_t), 1.0)
-                np.add.at(delta_w, (w, t), -1.0)
-                self.word_topic.add(delta_w)
+                # Push count deltas (new - old) for EXACTLY the words this
+                # block touched (lightLDA's push shape): per-block bytes
+                # are O(unique words x K), independent of V.
+                delta_rows = np.zeros((len(uw), K), dtype=np.float32)
+                np.add.at(delta_rows, (inv, new_t), 1.0)
+                np.add.at(delta_rows, (inv, t), -1.0)
+                self.word_topic.add_rows(uw.astype(np.int32), delta_rows)
                 delta_k = (np.bincount(new_t, minlength=K)
                            - np.bincount(t, minlength=K)).astype(np.float32)
                 self.topic.add(delta_k)
