@@ -36,7 +36,7 @@ configure.define_bool("is_pipeline", True, "prefetch pipeline")
 configure.define_int("data_block_size", 100000, "words per block")
 configure.define_string("w2v_optimizer", "adagrad", "adagrad|sgd")
 configure.define_bool("use_device_pipeline", True,
-                      "on-device pair generation (sg+ns only)")
+                      "on-device pair generation (all four variants)")
 configure.define_int("block_sentences", 512,
                      "sentences per device block (device pipeline)")
 configure.define_int("pad_sentence_length", 512,
@@ -75,8 +75,7 @@ def _cfg_from_flags(device_pipeline: bool) -> "Word2VecConfig":
         block_words=configure.get_flag("data_block_size"),
         pipeline=configure.get_flag("is_pipeline"),
         device_pipeline=(device_pipeline and
-                         configure.get_flag("use_device_pipeline")
-                         and sg and not hs),
+                         configure.get_flag("use_device_pipeline")),
         block_sentences=configure.get_flag("block_sentences"),
         pad_sentence_length=configure.get_flag("pad_sentence_length"),
     )

@@ -45,10 +45,10 @@ def parse_weight_line(line: str) -> Tuple[float, float,
                                           List[int], List[float]]:
     """``label:weight key:value ...`` (ref WeightedSampleReader) — the
     libsvm tokenizer with the sample weight scaled into the values."""
-    head, _, rest = line.partition(" ")
-    label_s, _, weight_s = head.partition(":")
+    parts = line.split()    # any whitespace, like every other text format
+    label_s, _, weight_s = parts[0].partition(":")
     weight = float(weight_s) if weight_s else 1.0
-    _, idx, val = parse_libsvm_line("0 " + rest)
+    _, idx, val = parse_libsvm_line(" ".join(["0"] + parts[1:]))
     return float(label_s), weight, idx, [v * weight for v in val]
 
 

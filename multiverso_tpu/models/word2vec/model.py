@@ -64,8 +64,8 @@ class Word2VecConfig:
     # Embedding storage dtype: "float32" or "bfloat16" (math stays f32;
     # bf16 halves HBM bytes per gather/scatter — the dominant cost).
     param_dtype: str = "float32"
-    # Device pipeline (sg+ns): pair-gen/subsample/negatives on device;
-    # host uploads raw token ids only.
+    # Device pipeline (all four variants): pair-gen/windowing/subsample/
+    # negatives/Huffman gathers on device; host uploads raw token ids only.
     device_pipeline: bool = False
     # Compact valid pairs to the front of the device pair stream and skip
     # all-padding chunks (~2x fewer chunk steps at typical subsample rates).
@@ -139,17 +139,60 @@ def _pair_arrays(sents, lengths, keep_prob, k_keep, k_win, window):
 def _compact_stream(centers, contexts, pmask, chunk):
     """Stable-partition valid pairs to the front; [n, chunk] views +
     true pair count."""
-    P = centers.shape[0]
+    (centers, contexts), _, n_pairs, n = _compact_examples(
+        pmask, chunk, [centers, contexts], [])
+    return centers, contexts, n_pairs, n
+
+
+def _compact_examples(pmask, chunk, arrays1d, arrays2d):
+    """Stable-partition valid examples to the front across parallel
+    streams — 1-D ([P] -> [n, chunk]) and 2-D ([P, C] -> [n, chunk, C])
+    payloads share one cumsum/destination map."""
+    P = pmask.shape[0]
     total = P + (-P) % chunk
     n = total // chunk
-    n_pairs = pmask.sum().astype(jnp.int32)
+    n_ex = pmask.sum().astype(jnp.int32)
     dest = jnp.cumsum(pmask.astype(jnp.int32)) - 1
     dest = jnp.where(pmask, dest, total)
-    centers = (jnp.zeros(total, centers.dtype)
-               .at[dest].set(centers, mode="drop").reshape(n, chunk))
-    contexts = (jnp.zeros(total, contexts.dtype)
-                .at[dest].set(contexts, mode="drop").reshape(n, chunk))
-    return centers, contexts, n_pairs, n
+    out1 = [jnp.zeros(total, a.dtype).at[dest].set(a, mode="drop")
+            .reshape(n, chunk) for a in arrays1d]
+    out2 = [jnp.zeros((total, a.shape[1]), a.dtype)
+            .at[dest].set(a, mode="drop").reshape(n, chunk, a.shape[1])
+            for a in arrays2d]
+    return out1, out2, n_ex, n
+
+
+def _cbow_arrays(sents, lengths, keep_prob, k_keep, k_win, window):
+    """In-graph CBOW example construction: every kept token position is an
+    example whose context is the surrounding (randomly shrunk) window —
+    the device analog of the reference's CBOW loop
+    (``wordembedding.cpp:120-135``): contexts within the center's
+    effective window contribute; subsampled/pad tokens drop out of both
+    roles. Returns centers [S*L], contexts [S*L, 2W], cmask (f32), and
+    the example mask."""
+    S, L = sents.shape
+    pos = jnp.arange(L)[None, :]
+    valid = pos < lengths[:, None]
+    keep = jax.random.uniform(k_keep, (S, L)) < keep_prob[sents]
+    tok_valid = valid & keep
+    wpos = jax.random.randint(k_win, (S, L), 1, window + 1)
+    ctx_cols, m_cols = [], []
+    for d in range(1, window + 1):
+        pad_i = jnp.zeros((S, d), sents.dtype)
+        pad_b = jnp.zeros((S, d), bool)
+        right = jnp.concatenate([sents[:, d:], pad_i], axis=1)
+        rmask = jnp.concatenate([tok_valid[:, d:], pad_b], axis=1) \
+            & (wpos >= d)
+        left = jnp.concatenate([pad_i, sents[:, :-d]], axis=1)
+        lmask = jnp.concatenate([pad_b, tok_valid[:, :-d]], axis=1) \
+            & (wpos >= d)
+        ctx_cols += [right.reshape(-1), left.reshape(-1)]
+        m_cols += [rmask.reshape(-1), lmask.reshape(-1)]
+    contexts = jnp.stack(ctx_cols, axis=1)          # [S*L, 2W]
+    cmask = jnp.stack(m_cols, axis=1)               # [S*L, 2W]
+    ex_mask = tok_valid.reshape(-1) & cmask.any(axis=1)
+    return (sents.reshape(-1), contexts, cmask.astype(jnp.float32),
+            ex_mask)
 
 
 # ---------------------------------------------------------------------------
@@ -289,66 +332,118 @@ def raw_cbow_hs_step(adagrad: bool):
 
 
 def _make_block_fn(window: int, negative: int, chunk: int,
-                   adagrad: bool, compact: bool):
+                   adagrad: bool, compact: bool, sg: bool = True,
+                   hs: bool = False, huffman=None):
     """Unjitted whole-block step — factored out so the sharded builder can
-    apply dp x tp shardings.
+    apply dp x tp shardings. ALL FOUR variants (sg/cbow x ns/hs).
 
     The host uploads only raw token ids ([S, L] padded sentences + lengths)
     — everything the reference does on the worker CPU (subsampling, dynamic
-    window pair extraction, unigram negative sampling,
-    ``wordembedding.cpp:120-135`` / ``sampler.cpp``) happens inside one
-    jitted program: masked offset-shift pairing (static shapes), PRNG-driven
-    subsample/window/negative draws, then a loop over fixed-size chunks of
-    pairs through the fused update. Host->device traffic per block drops
-    from ~40 bytes/pair to 4 bytes/word.
+    window pair/window extraction, unigram negative sampling, Huffman path
+    lookup, ``wordembedding.cpp:120-135`` / ``sampler.cpp``) happens inside
+    one jitted program: masked offset-shift construction (static shapes),
+    PRNG-driven subsample/window/negative draws, in-graph gathers of the
+    Huffman point/code tables for HS, then a loop over fixed-size chunks
+    through the fused update. Host->device traffic per block drops from
+    ~40 bytes/pair to 4 bytes/word.
 
-    ``compact=True`` additionally scatter-compacts the valid pairs to the
-    front of the stream (cumsum positions + masked scatter — cheap int32
-    traffic) and runs a dynamic-trip-count ``fori_loop`` over only the
-    chunks that hold real pairs. The fixed window-d shift construction
+    ``compact=True`` additionally scatter-compacts the valid examples to
+    the front of the stream (cumsum positions + masked scatter — cheap
+    int32 traffic) and runs a dynamic-trip-count ``fori_loop`` over only
+    the chunks that hold real work. The fixed window-d shift construction
     leaves ~half the slots masked (subsampled words, shrunk windows,
     sentence pads); without compaction every one of those slots still pays
-    its (2+K)·D gather/einsum/scatter. With it the per-block compute is
-    proportional to true pairs — the TPU answer to the reference's exact
-    dynamic-window pair loop (``wordembedding.cpp:120-135``).
+    its gather/einsum/scatter. With it the per-block compute is
+    proportional to true examples — the TPU answer to the reference's
+    exact dynamic-window loop (``wordembedding.cpp:120-135``).
     """
-    raw = raw_sg_ns_step(adagrad)
+    if sg and not hs:
+        raw = raw_sg_ns_step(adagrad)
+    elif sg:
+        raw = raw_sg_hs_step(adagrad)
+    elif not hs:
+        raw = raw_cbow_ns_step(adagrad)
+    else:
+        raw = raw_cbow_hs_step(adagrad)
+    if hs:
+        check(huffman is not None, "HS device pipeline needs the encoder")
+        # Device-resident Huffman path tables; [V, Lc] gathers happen
+        # in-graph per chunk (closure constants: uploaded once, reused by
+        # every dispatch).
+        hp = jnp.asarray(huffman.points.astype(np.int32))
+        hc = jnp.asarray(huffman.codes.astype(np.float32))
+        hl = jnp.asarray(huffman.lengths.astype(np.int32))
+        l_lane = jnp.arange(hp.shape[1])
+
+    def _hs_args(target, m):
+        """points/codes/length-mask for a chunk of target word ids."""
+        pts = jnp.take(hp, target, axis=0, mode="clip")
+        cds = jnp.take(hc, target, axis=0, mode="clip")
+        lm = ((l_lane[None, :] <
+               jnp.take(hl, target, mode="clip")[:, None])
+              .astype(jnp.float32) * m[:, None])
+        return pts, cds, lm
+
+    def run_chunk(tables, slices, m, neg, lr):
+        """Dispatch one chunk's streams into the variant's raw step."""
+        if sg and not hs:
+            c, o = slices
+            return raw(*tables, c, o, neg, m, lr)
+        if sg and hs:
+            c, o = slices
+            return raw(*tables, c, *_hs_args(o, m), lr)
+        if not sg and not hs:
+            c, ctx, cm = slices
+            return raw(*tables, c, ctx, cm, neg, m, lr)
+        c, ctx, cm = slices
+        return raw(*tables, c, ctx, cm, *_hs_args(c, m), lr)
 
     def block_step(w_in, w_out, g_in, g_out, neg_table, keep_prob, sents,
                    lengths, key, lr):
         k_keep, k_win, k_neg = jax.random.split(key, 3)
-        centers, contexts, pmask = _pair_arrays(sents, lengths, keep_prob,
-                                                k_keep, k_win, window)
-        P = centers.shape[0]
+        if sg:
+            centers, contexts, pmask = _pair_arrays(
+                sents, lengths, keep_prob, k_keep, k_win, window)
+            arrays1d, arrays2d = [centers, contexts], []
+        else:
+            centers, contexts, cmask, pmask = _cbow_arrays(
+                sents, lengths, keep_prob, k_keep, k_win, window)
+            arrays1d, arrays2d = [centers], [contexts, cmask]
+        P = pmask.shape[0]
         pad = (-P) % chunk
         n = (P + pad) // chunk
 
         if compact:
-            centers, contexts, n_pairs, n = _compact_stream(
-                centers, contexts, pmask, chunk)
+            out1, out2, n_pairs, n = _compact_examples(
+                pmask, chunk, arrays1d, arrays2d)
+            streams = out1 + out2
         else:
             n_pairs = pmask.sum()
-            centers = jnp.pad(centers, (0, pad)).reshape(n, chunk)
-            contexts = jnp.pad(contexts, (0, pad)).reshape(n, chunk)
-        negatives = _row_gather_negatives(neg_table, k_neg,
-                                          (n, chunk, negative))
+            streams = [jnp.pad(a, (0, pad)).reshape(n, chunk)
+                       for a in arrays1d]
+            streams += [jnp.pad(a, ((0, pad), (0, 0)))
+                        .reshape(n, chunk, a.shape[1]) for a in arrays2d]
+        negatives = (None if hs else
+                     _row_gather_negatives(neg_table, k_neg,
+                                           (n, chunk, negative)))
 
         if compact:
             # After compaction the first n_pairs slots are exactly the
-            # valid pairs, so only ceil(n_pairs/chunk) chunks carry work.
+            # valid examples, so only ceil(n_pairs/chunk) chunks carry
+            # work.
             n_live = (n_pairs.astype(jnp.int32) + chunk - 1) // chunk
             lane = jnp.arange(chunk)
 
             def body(i, carry):
                 *tables, loss = carry
-                c = jax.lax.dynamic_index_in_dim(centers, i, keepdims=False)
-                o = jax.lax.dynamic_index_in_dim(contexts, i,
-                                                 keepdims=False)
-                neg = jax.lax.dynamic_index_in_dim(negatives, i,
-                                                   keepdims=False)
+                slices = tuple(
+                    jax.lax.dynamic_index_in_dim(s, i, keepdims=False)
+                    for s in streams)
+                neg = (None if hs else jax.lax.dynamic_index_in_dim(
+                    negatives, i, keepdims=False))
                 m = ((i * chunk + lane) <
                      n_pairs.astype(jnp.int32)).astype(jnp.float32)
-                out = raw(*tables, c, o, neg, m, lr)
+                out = run_chunk(tuple(tables), slices, m, neg, lr)
                 return (*out[:4], loss + out[4])
 
             carry = jax.lax.fori_loop(
@@ -358,34 +453,44 @@ def _make_block_fn(window: int, negative: int, chunk: int,
 
         mask = jnp.pad(pmask, (0, pad)).reshape(n, chunk) \
                   .astype(jnp.float32)
+        xs = (*streams, mask) if hs else (*streams, mask, negatives)
 
-        def body(carry, xs):
-            c, o, m, neg = xs
-            out = raw(*carry, c, o, neg, m, lr)
+        def body(carry, xs_i):
+            if hs:
+                *slices, m = xs_i
+                neg = None
+            else:
+                *slices, m, neg = xs_i
+            out = run_chunk(carry, tuple(slices), m, neg, lr)
             return out[:4], out[4]
 
         carry, losses = jax.lax.scan(
-            body, (w_in, w_out, g_in, g_out),
-            (centers, contexts, mask, negatives))
+            body, (w_in, w_out, g_in, g_out), xs)
         return (*carry, losses.sum(), n_pairs)
 
     return block_step
 
 
 def build_device_block_step(window: int, negative: int, chunk: int,
-                            adagrad: bool, compact: bool = True):
-    """Whole-block training step with ON-DEVICE pair generation.
+                            adagrad: bool, compact: bool = True,
+                            sg: bool = True, hs: bool = False,
+                            huffman=None):
+    """Whole-block training step with ON-DEVICE pair generation — all four
+    variants (sg/cbow x ns/hs).
 
-    The host uploads only raw token ids; pairing, subsampling, compaction,
-    negative sampling and the chunk training loop all run in one jitted
-    program (details in :func:`_make_block_fn`'s body)."""
+    The host uploads only raw token ids; pairing/windowing, subsampling,
+    compaction, negative sampling or Huffman path gathers, and the chunk
+    training loop all run in one jitted program (details in
+    :func:`_make_block_fn`'s body)."""
     return jax.jit(_make_block_fn(window, negative, chunk, adagrad,
-                                  compact),
+                                  compact, sg=sg, hs=hs, huffman=huffman),
                    donate_argnums=(0, 1, 2, 3))
 
 
 def build_sharded_block_step(mesh, window: int, negative: int, chunk: int,
-                             adagrad: bool, compact: bool = True):
+                             adagrad: bool, compact: bool = True,
+                             sg: bool = True, hs: bool = False,
+                             huffman=None):
     """The SAME block step jitted over a (data x model) mesh — the dp x tp
     execution the reference reaches with row-sharded tables across servers
     plus data-parallel workers (SURVEY.md §2.4):
@@ -406,7 +511,8 @@ def build_sharded_block_step(mesh, window: int, negative: int, chunk: int,
     data2 = NamedSharding(mesh, P("data", None))
     data1 = NamedSharding(mesh, P("data"))
     repl = NamedSharding(mesh, P())
-    fn = _make_block_fn(window, negative, chunk, adagrad, compact)
+    fn = _make_block_fn(window, negative, chunk, adagrad, compact,
+                        sg=sg, hs=hs, huffman=huffman)
     return jax.jit(
         fn,
         in_shardings=(table, table, table, table, repl, repl, data2, data1,
@@ -575,8 +681,6 @@ class Word2Vec:
         self._scan_step = build_scan_step(raw)
 
         if cfg.device_pipeline:
-            check(cfg.sg and not cfg.hs,
-                  "device_pipeline supports skip-gram + negative sampling")
             sampler = self.generator.sampler
             # Shuffled so 128-wide rows are iid draws (row-gather sampling).
             perm = np.random.default_rng(cfg.seed + 17).permutation(
@@ -588,8 +692,13 @@ class Word2Vec:
             self._keep_prob = jnp.asarray(keep_host)
             self._block_step = build_device_block_step(
                 cfg.window, cfg.negative, cfg.batch_size, adagrad,
-                compact=cfg.compact_pairs)
+                compact=cfg.compact_pairs, sg=cfg.sg, hs=cfg.hs,
+                huffman=self.huffman)
             if cfg.chunk_dispatch:
+                check(cfg.sg and not cfg.hs,
+                      "chunk_dispatch (host-dispatched per-chunk steps) "
+                      "is the sg-ns perf experiment path; the fused "
+                      "device block step covers all four variants")
                 (self._pair_gen, self._chunk_step,
                  self._tail_step) = build_chunked_pipeline(
                     cfg.window, cfg.negative, cfg.batch_size, adagrad)
@@ -613,7 +722,8 @@ class Word2Vec:
                     ("data", "model"))
                 self._block_step = build_sharded_block_step(
                     self._sharded_mesh, cfg.window, cfg.negative,
-                    cfg.batch_size, adagrad, compact=cfg.compact_pairs)
+                    cfg.batch_size, adagrad, compact=cfg.compact_pairs,
+                    sg=cfg.sg, hs=cfg.hs, huffman=self.huffman)
             self._key = jax.random.PRNGKey(cfg.seed)
 
         self.total_words = dictionary.total_count * max(cfg.epochs, 1)
