@@ -320,8 +320,21 @@ def bench_pallas_rows() -> None:
         t2 = scatter_add_sorted_rows(t2, ids, deltas)
     jax.block_until_ready(t2)
     pallas_ms = (_time.perf_counter() - t0) / 20 * 1000
+
+    # Tiled table-sweep variant (ROADMAP perf #2): block-mapped tile DMAs
+    # at sequential-HBM bandwidth instead of one DMA per row.
+    from multiverso_tpu.ops.pallas_rows import tiled_scatter_add_sorted_rows
+    tiled = jax.jit(tiled_scatter_add_sorted_rows, donate_argnums=0)
+    t3 = tiled(jnp.zeros((100_000, 128), dtype=jnp.float32), ids, deltas)
+    jax.block_until_ready(t3)
+    t0 = _time.perf_counter()
+    for _ in range(20):
+        t3 = tiled(t3, ids, deltas)
+    jax.block_until_ready(t3)
+    tiled_ms = (_time.perf_counter() - t0) / 20 * 1000
     _log(f"row scatter-add 8192x128 into 100Kx128: "
-         f"XLA {xla_ms:.2f}ms vs Pallas {pallas_ms:.2f}ms")
+         f"XLA {xla_ms:.2f}ms vs Pallas/row-DMA {pallas_ms:.2f}ms "
+         f"vs Pallas/tiled {tiled_ms:.2f}ms")
 
 
 def main() -> None:

@@ -177,7 +177,15 @@ class CheckpointManager:
         for stale in ckpts[:-self.keep_last]:
             full = os.path.join(self.directory, stale)
             try:   # concurrent ranks may prune the same shared directory
-                for f in os.listdir(full):
+                # Manifests go FIRST: latest_checkpoint selects on
+                # meta.json, so a crash (or racing rank) mid-prune leaves
+                # an unselectable directory, never one whose manifest
+                # survives its shard files.
+                entries = sorted(
+                    os.listdir(full),
+                    key=lambda f: (f != "meta.json",   # the selector file
+                                   not f.startswith("meta")))
+                for f in entries:
                     os.unlink(os.path.join(full, f))
                 os.rmdir(full)
             except OSError:

@@ -76,8 +76,10 @@ class Word2VecConfig:
     # bodies) — but each dispatch pays the host->device launch latency, so
     # this wins ONLY with a co-located host (real TPU VM, ~10us launches).
     # Over a tunneled/remote chip (driver bench: ~40ms/launch) it loses
-    # badly, hence default False; the path is kept bitwise-equal-tested.
-    chunk_dispatch: bool = False
+    # badly. None = AUTO: probe the actual dispatch latency at init and
+    # flip it on when launches are cheap (<1ms) and the variant is sg-ns
+    # on a single device. The path is kept bitwise-equal-tested.
+    chunk_dispatch: Optional[bool] = None
     block_sentences: int = 512      # sentences per device block
     pad_sentence_length: int = 512  # fixed sentence pad (longer ones split)
     # dp x tp mesh for the device pipeline: sentences sharded over
@@ -521,6 +523,28 @@ def build_sharded_block_step(mesh, window: int, negative: int, chunk: int,
         donate_argnums=(0, 1, 2, 3))
 
 
+# Dispatch-latency threshold for chunk_dispatch AUTO: below this, host
+# launches are cheap enough that per-chunk dispatch beats the in-graph
+# loop's de-optimized scatter (round-2 measurements: standalone chunk
+# 0.05-0.12ms vs 2.2-2.6ms in-loop; tunneled launches ~40ms lose).
+CHUNK_DISPATCH_LATENCY_MS = 1.0
+
+
+def measured_dispatch_latency_ms(n: int = 7) -> float:
+    """Median latency of a trivial jitted dispatch + sync — the signal
+    that decides chunk_dispatch AUTO (co-located chip ~10-100us launches;
+    a tunneled chip ~40ms)."""
+    f = jax.jit(lambda a: a + 1.0)
+    x = jnp.zeros(8, jnp.float32)
+    f(x).block_until_ready()       # compile outside the timing
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
 def build_chunked_pipeline(window: int, negative: int, chunk: int,
                            adagrad: bool):
     """Device pair-gen + HOST-dispatched per-chunk training steps.
@@ -694,7 +718,23 @@ class Word2Vec:
                 cfg.window, cfg.negative, cfg.batch_size, adagrad,
                 compact=cfg.compact_pairs, sg=cfg.sg, hs=cfg.hs,
                 huffman=self.huffman)
-            if cfg.chunk_dispatch:
+            use_chunked = cfg.chunk_dispatch
+            if use_chunked is None:
+                # AUTO: per-chunk host dispatch sidesteps the in-graph
+                # loop's scatter de-optimization, but only pays when
+                # launches are cheap — probe and decide.
+                eligible = (cfg.sg and not cfg.hs
+                            and cfg.mesh_data * cfg.mesh_model == 1)
+                if eligible:
+                    lat = measured_dispatch_latency_ms()
+                    use_chunked = lat < CHUNK_DISPATCH_LATENCY_MS
+                    log.info("w2v chunk_dispatch auto: dispatch latency "
+                             "%.3fms -> %s", lat,
+                             "chunked" if use_chunked else "fused block")
+                else:
+                    use_chunked = False
+            self._chunk_dispatch = bool(use_chunked)
+            if self._chunk_dispatch:
                 check(cfg.sg and not cfg.hs,
                       "chunk_dispatch (host-dispatched per-chunk steps) "
                       "is the sg-ns perf experiment path; the fused "
@@ -704,7 +744,7 @@ class Word2Vec:
                     cfg.window, cfg.negative, cfg.batch_size, adagrad)
             self._sharded_mesh = None
             if cfg.mesh_data * cfg.mesh_model > 1:
-                check(not cfg.chunk_dispatch,
+                check(not self._chunk_dispatch,
                       "chunk_dispatch and a dp x tp mesh are mutually "
                       "exclusive: per-chunk host dispatch would serialize "
                       "the sharded step; pick one")
@@ -939,7 +979,7 @@ class Word2Vec:
             else:
                 buf = None
                 source = blocks
-            chunked = self.cfg.chunk_dispatch and not sharded
+            chunked = self._chunk_dispatch and not sharded
             W, chunk = self.cfg.window, self.cfg.batch_size
             try:
                 for mat, lens, words in source:

@@ -197,3 +197,96 @@ def scatter_add_rows(table: jax.Array, ids: jax.Array, deltas: jax.Array,
     return scatter_add_sorted_rows(table, jnp.take(ids, order),
                                    jnp.take(deltas, order, axis=0),
                                    interpret=interpret, sign=sign)
+
+
+# ---------------------------------------------------------------------------
+# tiled scatter-add: whole-table tile sweep (ROADMAP perf #2)
+# ---------------------------------------------------------------------------
+# The per-row-DMA kernel above moves one row per DMA (~1us each) — it can
+# never beat the standalone XLA scatter at bench shape (8K deltas into a
+# 100K x 128 table). This variant instead SWEEPS the table in block-mapped
+# (T, D) tiles: Mosaic double-buffers the big sequential tile DMAs at
+# near-peak HBM bandwidth, the full sorted delta set sits in VMEM, and
+# each grid step applies its tile's delta segment (pre-sliced client-side
+# with two searchsorted calls) via an in-kernel dynamic loop. Duplicates
+# fold naturally (sequential accumulation into the same VMEM row). Cost
+# model: read+write of the table (~0.25ms for 100Kx128 f32 at v5e HBM
+# peak) + O(N*D) VPU adds — independent of how scattered the ids are.
+
+_TILE_ROWS = 256
+_TILED_DELTA_VMEM_LIMIT = 8 << 20    # full delta block must fit in VMEM
+
+
+def _make_tiled_kernel(tile: int, sign: float):
+    def _kernel(starts_ref, ends_ref, ids_ref, deltas_ref, table_in_ref,
+                out_ref):
+        g = pl.program_id(0)
+        out_ref[:] = table_in_ref[:]
+        base = g * tile
+
+        def body(j, carry):
+            r = ids_ref[j] - base
+            row = out_ref[pl.ds(r, 1), :]
+            d = deltas_ref[pl.ds(j, 1), :]
+            step = d if sign > 0 else -d
+            out_ref[pl.ds(r, 1), :] = row + step.astype(row.dtype)
+            return carry
+
+        jax.lax.fori_loop(starts_ref[g], ends_ref[g], body, 0)
+    return _kernel
+
+
+def tiled_scatter_add_sorted_rows(table: jax.Array, sorted_ids: jax.Array,
+                                  sorted_deltas: jax.Array,
+                                  interpret: bool = False,
+                                  sign: float = 1.0,
+                                  tile: int = _TILE_ROWS) -> jax.Array:
+    """table[ids[i]] += sign*deltas[i] for SORTED ids via a tiled table
+    sweep. Requires the delta block to fit VMEM (use
+    ``tiled_scatter_eligible``)."""
+    if sign not in (1.0, -1.0):
+        raise ValueError(f"sign must be +-1.0; got {sign}")
+    rows, d = table.shape
+    # Non-divisible row counts use Pallas's native boundary-block masking
+    # (grid = ceil(rows/tile)) — padding the table here would add two
+    # whole-table HBM copies per call and break donation through the
+    # padded temp, skewing the very bench this kernel is judged by.
+    n_tiles = -(-rows // tile)
+    bounds = jnp.arange(n_tiles + 1, dtype=sorted_ids.dtype) * tile
+    starts = jnp.searchsorted(sorted_ids, bounds[:-1]).astype(jnp.int32)
+    ends = jnp.searchsorted(sorted_ids, bounds[1:]).astype(jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,      # starts, ends, ids
+        grid=(n_tiles,),
+        in_specs=[
+            # Full sorted delta set: one VMEM block, constant across grid.
+            pl.BlockSpec((sorted_deltas.shape[0], d),
+                         lambda g, *refs: (0, 0)),
+            # Table tile for this grid step.
+            pl.BlockSpec((tile, d), lambda g, *refs: (g, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, d), lambda g, *refs: (g, 0)),
+    )
+    return pl.pallas_call(
+        _make_tiled_kernel(tile, sign),
+        out_shape=jax.ShapeDtypeStruct((rows, d), table.dtype),
+        grid_spec=grid_spec,
+        input_output_aliases={4: 0},   # table (after 3 scalars + deltas)
+        interpret=interpret,
+    )(starts, ends, sorted_ids.astype(jnp.int32), sorted_deltas, table)
+
+
+def tiled_scatter_eligible(n_deltas: int, n_cols: int, dtype) -> bool:
+    """The whole delta block must fit the VMEM budget."""
+    return (n_deltas * n_cols * np.dtype(dtype).itemsize
+            <= _TILED_DELTA_VMEM_LIMIT)
+
+
+def tiled_scatter_add_rows(table: jax.Array, ids: jax.Array,
+                           deltas: jax.Array, interpret: bool = False,
+                           sign: float = 1.0) -> jax.Array:
+    """Unsorted convenience wrapper: argsort (XLA), then the tiled sweep."""
+    order = jnp.argsort(ids)
+    return tiled_scatter_add_sorted_rows(
+        table, jnp.take(ids, order), jnp.take(deltas, order, axis=0),
+        interpret=interpret, sign=sign)
