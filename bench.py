@@ -324,7 +324,7 @@ def bench_pallas_rows() -> None:
     # Tiled table-sweep variant (ROADMAP perf #2): block-mapped tile DMAs
     # at sequential-HBM bandwidth instead of one DMA per row.
     from multiverso_tpu.ops.pallas_rows import tiled_scatter_add_sorted_rows
-    tiled = jax.jit(tiled_scatter_add_sorted_rows, donate_argnums=0)
+    tiled = tiled_scatter_add_sorted_rows     # jitted + donating already
     t3 = tiled(jnp.zeros((100_000, 128), dtype=jnp.float32), ids, deltas)
     jax.block_until_ready(t3)
     t0 = _time.perf_counter()

@@ -236,6 +236,8 @@ def _make_tiled_kernel(tile: int, sign: float):
     return _kernel
 
 
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("interpret", "sign", "tile"))
 def tiled_scatter_add_sorted_rows(table: jax.Array, sorted_ids: jax.Array,
                                   sorted_deltas: jax.Array,
                                   interpret: bool = False,
@@ -282,6 +284,8 @@ def tiled_scatter_eligible(n_deltas: int, n_cols: int, dtype) -> bool:
             <= _TILED_DELTA_VMEM_LIMIT)
 
 
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("interpret", "sign"))
 def tiled_scatter_add_rows(table: jax.Array, ids: jax.Array,
                            deltas: jax.Array, interpret: bool = False,
                            sign: float = 1.0) -> jax.Array:

@@ -683,10 +683,12 @@ class PSService:
     # not one-shot, so a restarted rank rejoins without peer intervention).
     def enable_directory(self, rank: int, peers: List[Tuple[str, int]]
                          ) -> None:
-        """Adopt a rank identity and join the rank-0 directory. Idempotent.
-        Rank 0 hosts the directory (seeded from the static peer list);
-        other ranks register their CURRENT address with it at startup —
-        which is exactly what a restarted process does too."""
+        """Adopt a rank identity and join the membership directory.
+        Idempotent. EVERY service keeps a directory replica (seeded from
+        the static peer list); a starting — or RESTARTING — rank
+        registers its current address with every live peer, so lookups
+        survive any single seat going down, including rank 0 (the
+        reference Controller's one uncovered seat)."""
         if getattr(self, "rank", None) is not None:
             return
         self.rank = rank
@@ -694,19 +696,37 @@ class PSService:
             for r, addr in enumerate(peers):
                 self._directory.setdefault(r, tuple(addr))
             self._directory[rank] = tuple(self.address)
-        if rank != 0 and peers:
-            try:
-                self._register_with(tuple(peers[0]))
-            except OSError as e:
-                log.warning("directory registration failed: %s", e)
+        # Fan the registrations out CONCURRENTLY with a short budget:
+        # serial 10s connects to not-yet-listening cross-host peers would
+        # block table construction for minutes on a cold start. Stragglers
+        # finish in the background (daemon threads) — registration is
+        # best-effort either way, the static seed list covers the start.
+        threads = []
+        for r, addr in enumerate(peers):
+            if r == rank:
+                continue
 
-    def _register_with(self, directory_addr: Tuple[str, int]) -> None:
+            def reg(r=r, addr=tuple(addr)):
+                try:
+                    self._register_with(addr, timeout=3)
+                except OSError as e:
+                    log.warning("directory registration with rank %d "
+                                "failed: %s", r, e)
+
+            th = threading.Thread(target=reg, daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=3)
+
+    def _register_with(self, directory_addr: Tuple[str, int],
+                       timeout: float = 10) -> None:
         host, port = self.address
         msg = Message(src=self.rank, type=MsgType.Control_Register,
                       msg_id=0,
                       data=[np.asarray([self.rank, port], dtype=np.int64),
                             np.frombuffer(host.encode(), dtype=np.uint8)])
-        with socket.create_connection(directory_addr, timeout=10) as s:
+        with socket.create_connection(directory_addr, timeout=timeout) as s:
             send_message(s, msg)
             recv_message(s)     # ack
 
@@ -1049,10 +1069,11 @@ class DistributedTableBase:
         self._n_local = max(1, zoo.num_local_workers)
         self._clients: Dict[int, PeerClient] = {}
         self._peers = peers
-        # Join the central membership directory (rank 0, the Controller
-        # analog): a restarted rank re-registers its new address here and
-        # peers rediscover it on the next failed request — no manual
-        # reconnect() required.
+        # Join the REPLICATED membership directory (the Controller analog,
+        # replicated on every service): a restarted rank re-registers its
+        # new address with every live peer and traffic rediscovers it on
+        # the next failed request — no manual reconnect(), any seat may
+        # die, rank 0 included.
         service.enable_directory(rank, peers)
         self._op_lock = threading.RLock()
         self._pending: "collections.OrderedDict[int, _PendingOp]" = \
@@ -1087,29 +1108,55 @@ class DistributedTableBase:
         return client
 
     # -- elastic rediscovery -----------------------------------------------
-    def _lookup_peer(self, server: int) -> Optional[Tuple[str, int]]:
-        """Current address of ``server`` per the rank-0 directory. Like the
-        reference Controller, the directory lives on rank 0 — rank 0 itself
-        restarting is the one seat rediscovery cannot cover."""
+    def _lookup_peer(self, server: int,
+                     avoid: Optional[Tuple[str, int]] = None
+                     ) -> Optional[Tuple[str, int]]:
+        """Current address of ``server``. The directory is REPLICATED:
+        this process's own replica answers first (a restarting peer
+        registers its new address with every live rank directly), then
+        remote replicas are consulted in rank order — so rediscovery
+        survives any seat going down, rank 0 included. ``avoid`` is the
+        address the caller just failed against: a replica still holding
+        it is stale, so the search continues past it (falling back to it
+        only when no replica knows better — the retry loop re-polls)."""
         svc = self._service
-        if svc.rank == 0:
-            return svc.lookup(server)
-        try:
-            msg = Message(src=self.rank, type=MsgType.Control_Lookup,
-                          msg_id=self._next_msg_id(),
-                          data=[np.asarray([server], dtype=np.int64)])
-            with socket.create_connection(tuple(self._peers[0]),
-                                          timeout=5) as s:
-                send_message(s, msg)
-                reply = recv_message(s)
-            if reply is None:
-                return None
-            port = int(reply.data[0][0])
-            if port < 0:
-                return None
-            return (reply.data[1].tobytes().decode(), port)
-        except OSError:
-            return None
+
+        def candidates():
+            local = svc.lookup(server)
+            if local is not None:
+                yield tuple(local)
+            for r in range(self.world):
+                if r in (self.rank, server):
+                    continue
+                try:
+                    msg = Message(src=self.rank,
+                                  type=MsgType.Control_Lookup,
+                                  msg_id=self._next_msg_id(),
+                                  data=[np.asarray([server],
+                                                   dtype=np.int64)])
+                    # Short timeout: this runs inside the 0.3s retry
+                    # poll loop and a partitioned (SYN-dropping) replica
+                    # must not eat the whole RETRY_WINDOW per sweep.
+                    with socket.create_connection(tuple(self._peers[r]),
+                                                  timeout=1.5) as s:
+                        send_message(s, msg)
+                        reply = recv_message(s)
+                    if reply is None:
+                        continue
+                    port = int(reply.data[0][0])
+                    if port < 0:
+                        continue
+                    yield (reply.data[1].tobytes().decode(), port)
+                except OSError:
+                    continue
+
+        fallback = None
+        for cand in candidates():   # lazy: a fresh local answer returns
+            if avoid is None or cand != tuple(avoid):   # without any
+                return cand                             # remote queries
+            if fallback is None:
+                fallback = cand
+        return fallback
 
     def _retry_request(self, server: int, msg: Message
                        ) -> Tuple[threading.Event, List]:
@@ -1117,11 +1164,12 @@ class DistributedTableBase:
         Polls the directory for up to RETRY_WINDOW so a peer mid-restart is
         picked up as soon as it re-registers."""
         deadline = time.monotonic() + self.RETRY_WINDOW
+        dead_addr = tuple(self._peers[server])
         while True:
             old = self._clients.pop(server, None)
             if old is not None:
                 old.close()
-            addr = self._lookup_peer(server)
+            addr = self._lookup_peer(server, avoid=dead_addr)
             if addr is not None:
                 self._peers[server] = addr
             try:

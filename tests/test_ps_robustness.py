@@ -208,3 +208,42 @@ def test_finish_train_scoped_to_one_table(sync_world):
         assert svc._sync[6]._adds.value(0) == inf     # retired on A
         assert svc._sync[7]._adds.value(0) == 0.0     # still live on B
     tb0.close(); ta0.close()
+
+
+def test_rank0_restart_rediscovered_via_replicated_directory(mv_env):
+    """The one seat round-3 rediscovery could not cover: rank 0 (the
+    directory host) dies and restarts at a NEW address. The directory is
+    now replicated on every service and a restarting rank registers with
+    every live peer, so rank 1 rediscovers rank 0 from its OWN replica —
+    automatically, with no manual reconnect()."""
+    import os
+    import tempfile
+
+    from multiverso_tpu.core import checkpoint as ckpt
+
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    t0 = DistributedArrayTable(9, 40, svc0, peers, rank=0)
+    t1 = DistributedArrayTable(9, 40, svc1, peers, rank=1)
+    t1.add(np.arange(40, dtype=np.float32))
+    np.testing.assert_allclose(t1.get(), np.arange(40))
+
+    uri = f"file://{os.path.join(tempfile.mkdtemp(), 'shard0.npz')}"
+    ckpt.save_table(t0, uri)
+    svc0.close()
+    time.sleep(0.2)
+
+    # rank 0 restarts at a NEW address; enable_directory registers the
+    # new seat with rank 1's directory replica during table construction.
+    svc0b = PSService()
+    t0b = DistributedArrayTable(9, 40, svc0b,
+                                [svc0b.address, peers[1]], rank=0)
+    ckpt.load_table(t0b, uri)
+
+    # rank 1's next op hits the dead connection, retries through its own
+    # replica, and lands on the restarted rank 0 — no reconnect() call.
+    t1.add(np.ones(40, dtype=np.float32))
+    got = t1.get()
+    np.testing.assert_allclose(got, np.arange(40) + 1.0)
+    np.testing.assert_allclose(t0b.get(), np.arange(40) + 1.0)
+    svc0b.close(); svc1.close()
