@@ -134,17 +134,36 @@ class _SparseShardState:
     """Per-worker staleness bitmap for one sparse table shard (ref
     ``up_to_date_[worker][row]``, sparse_matrix_table.cpp:184-197 — there
     per server process, here per PSService shard). All access is on the
-    single dispatcher thread; no lock needed."""
+    single dispatcher thread; no lock needed.
 
-    def __init__(self, num_workers: int, num_rows: int):
+    Two freshness modes for the WRITER's own rows on Add:
+
+    * ``mirror=True`` (plain-add tables): the client applies its own
+      delta to its cache, so the writer's rows are forced FRESH — the
+      writer always sees its own writes.
+    * ``mirror=False`` (stateful updaters — sgd/ftrl — where the client
+      cannot reproduce the server's update): the writer's bits are LEFT
+      UNCHANGED, exactly the reference's UpdateAddState (:199-223: only
+      ``id != worker_id`` rows are invalidated). The writer's view is
+      its last pull; its own add becomes visible when any worker's write
+      re-stales the row. Looser, but sound for any updater.
+    """
+
+    def __init__(self, num_workers: int, num_rows: int,
+                 mirror: bool = True):
         self.stale = np.ones((num_workers, num_rows), dtype=bool)
+        self.mirror = mirror
 
     def on_add(self, local_rows: np.ndarray, worker: int) -> None:
-        """Add invalidates the touched rows for every OTHER worker (ref
-        :200-223); the writer's own copy is fresh by construction."""
-        self.stale[:, local_rows] = True
-        if 0 <= worker < self.stale.shape[0]:
-            self.stale[worker, local_rows] = False
+        if self.mirror:
+            self.stale[:, local_rows] = True
+            if 0 <= worker < self.stale.shape[0]:
+                self.stale[worker, local_rows] = False
+        else:       # ref-exact: invalidate others, leave the writer as-is
+            w = worker % self.stale.shape[0]
+            keep = self.stale[w, local_rows].copy()
+            self.stale[:, local_rows] = True
+            self.stale[w, local_rows] = keep
 
     def take_stale(self, worker: int) -> np.ndarray:
         """Rows stale for ``worker``; marks them fresh (ref
@@ -249,7 +268,8 @@ class PSService:
     def register_shard(self, table_id: int, store: ServerStore,
                        row_offset: int = 0, sync_workers: int = 0,
                        sparse_workers: int = 0,
-                       sparse_rows: int = 0) -> None:
+                       sparse_rows: int = 0,
+                       sparse_mirror: bool = True) -> None:
         """``sync_workers > 0`` arms BSP clock gating for this table
         (SyncServer mode, selected by ``-sync=true`` exactly as the
         reference chooses its server subclass, src/server.cpp:224-231).
@@ -265,7 +285,8 @@ class PSService:
             if sparse_workers > 0:
                 self._sparse.setdefault(
                     table_id,
-                    _SparseShardState(sparse_workers, max(sparse_rows, 0)))
+                    _SparseShardState(sparse_workers, max(sparse_rows, 0),
+                                      mirror=sparse_mirror))
             self._tables[table_id] = (store, row_offset)
         # Wake the dispatcher so any requests parked on this table replay.
         try:
@@ -1537,7 +1558,8 @@ class DistributedMatrixTable(DistributedTableBase):
                                row_offset=self.row_offsets[rank],
                                sync_workers=self._sync_workers(),
                                sparse_workers=self._sparse_slots(),
-                               sparse_rows=local_rows)
+                               sparse_rows=local_rows,
+                               sparse_mirror=self._sparse_mirror())
         from multiverso_tpu.parallel.async_engine import _stageable
         self._init_staging(num_row, num_col,
                            _stageable(self.local_store.updater))
@@ -1549,6 +1571,11 @@ class DistributedMatrixTable(DistributedTableBase):
         """Per-worker staleness slots to arm on the serving shard; 0 =
         plain matrix table (DistributedSparseMatrixTable overrides)."""
         return 0
+
+    def _sparse_mirror(self) -> bool:
+        """Writer-freshness mode for the sparse bitmap (see
+        _SparseShardState); irrelevant when _sparse_slots() == 0."""
+        return True
 
     def _route(self, rows: np.ndarray) -> Dict[int, np.ndarray]:
         out: Dict[int, List[int]] = {}
@@ -1870,16 +1897,20 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
     def __init__(self, table_id: int, num_row: int, num_col: int,
                  service: PSService, peers: List[Tuple[str, int]],
                  rank: int, dtype=np.float32, updater: str = "default"):
-        # The incremental contract requires delta-add semantics: the server
-        # marks a writer's rows fresh on Add, which is only sound when the
-        # client can mirror the server's update (cache += delta). The
-        # reference's sparse table is likewise used with plain adds.
-        check(updater == "default",
-              "DistributedSparseMatrixTable requires the plain-add "
-              f"updater; got '{updater}'")
-        # Set BEFORE super().__init__: the parent's single register_shard
-        # consults _sparse_slots() (no register-then-overwrite window),
-        # and _send_add_rows touches the cache.
+        # Plain-add tables run in MIRROR mode (the client reproduces the
+        # server's update, so the writer always sees its own writes).
+        # Stateful updaters (sgd/ftrl — the client cannot reproduce the
+        # server-side step) fall back to the reference's exact loose
+        # semantics: the writer's bits are untouched on Add and its view
+        # is its last pull (_SparseShardState docstring). The decision is
+        # made in _sparse_mirror from the RESOLVED updater instance (not
+        # the name string — a typo'd name silently resolves to plain add
+        # in get_updater and must still mirror).
+        # Set placeholders BEFORE super().__init__: the parent's single
+        # register_shard consults _sparse_slots()/_sparse_mirror() (no
+        # register-then-overwrite window), and _send_add_rows touches the
+        # cache.
+        self._mirror = True
         self._incr_cache: Dict[int, np.ndarray] = {}
         self.last_incremental_rows = 0   # observability (tests/monitor)
         super().__init__(table_id, num_row, num_col, service, peers, rank,
@@ -1891,6 +1922,13 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
         universe (bitmap spans the REAL local rows — 0 on an empty
         shard)."""
         return self.world * self._n_local
+
+    def _sparse_mirror(self) -> bool:
+        """Mirror iff the RESOLVED updater is the plain adder (the only
+        update the client can reproduce exactly)."""
+        from multiverso_tpu.core.updater import Updater
+        self._mirror = type(self.local_store.updater) is Updater
+        return self._mirror
 
     def _cache_for(self, wid: int) -> np.ndarray:
         cache = self._incr_cache.get(wid)
@@ -1910,21 +1948,23 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
         cache here, client-side."""
         option = dataclasses.replace(
             option, worker_id=self._gid(option.worker_id))
-        np.add.at(self._cache_for(option.worker_id),
-                  np.asarray(rows, dtype=np.int64),
-                  np.asarray(deltas, dtype=np.float32))
+        if self._mirror:
+            np.add.at(self._cache_for(option.worker_id),
+                      np.asarray(rows, dtype=np.int64),
+                      np.asarray(deltas, dtype=np.float32))
         parts = []
         routed = self._route(rows)
         for s, ix in routed.items():
-            # clip=0.0: the freshness contract requires the server to
-            # apply EXACTLY the delta the client mirrored into its cache —
+            # Mirror mode packs clip=0.0: the freshness contract requires
+            # the server to apply EXACTLY the delta the client mirrored —
             # the lossy user clip threshold would diverge them silently.
             msg = Message(src=self.rank, type=MsgType.Request_Add,
                           table_id=self.table_id,
                           msg_id=self._next_msg_id(),
                           data=[rows[ix], _opt_to_array(option),
-                                *pack_payload(deltas[ix], _wire_mode(),
-                                              clip=0.0)])
+                                *pack_payload(
+                                    deltas[ix], _wire_mode(),
+                                    clip=0.0 if self._mirror else None)])
             parts.append((s, msg, self._request_or_retry(s, msg)))
         parts.extend(self._bsp_tick_parts(MsgType.Request_Add, routed,
                                           option=option))

@@ -274,3 +274,39 @@ def test_two_process_sparse_and_kv(tmp_path):
             pytest.fail("sparse worker timed out")
         assert p.returncode == 0, f"rank {r} failed:\n{err[-2000:]}"
         assert f"SPARSE_RANK{r}_OK" in out
+
+
+def test_sparse_sgd_reference_loose_semantics(two_rank_world):
+    """Stateful updaters (sgd) use the reference's exact UpdateAddState
+    semantics (sparse_matrix_table.cpp:199-223): the writer's own bits
+    are untouched on Add — its view is its last pull; other workers see
+    the server-side sgd step on their next incremental get."""
+    svc0, svc1, peers = two_rank_world
+    m0 = DistributedSparseMatrixTable(13, 10, 4, svc0, peers, rank=0,
+                                      updater="sgd")
+    m1 = DistributedSparseMatrixTable(13, 10, 4, svc1, peers, rank=1,
+                                      updater="sgd")
+    lr_opt = AddOption(worker_id=0, learning_rate=0.5)
+
+    got0 = m0.get(GetOption(worker_id=0))      # worker 0 pulls (all zero)
+    np.testing.assert_allclose(got0, 0.0)
+
+    # worker 0 adds a gradient of +1 on row 2: server does w -= lr*delta
+    m0.add_rows([2], np.ones((1, 4), dtype=np.float32), lr_opt)
+
+    # writer's own view: last pull (zeros) — reference loose semantics
+    got0 = m0.get(GetOption(worker_id=0))
+    assert m0.last_incremental_rows == 0
+    np.testing.assert_allclose(got0[2], 0.0)
+
+    # the OTHER worker's incremental get ships the sgd-updated row
+    # (sgd: data -= delta; the client pre-scales by lr, sgd_updater.h)
+    got1 = m1.get(GetOption(worker_id=0))      # gid 1 (rank 1)
+    np.testing.assert_allclose(got1[2], -1.0)
+
+    # worker 1 now writes row 2 -> worker 0's next get refreshes it
+    m1.add_rows([2], np.ones((1, 4), dtype=np.float32),
+                AddOption(worker_id=0, learning_rate=0.5))
+    got0 = m0.get(GetOption(worker_id=0))
+    assert m0.last_incremental_rows >= 1
+    np.testing.assert_allclose(got0[2], -2.0)
