@@ -159,11 +159,13 @@ class _SparseShardState:
             self.stale[:, local_rows] = True
             if 0 <= worker < self.stale.shape[0]:
                 self.stale[worker, local_rows] = False
-        else:       # ref-exact: invalidate others, leave the writer as-is
-            w = worker % self.stale.shape[0]
-            keep = self.stale[w, local_rows].copy()
+        elif 0 <= worker < self.stale.shape[0]:
+            # ref-exact: invalidate others, leave the writer as-is
+            keep = self.stale[worker, local_rows].copy()
             self.stale[:, local_rows] = True
-            self.stale[w, local_rows] = keep
+            self.stale[worker, local_rows] = keep
+        else:       # unattributable writer: everyone is stale
+            self.stale[:, local_rows] = True
 
     def take_stale(self, worker: int) -> np.ndarray:
         """Rows stale for ``worker``; marks them fresh (ref

@@ -39,26 +39,66 @@ class SparseMatrixTable(MatrixTable):
         self._stale = np.ones((slots, self.num_row), dtype=bool)
         self._caches: Dict[int, np.ndarray] = {}
         self._stale_lock = threading.Lock()
+        # Writer freshness on Add: plain-add tables MIRROR (the writer's
+        # delta lands in its own cache, so marking its rows fresh is
+        # sound and it always sees its own writes); stateful updaters use
+        # the reference's exact loose semantics (UpdateAddState,
+        # :199-223: only OTHER workers' bits are invalidated — the
+        # writer's view is its last pull). Decided from the RESOLVED
+        # updater instance, matching DistributedSparseMatrixTable. Tables
+        # with nonzero initialization cannot mirror either: the cache's
+        # implicit zeros would diverge from init+delta on never-pulled
+        # rows.
+        from multiverso_tpu.core.updater import Updater
+        self._mirror = (type(self.store.updater) is Updater
+                        and not getattr(option, "random_init", False))
+
+    def _cache_for(self, wid: int) -> np.ndarray:
+        cache = self._caches.get(wid)
+        if cache is None:
+            cache = self._caches[wid] = np.zeros(
+                (self.num_row, self.num_col), dtype=self.store.dtype)
+        return cache
+
+    def _on_write(self, wid: int, rows: Optional[np.ndarray],
+                  deltas: np.ndarray) -> None:
+        """Staleness + (mirror mode) cache bookkeeping for one Add;
+        ``rows=None`` means a dense whole-table write."""
+        with self._stale_lock:
+            in_range = 0 <= wid < self._slots
+            if self._mirror and in_range:
+                if rows is None:
+                    self._stale[:, :] = True
+                    self._stale[wid, :] = False
+                    self._cache_for(wid)[...] += deltas
+                else:
+                    self._stale[:, rows] = True
+                    self._stale[wid, rows] = False
+                    np.add.at(self._cache_for(wid), rows, deltas)
+            elif in_range:      # ref-exact: leave the writer's bits as-is
+                sel = slice(None) if rows is None else rows
+                keep = self._stale[wid, sel].copy()
+                self._stale[:, sel] = True
+                self._stale[wid, sel] = keep
+            else:               # unknown writer: everyone is stale
+                self._stale[:, slice(None) if rows is None else rows] = True
 
     # -- add: invalidate other workers' rows (ref :200-223) ----------------
     def add_rows_async(self, row_ids, deltas,
                        option: Optional[AddOption] = None) -> int:
         option = option or AddOption()
         msg_id = super().add_rows_async(row_ids, deltas, option)
-        rows = np.asarray(row_ids, dtype=np.int64)
-        with self._stale_lock:
-            self._stale[:, rows] = True
-            if 0 <= option.worker_id < self._slots:
-                self._stale[option.worker_id, rows] = False
+        self._on_write(option.worker_id,
+                       np.asarray(row_ids, dtype=np.int64),
+                       np.asarray(deltas, dtype=self.store.dtype))
         return msg_id
 
     def add_async(self, delta, option: Optional[AddOption] = None) -> int:
         option = option or AddOption()
         msg_id = super().add_async(delta, option)
-        with self._stale_lock:
-            self._stale[:, :] = True
-            if 0 <= option.worker_id < self._slots:
-                self._stale[option.worker_id, :] = False
+        self._on_write(option.worker_id, None,
+                       np.asarray(delta, dtype=self.store.dtype)
+                       .reshape(self.num_row, self.num_col))
         return msg_id
 
     # -- incremental get (ref UpdateGetState :226-258) ---------------------
@@ -96,10 +136,7 @@ class SparseMatrixTable(MatrixTable):
         if option is None:
             return super().get()
         wid = option.worker_id
-        cache = self._caches.get(wid)
-        if cache is None:
-            cache = self._caches[wid] = np.zeros(
-                (self.num_row, self.num_col), dtype=self.store.dtype)
+        cache = self._cache_for(wid)
         rows, values = self.get_stale(option)
         if len(rows):
             cache[rows] = values

@@ -4,7 +4,8 @@
 import numpy as np
 
 import multiverso_tpu as mv
-from multiverso_tpu.core.options import GetOption
+from multiverso_tpu.core.options import (AddOption, GetOption,
+                                         MatrixTableOption)
 
 
 def _make(mv, **kw):
@@ -80,3 +81,58 @@ def test_restore_marks_all_stale(tmp_path, mv_env):
     ckpt.load_table(t, uri)
     assert len(t.stale_rows(0)) == t.num_row        # everything re-pulls
     np.testing.assert_allclose(t.get(GetOption(worker_id=0)), full_before)
+
+
+def test_writer_sees_own_unpulled_write_plain_add(mv_env):
+    """r4 regression: an add to a never-pulled row must be visible in the
+    writer's own incremental get (mirror mode applies the delta to the
+    writer's cache; the old code marked the row fresh over a zero
+    cache)."""
+    t = mv.create_table(MatrixTableOption(8, 2, is_sparse=True,
+                                          name="own_write"))
+    t.add_rows([3], np.ones((1, 2), dtype=np.float32),
+               AddOption(worker_id=0))
+    got = t.get(GetOption(worker_id=0))
+    np.testing.assert_allclose(got[3], 1.0)
+    np.testing.assert_allclose(got[0], 0.0)
+
+
+def test_stateful_updater_uses_reference_loose_freshness(mv_env):
+    """sgd tables can't mirror; writer bits stay untouched on Add (ref
+    UpdateAddState :199-223): a never-pulled row stays stale and the next
+    get ships server truth; a previously-pulled row keeps the last-pull
+    view until another worker re-stales it."""
+    t = mv.create_table(MatrixTableOption(8, 2, is_sparse=True,
+                                          updater="sgd", name="sgd_loose"))
+    assert not t._mirror
+    # never pulled: own add leaves the row stale -> get ships the truth
+    t.add_rows([2], np.ones((1, 2), dtype=np.float32),
+               AddOption(worker_id=0))
+    got = t.get(GetOption(worker_id=0))
+    np.testing.assert_allclose(got[2], -1.0)     # sgd: data -= delta
+    # pulled now: own add is invisible (last-pull view) ...
+    t.add_rows([2], np.ones((1, 2), dtype=np.float32),
+               AddOption(worker_id=0))
+    got = t.get(GetOption(worker_id=0))
+    np.testing.assert_allclose(got[2], -1.0)
+    # ... until another worker writes the row
+    t.add_rows([2], np.ones((1, 2), dtype=np.float32),
+               AddOption(worker_id=1))
+    got = t.get(GetOption(worker_id=0))
+    np.testing.assert_allclose(got[2], -3.0)
+
+
+def test_random_init_table_does_not_mirror(mv_env):
+    """Mirror mode assumes zero-initialized rows: with random_init the
+    cache's implicit zeros would diverge from init+delta, so the table
+    falls back to loose freshness and the incremental get ships server
+    truth for the never-pulled written row."""
+    t = mv.create_table(MatrixTableOption(6, 2, is_sparse=True,
+                                          random_init=True, seed=5,
+                                          name="rand_sparse"))
+    assert not t._mirror
+    t.add_rows([3], np.ones((1, 2), dtype=np.float32),
+               AddOption(worker_id=0))
+    got = t.get(GetOption(worker_id=0))
+    truth = np.asarray(t.get_rows([3]))[0]
+    np.testing.assert_allclose(got[3], truth)    # init + delta, not delta
