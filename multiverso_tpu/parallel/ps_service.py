@@ -136,31 +136,22 @@ class _SparseShardState:
     per server process, here per PSService shard). All access is on the
     single dispatcher thread; no lock needed.
 
-    Two freshness modes for the WRITER's own rows on Add:
-
-    * ``mirror=True`` (plain-add tables): the client applies its own
-      delta to its cache, so the writer's rows are forced FRESH — the
-      writer always sees its own writes.
-    * ``mirror=False`` (stateful updaters — sgd/ftrl — where the client
-      cannot reproduce the server's update): the writer's bits are LEFT
-      UNCHANGED, exactly the reference's UpdateAddState (:199-223: only
-      ``id != worker_id`` rows are invalidated). The writer's view is
-      its last pull; its own add becomes visible when any worker's write
-      re-stales the row. Looser, but sound for any updater.
+    Add semantics are the reference's EXACT UpdateAddState (:199-223):
+    touched rows go stale for every worker EXCEPT the writer, whose bits
+    are LEFT UNCHANGED. Forcing the writer's rows fresh would be a race:
+    if another worker wrote the row after the writer's last pull, the
+    writer's cache is missing that delta and only a re-pull can fix it —
+    an own-write must not mask it. Plain-add clients additionally mirror
+    their own delta into their cache (so rows that WERE fresh stay both
+    fresh and correct); for stale rows and stateful updaters the next
+    pull ships server truth, own delta included.
     """
 
-    def __init__(self, num_workers: int, num_rows: int,
-                 mirror: bool = True):
+    def __init__(self, num_workers: int, num_rows: int):
         self.stale = np.ones((num_workers, num_rows), dtype=bool)
-        self.mirror = mirror
 
     def on_add(self, local_rows: np.ndarray, worker: int) -> None:
-        if self.mirror:
-            self.stale[:, local_rows] = True
-            if 0 <= worker < self.stale.shape[0]:
-                self.stale[worker, local_rows] = False
-        elif 0 <= worker < self.stale.shape[0]:
-            # ref-exact: invalidate others, leave the writer as-is
+        if 0 <= worker < self.stale.shape[0]:
             keep = self.stale[worker, local_rows].copy()
             self.stale[:, local_rows] = True
             self.stale[worker, local_rows] = keep
@@ -270,8 +261,7 @@ class PSService:
     def register_shard(self, table_id: int, store: ServerStore,
                        row_offset: int = 0, sync_workers: int = 0,
                        sparse_workers: int = 0,
-                       sparse_rows: int = 0,
-                       sparse_mirror: bool = True) -> None:
+                       sparse_rows: int = 0) -> None:
         """``sync_workers > 0`` arms BSP clock gating for this table
         (SyncServer mode, selected by ``-sync=true`` exactly as the
         reference chooses its server subclass, src/server.cpp:224-231).
@@ -287,8 +277,7 @@ class PSService:
             if sparse_workers > 0:
                 self._sparse.setdefault(
                     table_id,
-                    _SparseShardState(sparse_workers, max(sparse_rows, 0),
-                                      mirror=sparse_mirror))
+                    _SparseShardState(sparse_workers, max(sparse_rows, 0)))
             self._tables[table_id] = (store, row_offset)
         # Wake the dispatcher so any requests parked on this table replay.
         try:
@@ -1560,8 +1549,7 @@ class DistributedMatrixTable(DistributedTableBase):
                                row_offset=self.row_offsets[rank],
                                sync_workers=self._sync_workers(),
                                sparse_workers=self._sparse_slots(),
-                               sparse_rows=local_rows,
-                               sparse_mirror=self._sparse_mirror())
+                               sparse_rows=local_rows)
         from multiverso_tpu.parallel.async_engine import _stageable
         self._init_staging(num_row, num_col,
                            _stageable(self.local_store.updater))
@@ -1573,11 +1561,6 @@ class DistributedMatrixTable(DistributedTableBase):
         """Per-worker staleness slots to arm on the serving shard; 0 =
         plain matrix table (DistributedSparseMatrixTable overrides)."""
         return 0
-
-    def _sparse_mirror(self) -> bool:
-        """Writer-freshness mode for the sparse bitmap (see
-        _SparseShardState); irrelevant when _sparse_slots() == 0."""
-        return True
 
     def _route(self, rows: np.ndarray) -> Dict[int, np.ndarray]:
         out: Dict[int, List[int]] = {}
@@ -1899,38 +1882,30 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
     def __init__(self, table_id: int, num_row: int, num_col: int,
                  service: PSService, peers: List[Tuple[str, int]],
                  rank: int, dtype=np.float32, updater: str = "default"):
-        # Plain-add tables run in MIRROR mode (the client reproduces the
-        # server's update, so the writer always sees its own writes).
-        # Stateful updaters (sgd/ftrl — the client cannot reproduce the
-        # server-side step) fall back to the reference's exact loose
-        # semantics: the writer's bits are untouched on Add and its view
-        # is its last pull (_SparseShardState docstring). The decision is
-        # made in _sparse_mirror from the RESOLVED updater instance (not
-        # the name string — a typo'd name silently resolves to plain add
-        # in get_updater and must still mirror).
-        # Set placeholders BEFORE super().__init__: the parent's single
-        # register_shard consults _sparse_slots()/_sparse_mirror() (no
-        # register-then-overwrite window), and _send_add_rows touches the
-        # cache.
-        self._mirror = True
+        # Bitmap semantics are always the reference's loose UpdateAddState
+        # (_SparseShardState docstring). Plain-add clients ADDITIONALLY
+        # mirror their own delta into their cache so rows that were fresh
+        # stay both fresh and correct; stateful updaters (sgd/ftrl — the
+        # client cannot reproduce the server-side step) skip the mirror
+        # and see own writes on the next pull of a stale row. Decided
+        # from the RESOLVED updater instance after super().__init__ (a
+        # typo'd name silently resolves to plain add in get_updater and
+        # must still mirror). Placeholders set BEFORE super() because the
+        # parent's register_shard path runs during it.
+        self._mirror = False
         self._incr_cache: Dict[int, np.ndarray] = {}
         self.last_incremental_rows = 0   # observability (tests/monitor)
         super().__init__(table_id, num_row, num_col, service, peers, rank,
                          dtype=dtype, updater=updater)
         self.name = f"dist_sparse_matrix_{table_id}"
+        from multiverso_tpu.core.updater import Updater
+        self._mirror = type(self.local_store.updater) is Updater
 
     def _sparse_slots(self) -> int:
         """Arm the serving shard's staleness bitmap for the DCN worker
         universe (bitmap spans the REAL local rows — 0 on an empty
         shard)."""
         return self.world * self._n_local
-
-    def _sparse_mirror(self) -> bool:
-        """Mirror iff the RESOLVED updater is the plain adder (the only
-        update the client can reproduce exactly)."""
-        from multiverso_tpu.core.updater import Updater
-        self._mirror = type(self.local_store.updater) is Updater
-        return self._mirror
 
     def _cache_for(self, wid: int) -> np.ndarray:
         cache = self._incr_cache.get(wid)
@@ -1944,10 +1919,10 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
         """Adds must reach the staleness bitmap even for this rank's own
         shard, so the LocalForward shortcut is disabled: route EVERYTHING
         through the service dispatch (still in-process for the local
-        shard, one loopback hop). The server marks the touched rows FRESH
-        for the writer (ref :200-223), which assumes the writer's cache is
-        current — so the delta is applied to this worker's own incremental
-        cache here, client-side."""
+        shard, one loopback hop). The server leaves the writer's own bits
+        UNCHANGED (loose UpdateAddState, ref :199-223); plain-add clients
+        mirror the delta into their cache here so rows that were fresh
+        stay both fresh and correct."""
         option = dataclasses.replace(
             option, worker_id=self._gid(option.worker_id))
         if self._mirror:
@@ -1977,12 +1952,13 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
         stale for this worker; fresh rows come from the local cache.
 
         Async mode holds ``_op_lock`` through the wait: a concurrent
-        ``add_rows`` mutates the same cache and marks its rows fresh
-        server-side, so a stale-get reply raced past it would overwrite
-        the cache with pre-add values that no future get re-pulls. BSP
-        waits outside the lock (the clock gates already enforce per-worker
-        program order, and a gated wait under the lock could deadlock
-        against another local worker's add on the same handle)."""
+        ``add_rows`` mutates the same cache (the plain-add mirror), so a
+        stale-get reply applied out of order with it could leave the
+        cache holding pre-add values for a row whose fresh bit the mirror
+        relies on. BSP waits outside the lock (the clock gates already
+        enforce per-worker program order, and a gated wait under the lock
+        could deadlock against another local worker's add on the same
+        handle)."""
         with self._op_lock:
             self.flush()
             wid = self._gid(option.worker_id if option is not None else 0)

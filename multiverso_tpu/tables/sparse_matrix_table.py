@@ -39,19 +39,18 @@ class SparseMatrixTable(MatrixTable):
         self._stale = np.ones((slots, self.num_row), dtype=bool)
         self._caches: Dict[int, np.ndarray] = {}
         self._stale_lock = threading.Lock()
-        # Writer freshness on Add: plain-add tables MIRROR (the writer's
-        # delta lands in its own cache, so marking its rows fresh is
-        # sound and it always sees its own writes); stateful updaters use
-        # the reference's exact loose semantics (UpdateAddState,
-        # :199-223: only OTHER workers' bits are invalidated — the
-        # writer's view is its last pull). Decided from the RESOLVED
-        # updater instance, matching DistributedSparseMatrixTable. Tables
-        # with nonzero initialization cannot mirror either: the cache's
-        # implicit zeros would diverge from init+delta on never-pulled
-        # rows.
+        # Bitmap semantics are ALWAYS the reference's loose UpdateAddState
+        # (:199-223): touched rows go stale for every worker except the
+        # writer, whose bits are left unchanged — forcing them fresh
+        # would mask another worker's intervening write (and, with
+        # random_init, never-pulled rows' init values). Plain-add tables
+        # ADDITIONALLY mirror the writer's delta into its cache so rows
+        # that were fresh stay both fresh and correct; stateful updaters
+        # skip the mirror (stale rows re-pull server truth either way).
+        # Decided from the RESOLVED updater instance, matching
+        # DistributedSparseMatrixTable.
         from multiverso_tpu.core.updater import Updater
-        self._mirror = (type(self.store.updater) is Updater
-                        and not getattr(option, "random_init", False))
+        self._mirror = type(self.store.updater) is Updater
 
     def _cache_for(self, wid: int) -> np.ndarray:
         cache = self._caches.get(wid)
@@ -62,26 +61,24 @@ class SparseMatrixTable(MatrixTable):
 
     def _on_write(self, wid: int, rows: Optional[np.ndarray],
                   deltas: np.ndarray) -> None:
-        """Staleness + (mirror mode) cache bookkeeping for one Add;
-        ``rows=None`` means a dense whole-table write."""
+        """Staleness + (plain-add) cache bookkeeping for one Add;
+        ``rows=None`` means a dense whole-table write. Bits follow the
+        loose reference rule for EVERY updater (see __init__)."""
+        sel = slice(None) if rows is None else rows
         with self._stale_lock:
-            in_range = 0 <= wid < self._slots
-            if self._mirror and in_range:
-                if rows is None:
-                    self._stale[:, :] = True
-                    self._stale[wid, :] = False
-                    self._cache_for(wid)[...] += deltas
-                else:
-                    self._stale[:, rows] = True
-                    self._stale[wid, rows] = False
-                    np.add.at(self._cache_for(wid), rows, deltas)
-            elif in_range:      # ref-exact: leave the writer's bits as-is
-                sel = slice(None) if rows is None else rows
+            if 0 <= wid < self._slots:
                 keep = self._stale[wid, sel].copy()
                 self._stale[:, sel] = True
                 self._stale[wid, sel] = keep
+                if self._mirror:
+                    # Fresh rows stay correct; stale rows' cache entries
+                    # are garbage either way (overwritten on next pull).
+                    if rows is None:
+                        self._cache_for(wid)[...] += deltas
+                    else:
+                        np.add.at(self._cache_for(wid), rows, deltas)
             else:               # unknown writer: everyone is stale
-                self._stale[:, slice(None) if rows is None else rows] = True
+                self._stale[:, sel] = True
 
     # -- add: invalidate other workers' rows (ref :200-223) ----------------
     def add_rows_async(self, row_ids, deltas,

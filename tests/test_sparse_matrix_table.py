@@ -122,15 +122,13 @@ def test_stateful_updater_uses_reference_loose_freshness(mv_env):
     np.testing.assert_allclose(got[2], -3.0)
 
 
-def test_random_init_table_does_not_mirror(mv_env):
-    """Mirror mode assumes zero-initialized rows: with random_init the
-    cache's implicit zeros would diverge from init+delta, so the table
-    falls back to loose freshness and the incremental get ships server
-    truth for the never-pulled written row."""
+def test_random_init_unpulled_write_ships_truth(mv_env):
+    """random_init + never-pulled row: loose bits keep the row stale, so
+    the incremental get ships SERVER truth (init + delta) — the mirror
+    never masks initialization the cache has not seen."""
     t = mv.create_table(MatrixTableOption(6, 2, is_sparse=True,
                                           random_init=True, seed=5,
                                           name="rand_sparse"))
-    assert not t._mirror
     t.add_rows([3], np.ones((1, 2), dtype=np.float32),
                AddOption(worker_id=0))
     got = t.get(GetOption(worker_id=0))
