@@ -1,0 +1,27 @@
+"""Shared demo helper: probe the attached accelerator, fall back to CPU."""
+
+import os
+import subprocess
+import sys
+
+
+def pin_backend(probe_timeout: float = 60) -> None:
+    """Use the attached accelerator when it answers quickly; otherwise pin
+    CPU so demos run anywhere (the tunneled chip can be down). Skips the
+    probe subprocess entirely when the environment already pins CPU."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return
+    try:
+        ok = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "jax.jit(lambda: jnp.ones(4).sum())()"],
+            capture_output=True, timeout=probe_timeout).returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        print("(accelerator unreachable -- running on CPU)")

@@ -13,7 +13,10 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+
 def main() -> int:
+    from examples._backend import pin_backend
+    pin_backend()
     import multiverso_tpu as mv
     from multiverso_tpu.core import checkpoint as ckpt
     from multiverso_tpu.parallel.async_engine import (AsyncTableEngine,

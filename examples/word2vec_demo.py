@@ -15,6 +15,7 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+
 def make_corpus(path: str, n_sentences: int = 2000) -> None:
     """Three word 'topics' with distinct co-occurrence patterns."""
     rng = np.random.default_rng(0)
@@ -31,6 +32,8 @@ def make_corpus(path: str, n_sentences: int = 2000) -> None:
 
 
 def main() -> int:
+    from examples._backend import pin_backend
+    pin_backend()
     import multiverso_tpu as mv
     from multiverso_tpu.models.word2vec import (Dictionary, Word2Vec,
                                                 Word2VecConfig, read_corpus)

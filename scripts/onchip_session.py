@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""One-shot on-chip data capture — run this the moment a tunnel window
+opens. Tunnel windows are scarce (rounds 1-4 all hit outages at driver
+bench time), so this script collects EVERY pending measurement in one
+pass, each phase in its own subprocess with a timeout (a mid-phase
+tunnel flap loses that phase, not the session), appending everything to
+ONCHIP_RESULTS.txt:
+
+  1. attribution  — scripts/perf_attrib.py (the ~20x in-loop scatter
+                    de-opt: which formulation pays; decides the fused-
+                    path fix, VERDICT r3 #1)
+  2. pallas       — XLA vs per-row-DMA vs tiled scatter at bench shape
+                    (decides which kernel survives, VERDICT r3 #9)
+  3. dispatch     — launch-latency probe (validates the chunk_dispatch
+                    AUTO threshold for this link)
+  4. bench        — the full bench.py headline (words/sec + roofline)
+
+Usage:  python scripts/onchip_session.py [--skip bench] [--quick]
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+OUT = os.path.join(REPO, "ONCHIP_RESULTS.txt")
+
+
+def log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    with open(OUT, "a") as f:
+        f.write(line + "\n")
+
+
+def run_phase(name: str, cmd, timeout: float) -> bool:
+    log(f"=== phase {name}: {' '.join(cmd)}")
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired as e:
+        log(f"phase {name} TIMED OUT after {timeout:.0f}s")
+        for blob in (e.stdout, e.stderr):
+            if blob:
+                text = blob if isinstance(blob, str) else blob.decode(
+                    errors="replace")
+                with open(OUT, "a") as f:
+                    f.write(text[-4000:] + "\n")
+        return False
+    dt = time.time() - t0
+    with open(OUT, "a") as f:
+        f.write(proc.stdout[-8000:] + "\n")
+        if proc.returncode != 0:
+            f.write("STDERR:\n" + proc.stderr[-4000:] + "\n")
+    log(f"phase {name} rc={proc.returncode} in {dt:.0f}s")
+    return proc.returncode == 0
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--skip", action="append", default=[])
+    p.add_argument("--quick", action="store_true",
+                   help="smaller attribution shapes (short windows)")
+    args = p.parse_args()
+
+    with open(OUT, "a") as f:
+        f.write(f"\n{'=' * 70}\n# on-chip session "
+                f"{time.strftime('%Y-%m-%d %H:%M:%S UTC', time.gmtime())}"
+                f"\n{'=' * 70}\n")
+
+    # Cheap liveness gate first: don't burn phase timeouts on a dead link.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "print(jax.devices());"
+             "print(float(jax.jit(lambda: jnp.ones(8).sum())()))"],
+            capture_output=True, text=True, timeout=150)
+    except subprocess.TimeoutExpired:
+        log("tunnel probe TIMED OUT — aborting session (tunnel down)")
+        sys.exit(1)
+    if probe.returncode != 0:
+        log("tunnel probe FAILED — aborting session")
+        log(probe.stderr[-500:])
+        sys.exit(1)
+    log("tunnel live: " + probe.stdout.strip().replace("\n", " | "))
+
+    py = sys.executable
+    if "dispatch" not in args.skip:
+        run_phase("dispatch", [py, "-c", (
+            "import sys; sys.path.insert(0, '.');"
+            "from multiverso_tpu.models.word2vec.model import "
+            "measured_dispatch_latency_ms;"
+            "print('dispatch_latency_ms=',"
+            "measured_dispatch_latency_ms(15))")], 300)
+    if "attribution" not in args.skip:
+        attrib = [py, os.path.join(HERE, "perf_attrib.py")]
+        if args.quick:
+            attrib += ["--chunks", "8", "--iters", "3"]
+        run_phase("attribution", attrib, 900)
+    if "pallas" not in args.skip:
+        run_phase("pallas", [py, "-c", (
+            "import sys; sys.path.insert(0, '.');"
+            "import bench; bench.bench_pallas_rows()")], 600)
+    if "bench" not in args.skip:
+        run_phase("bench", [py, os.path.join(REPO, "bench.py")], 2400)
+    log("session complete — results in ONCHIP_RESULTS.txt")
+
+
+if __name__ == "__main__":
+    main()
