@@ -15,6 +15,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -231,3 +232,56 @@ def test_two_process_two_thread_sync(tmp_path):
             pytest.fail("sync worker timed out")
         assert p.returncode == 0, f"rank {r} failed:\n{err[-2000:]}"
         assert f"SYNC_RANK{r}_OK" in out
+
+
+def test_bsp_fuzz_identical_views_with_jitter(sync_two_rank_world):
+    """Fuzz the clock-gated dispatch: 2 ranks x 2 local workers with
+    random per-round deltas and random timing jitter. The BSP invariant
+    must hold regardless of interleaving: every worker's i-th Get is
+    IDENTICAL across all four workers, and equals the sum of all
+    workers' first i rounds of deltas."""
+    import random
+
+    svc0, svc1, peers = sync_two_rank_world
+    size, rounds = 16, 6
+    t0 = DistributedArrayTable(40, size, svc0, peers, rank=0)
+    t1 = DistributedArrayTable(40, size, svc1, peers, rank=1)
+
+    # delta[w][i]: deterministic per (worker, round) so the closed form
+    # is computable; values differ per worker/round.
+    def delta(w, i):
+        return np.full(size, float((w + 1) * 100 + i), dtype=np.float32)
+
+    views = {w: [] for w in range(4)}
+    errors = []
+
+    def worker(table, lw, gid, seed):
+        rng = random.Random(seed)
+        try:
+            for i in range(rounds):
+                time.sleep(rng.random() * 0.02)
+                table.add(delta(gid, i), AddOption(worker_id=lw))
+                time.sleep(rng.random() * 0.02)
+                views[gid].append(table.get(GetOption(worker_id=lw)))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker,
+                                args=(tbl, lw, r * 2 + lw, 31 + r * 2 + lw))
+               for r, tbl in ((0, t0), (1, t1)) for lw in (0, 1)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=180)
+        assert not th.is_alive(), "BSP fuzz worker wedged"
+    assert not errors, errors
+
+    for i in range(rounds):
+        expect = np.zeros(size, dtype=np.float32)
+        for w in range(4):
+            for j in range(i + 1):
+                expect += delta(w, j)
+        for w in range(4):
+            np.testing.assert_allclose(
+                views[w][i], expect,
+                err_msg=f"worker {w} round {i} diverged")
