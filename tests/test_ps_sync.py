@@ -239,7 +239,7 @@ def test_bsp_fuzz_identical_views_with_jitter(sync_two_rank_world):
     random per-round deltas and random timing jitter. The BSP invariant
     must hold regardless of interleaving: every worker's i-th Get is
     IDENTICAL across all four workers, and equals the sum of all
-    workers' first i rounds of deltas."""
+    workers' rounds 0..i of deltas."""
     import random
 
     svc0, svc1, peers = sync_two_rank_world
