@@ -38,8 +38,10 @@ from multiverso_tpu.models.word2vec.model import (Word2VecConfig,
                                                   raw_cbow_ns_step,
                                                   raw_sg_hs_step,
                                                   raw_sg_ns_step)
+from multiverso_tpu.core.options import GetOption
 from multiverso_tpu.parallel.ps_service import (DistributedKVTable,
                                                 DistributedMatrixTable,
+                                                DistributedSparseMatrixTable,
                                                 PSService)
 from multiverso_tpu.utils.log import check, log
 
@@ -58,7 +60,8 @@ class DistributedWord2Vec:
 
     def __init__(self, cfg: Word2VecConfig, dictionary: Dictionary,
                  service: PSService, peers: List[Tuple[str, int]],
-                 rank: int, num_workers: Optional[int] = None):
+                 rank: int, num_workers: Optional[int] = None,
+                 sparse_tables: bool = False):
         check(cfg.param_dtype == "float32",
               "distributed mode stores float32 tables; param_dtype="
               f"'{cfg.param_dtype}' is not supported here yet")
@@ -69,10 +72,18 @@ class DistributedWord2Vec:
         self._adagrad = cfg.optimizer == "adagrad"
         V, D = len(dictionary), cfg.embedding_size
         out_rows = max((V - 1) if cfg.hs else V, 1)  # HS: inner nodes
-        self.w_in = DistributedMatrixTable(self.TABLE_IN, V, D, service,
-                                           peers, rank)
-        self.w_out = DistributedMatrixTable(self.TABLE_OUT, out_rows, D,
-                                            service, peers, rank)
+        # sparse_tables=True: row pulls become INCREMENTAL — only rows
+        # written since this worker's last pull cross the wire (keyed
+        # UpdateGetState); frequent words, re-pulled every block, serve
+        # from the worker cache. Cost: a [rows, D] host cache per table
+        # per worker — the reference sparse table's exact trade
+        # (``-sparse=true`` there).
+        Table = (DistributedSparseMatrixTable if sparse_tables
+                 else DistributedMatrixTable)
+        self._pull_opt = GetOption(worker_id=0) if sparse_tables else None
+        self.w_in = Table(self.TABLE_IN, V, D, service, peers, rank)
+        self.w_out = Table(self.TABLE_OUT, out_rows, D, service, peers,
+                           rank)
         # AdaGrad accumulators as their own PS tables — the reference's two
         # adagrad gradient matrices (communicator.cpp:17-32). Workers pull
         # rows, accumulate locally, and push back the delta scaled by
@@ -80,10 +91,9 @@ class DistributedWord2Vec:
         # table's delta (GetDeltaLoop, communicator.cpp:167).
         self.g_in = self.g_out = None
         if self._adagrad:
-            self.g_in = DistributedMatrixTable(self.TABLE_G_IN, V, D,
-                                               service, peers, rank)
-            self.g_out = DistributedMatrixTable(self.TABLE_G_OUT, out_rows,
-                                                D, service, peers, rank)
+            self.g_in = Table(self.TABLE_G_IN, V, D, service, peers, rank)
+            self.g_out = Table(self.TABLE_G_OUT, out_rows, D, service,
+                               peers, rank)
         # Global word-count table: every worker pushes its per-block word
         # count and the lr schedule decays on the GLOBAL sum — the
         # reference's word-count KV table + lr thread
@@ -223,13 +233,14 @@ class DistributedWord2Vec:
         if not batches:
             return 0
         ids_in, ids_out, group = self._collect_and_remap(batches)
-        # Pull (RequestParameter analog).
-        local_in = self.w_in.get_rows(ids_in)
-        local_out = self.w_out.get_rows(ids_out)
+        # Pull (RequestParameter analog); with sparse tables the pull is
+        # incremental — only rows re-staled since the last block ship.
+        local_in = self.w_in.get_rows(ids_in, self._pull_opt)
+        local_out = self.w_out.get_rows(ids_out, self._pull_opt)
         old_in, old_out = local_in.copy(), local_out.copy()
         if self._adagrad:
-            local_gin = self.g_in.get_rows(ids_in)
-            local_gout = self.g_out.get_rows(ids_out)
+            local_gin = self.g_in.get_rows(ids_in, self._pull_opt)
+            local_gout = self.g_out.get_rows(ids_out, self._pull_opt)
             old_gin, old_gout = local_gin.copy(), local_gout.copy()
         else:
             local_gin = jnp.zeros_like(local_in)

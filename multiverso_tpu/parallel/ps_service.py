@@ -129,6 +129,12 @@ TICK_GET_KEY = -2
 # rides in the GetOption blob (msg.data[1]).
 STALE_GET_KEY = -3
 
+# Keyed variant: data = [[-4], [wid], keys] — serve only the STALE subset
+# of the requested rows and mark those fresh (the reference's keyed
+# UpdateGetState branch, :244-253). Reply carries the served rows' GLOBAL
+# ids so the client knows which of its cached rows were refreshed.
+STALE_ROWS_GET_KEY = -4
+
 
 class _SparseShardState:
     """Per-worker staleness bitmap for one sparse table shard (ref
@@ -165,6 +171,16 @@ class _SparseShardState:
         rows = np.flatnonzero(self.stale[w]).astype(np.int32)
         self.stale[w, rows] = False
         return rows
+
+    def take_stale_among(self, worker: int,
+                         local_rows: np.ndarray) -> np.ndarray:
+        """The STALE subset of ``local_rows`` for ``worker``; marks those
+        fresh (the reference's keyed UpdateGetState branch, :244-253)."""
+        w = worker % self.stale.shape[0]
+        local_rows = np.asarray(local_rows, dtype=np.int64)
+        stale = local_rows[self.stale[w, local_rows]]
+        self.stale[w, stale] = False
+        return stale.astype(np.int32)
 
 
 class PSService:
@@ -575,8 +591,9 @@ class PSService:
         # rows back and silently lose those values). Byte-bounded — Get
         # replies carry row payloads.
         stale_get = (msg.type == MsgType.Request_Get and msg.data
-                     and msg.data[0].size == 1
-                     and int(msg.data[0][0]) == STALE_GET_KEY)
+                     and msg.data[0].size >= 1
+                     and int(msg.data[0][0]) in (STALE_GET_KEY,
+                                                 STALE_ROWS_GET_KEY))
         if msg.type == MsgType.Request_Add or stale_get or \
                 (gate is not None and msg.type == MsgType.Request_Get):
             per = self._applied.setdefault(msg.src,
@@ -651,6 +668,25 @@ class PSService:
                 reply.data = pack_payload(np.empty(0, np.float32), "none")
                 return reply
             mode = _wire_mode()
+            if keys.size >= 1 and int(keys[0]) == STALE_ROWS_GET_KEY:
+                # Keyed incremental Get: only the stale subset of the
+                # requested rows crosses the wire (ref keyed
+                # UpdateGetState, :244-253). data = [[-4], [wid], keys].
+                st = self._sparse.get(msg.table_id)
+                wid = int(msg.data[1][0]) if len(msg.data) > 1 \
+                    and msg.data[1].size else 0
+                check(st is not None,
+                      f"table {msg.table_id} is not sparse-tracked")
+                req = msg.data[2].astype(np.int64) - row_offset
+                with monitor("PS_SERVICE_GET"):
+                    rows = st.take_stale_among(wid, req)
+                    values = np.asarray(store.read_rows(rows))
+                reply = msg.create_reply()
+                reply.data = [rows + np.int32(row_offset),
+                              *pack_payload(values,
+                                            "sparse" if mode != "none"
+                                            else "none", clip=0.0)]
+                return reply
             if keys.size == 1 and int(keys[0]) == STALE_GET_KEY:
                 # Incremental whole-table Get: exactly the rows stale for
                 # this worker cross the wire (ref UpdateGetState), tagged
@@ -1947,9 +1983,13 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
                                           option=option))
         return _PendingOp(parts, retrier=self._retry_request)
 
-    def get(self, option: "Optional[GetOption]" = None) -> np.ndarray:
-        """Incremental whole-table get: each shard returns only the rows
-        stale for this worker; fresh rows come from the local cache.
+    def _run_incremental(self, option: "Optional[GetOption]",
+                         build_parts, result_fn) -> np.ndarray:
+        """Shared scaffold for the two incremental-get entry points:
+        flush, resolve the worker cache, fire ``build_parts(wid, cache)``
+        (returning ``(parts, n_data)`` — data parts FIRST, BSP ticks
+        after), scatter the served rows into the cache, and hand the
+        cache to ``result_fn``.
 
         Async mode holds ``_op_lock`` through the wait: a concurrent
         ``add_rows`` mutates the same cache (the plain-add mirror), so a
@@ -1963,6 +2003,36 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
             self.flush()
             wid = self._gid(option.worker_id if option is not None else 0)
             cache = self._cache_for(wid)
+            parts, n_data = build_parts(wid)
+
+            def assemble(replies: List[Message]) -> np.ndarray:
+                pulled = 0
+                for reply in replies[:n_data]:
+                    rows = reply.data[0]
+                    if rows.size:
+                        cache[rows] = unpack_payload(reply.data[1:])
+                    pulled += int(rows.size)
+                self.last_incremental_rows = pulled
+                return result_fn(cache)
+
+            op = _PendingOp(parts, assemble, retrier=self._retry_request)
+            if not self._bsp:
+                return op.wait(self._op_timeout)
+        return op.wait(self._op_timeout)
+
+    def get(self, option: "Optional[GetOption]" = None) -> np.ndarray:
+        """Incremental whole-table get: each shard returns only the rows
+        stale for this worker; the rest come from the local cache.
+
+        View semantics per updater (see ``_SparseShardState``): plain-add
+        tables mirror, so the view is fully current INCLUDING this
+        worker's own writes; stateful updaters (sgd/ftrl) follow the
+        reference's loose contract — the view is this worker's LAST PULL
+        of each fresh row, and its own writes to fresh rows surface only
+        once any worker re-stales them (the reference's exact
+        UpdateAddState/UpdateGetState behavior)."""
+
+        def build(wid):
             parts = []
             for s in range(self.world):
                 msg = Message(src=self.rank, type=MsgType.Request_Get,
@@ -1971,21 +2041,46 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
                               data=[np.asarray([STALE_GET_KEY], np.int32),
                                     np.asarray([wid], np.int32)])
                 parts.append((s, msg, self._request_or_retry(s, msg)))
+            return parts, len(parts)
 
-            def assemble(replies: List[Message]) -> np.ndarray:
-                pulled = 0
-                for reply in replies:
-                    rows = reply.data[0]
-                    if rows.size:
-                        cache[rows] = unpack_payload(reply.data[1:])
-                    pulled += int(rows.size)
-                self.last_incremental_rows = pulled
-                return cache.copy()
+        return self._run_incremental(option, build,
+                                     lambda cache: cache.copy())
 
-            op = _PendingOp(parts, assemble, retrier=self._retry_request)
-            if not self._bsp:
-                return op.wait(self._op_timeout)
-        return op.wait(self._op_timeout)
+    def get_rows(self, row_ids,
+                 option: "Optional[GetOption]" = None) -> np.ndarray:
+        """Keyed get. With a GetOption it is INCREMENTAL (the reference's
+        keyed UpdateGetState, :244-253): only the stale subset of the
+        requested rows crosses the wire; the rest come from this worker's
+        cache — the pull shape of the distributed w2v cycle, where row
+        sets overlap heavily across blocks. View semantics per updater
+        are as :meth:`get` documents (stateful updaters: own writes to
+        fresh rows surface on re-stale, the reference's loose contract).
+        Without an option it is the plain non-incremental pull
+        (staleness untouched, always server truth)."""
+        if option is None:
+            return super().get_rows(row_ids)
+        req = np.asarray(row_ids, dtype=np.int32)
+        uniq = np.unique(req)
+
+        def build(wid):
+            parts = []
+            routed = self._route(uniq)
+            for s, ix in routed.items():
+                msg = Message(src=self.rank, type=MsgType.Request_Get,
+                              table_id=self.table_id,
+                              msg_id=self._next_msg_id(),
+                              data=[np.asarray([STALE_ROWS_GET_KEY],
+                                               np.int32),
+                                    np.asarray([wid], np.int32),
+                                    uniq[ix]])
+                parts.append((s, msg, self._request_or_retry(s, msg)))
+            n_data = len(parts)
+            parts.extend(self._bsp_tick_parts(MsgType.Request_Get, routed,
+                                              get_option=option))
+            return parts, n_data
+
+        return self._run_incremental(option, build,
+                                     lambda cache: cache[req])
 
     def load_state(self, payload: Dict[str, np.ndarray]) -> None:
         super().load_state(payload)

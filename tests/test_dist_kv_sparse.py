@@ -180,6 +180,42 @@ def test_bsp_sparse_row_routed_does_not_wedge(sync_two_rank_world):
     assert not errors, errors
 
 
+def test_sparse_keyed_incremental_get(two_rank_world):
+    """Keyed gets are ALSO incremental (ref keyed UpdateGetState,
+    :244-253): only the stale subset of the requested rows crosses the
+    wire; fresh rows serve from the worker's cache; request order and
+    duplicates are honored."""
+    svc0, svc1, peers = two_rank_world
+    m0 = DistributedSparseMatrixTable(30, 20, 4, svc0, peers, rank=0)
+    m1 = DistributedSparseMatrixTable(30, 20, 4, svc1, peers, rank=1)
+    m0.add_rows(np.arange(10, dtype=np.int32),
+                np.arange(10, dtype=np.float32)[:, None]
+                .repeat(4, 1), AddOption(worker_id=0))
+
+    opt = GetOption(worker_id=0)
+    got = m1.get_rows([2, 7, 2, 15], opt)       # 15 never written: zeros
+    assert m1.last_incremental_rows == 3        # {2, 7, 15} stale
+    np.testing.assert_allclose(got[0], 2.0)
+    np.testing.assert_allclose(got[1], 7.0)
+    np.testing.assert_allclose(got[2], 2.0)     # duplicate honored
+    np.testing.assert_allclose(got[3], 0.0)
+
+    got = m1.get_rows([2, 7], opt)              # all fresh: cache only
+    assert m1.last_incremental_rows == 0
+    np.testing.assert_allclose(got[0], 2.0)
+
+    m0.add_rows([7], np.full((1, 4), 100.0, np.float32),
+                AddOption(worker_id=0))
+    got = m1.get_rows([2, 7], opt)              # exactly the re-staled row
+    assert m1.last_incremental_rows == 1
+    np.testing.assert_allclose(got[1], 107.0)
+    np.testing.assert_allclose(got[0], 2.0)
+
+    # optionless keyed get stays plain (ships everything, marks nothing)
+    got = m1.get_rows([2, 7])
+    np.testing.assert_allclose(got[1], 107.0)
+
+
 _SPARSE_WORKER = r"""
 import os, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"

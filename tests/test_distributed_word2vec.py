@@ -186,3 +186,64 @@ def test_global_lr_schedule_matches_single_rank(mv_env):
     finally:
         svc0.close()
         svc1.close()
+
+
+def test_two_rank_sparse_tables_train_and_save_wire(mv_env):
+    """sparse_tables=True: pulls become incremental (keyed
+    UpdateGetState) — training still separates topics, both ranks agree,
+    and the wire ships fewer rows than the request volume (frequent words
+    serve from the worker cache when unwritten since the last pull)."""
+    sents = _corpus()
+    d = Dictionary.build(sents, min_count=1)
+    ids = [d.encode(s) for s in sents]
+    cfg = Word2VecConfig(embedding_size=32, batch_size=256, window=4,
+                         negative=5, min_count=1, sample=0, sg=True,
+                         epochs=4, learning_rate=0.005, block_words=500,
+                         pipeline=False, seed=3, optimizer="sgd")
+
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    try:
+        w0 = DistributedWord2Vec(cfg, d, svc0, peers, rank=0,
+                                 sparse_tables=True)
+        w1 = DistributedWord2Vec(cfg, d, svc1, peers, rank=1,
+                                 sparse_tables=True)
+        requested = [0]
+        shipped = [0]
+        orig = w0.w_in.get_rows
+
+        def spy(rows, option=None):
+            out = orig(rows, option)
+            if option is not None:
+                requested[0] += len(np.unique(np.asarray(rows)))
+                shipped[0] += w0.w_in.last_incremental_rows
+            return out
+
+        w0.w_in.get_rows = spy
+        threads = [
+            threading.Thread(target=w0.train, args=(ids[0::2],)),
+            threading.Thread(target=w1.train, args=(ids[1::2],)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "sparse distributed training hung"
+
+        assert requested[0] > 0
+        # Incremental pulls must beat re-shipping every requested row.
+        assert shipped[0] < requested[0], (shipped, requested)
+
+        emb = w0.embeddings()
+        emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
+        a_ids = [d.word2id[w] for w in d.words if w.startswith("a")]
+        b_ids = [d.word2id[w] for w in d.words if w.startswith("b")]
+        intra = np.mean([emb[i] @ emb[j]
+                         for i in a_ids for j in a_ids if i != j])
+        inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
+        assert intra > inter + 0.1, f"intra={intra:.3f} inter={inter:.3f}"
+        np.testing.assert_allclose(w1.embeddings(), w0.embeddings(),
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        svc0.close()
+        svc1.close()
