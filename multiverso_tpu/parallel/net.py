@@ -25,6 +25,19 @@ _BLOB_HEADER = struct.Struct("<16sI")  # dtype string, ndim
 _MAGIC = struct.Struct("<I")
 _MAGIC_VALUE = 0x4D565450  # "MVTP"
 
+# Decode sanity bounds: a malformed (or hostile) frame must fail fast as
+# an IOError, not drive unbounded buffering or a numpy dtype crash.
+_MAX_BLOBS = 4096
+_MAX_NDIM = 16
+_MAX_BLOB_BYTES = 1 << 33   # 8 GB per blob — generous for shard traffic
+
+
+def _blob_dtype(tag: bytes) -> np.dtype:
+    try:
+        return np.dtype(tag.rstrip(b"\0").decode())
+    except (TypeError, ValueError, UnicodeDecodeError) as e:
+        raise IOError(f"bad blob dtype tag {tag!r}") from e
+
 
 def _pack_blob(arr: np.ndarray) -> bytes:
     arr = np.ascontiguousarray(arr)
@@ -75,12 +88,16 @@ def parse_frame(buf) -> Tuple[Optional[Message], int]:
     off = _MAGIC.size
     mtype, table_id, msg_id, src, n_blobs = _HEADER.unpack_from(buf, off)
     off += _HEADER.size
+    if not 0 <= n_blobs <= _MAX_BLOBS:
+        raise IOError(f"bad blob count {n_blobs}")
     data: List[np.ndarray] = []
     for _ in range(n_blobs):
         if n < off + _BLOB_HEADER.size:
             return None, 0
         dtype_tag, ndim = _BLOB_HEADER.unpack_from(buf, off)
         off += _BLOB_HEADER.size
+        if ndim > _MAX_NDIM:
+            raise IOError(f"bad blob ndim {ndim}")
         if n < off + 8 * ndim + 8:
             return None, 0
         shape: Tuple[int, ...] = ()
@@ -89,12 +106,18 @@ def parse_frame(buf) -> Tuple[Optional[Message], int]:
             off += 8 * ndim
         (nbytes,) = struct.unpack_from("<q", buf, off)
         off += 8
+        if not 0 <= nbytes <= _MAX_BLOB_BYTES:
+            raise IOError(f"bad blob size {nbytes}")
         if n < off + nbytes:
             return None, 0
         arr = np.frombuffer(bytes(buf[off:off + nbytes]),
-                            dtype=np.dtype(dtype_tag.rstrip(b"\0").decode()))
+                            dtype=_blob_dtype(dtype_tag))
         off += nbytes
-        data.append(arr.reshape(shape))
+        try:
+            data.append(arr.reshape(shape))
+        except (TypeError, ValueError) as e:
+            raise IOError(f"blob shape {shape} does not match payload "
+                          f"({nbytes} bytes)") from e
     return Message(src=src, type=mtype, table_id=table_id, msg_id=msg_id,
                    data=data), off
 
@@ -111,12 +134,16 @@ def recv_message(sock: socket.socket) -> Optional[Message]:
     if header is None:
         return None
     mtype, table_id, msg_id, src, n_blobs = _HEADER.unpack(header)
+    if not 0 <= n_blobs <= _MAX_BLOBS:
+        raise IOError(f"bad blob count {n_blobs}")
     data: List[np.ndarray] = []
     for _ in range(n_blobs):
         bh = _recv_exact(sock, _BLOB_HEADER.size)
         if bh is None:
             return None
         dtype_tag, ndim = _BLOB_HEADER.unpack(bh)
+        if ndim > _MAX_NDIM:
+            raise IOError(f"bad blob ndim {ndim}")
         shape: Tuple[int, ...] = ()
         if ndim:
             dims = _recv_exact(sock, 8 * ndim)
@@ -124,11 +151,16 @@ def recv_message(sock: socket.socket) -> Optional[Message]:
                 return None
             shape = struct.unpack(f"<{ndim}q", dims)
         (nbytes,) = struct.unpack("<q", _recv_exact(sock, 8))
+        if not 0 <= nbytes <= _MAX_BLOB_BYTES:
+            raise IOError(f"bad blob size {nbytes}")
         raw = _recv_exact(sock, nbytes)
         if raw is None:
             return None
-        arr = np.frombuffer(raw, dtype=np.dtype(dtype_tag.rstrip(b"\0")
-                                                .decode()))
-        data.append(arr.reshape(shape))
+        arr = np.frombuffer(raw, dtype=_blob_dtype(dtype_tag))
+        try:
+            data.append(arr.reshape(shape))
+        except (TypeError, ValueError) as e:
+            raise IOError(f"blob shape {shape} does not match payload "
+                          f"({nbytes} bytes)") from e
     return Message(src=src, type=mtype, table_id=table_id, msg_id=msg_id,
                    data=data)

@@ -358,7 +358,11 @@ class PSService:
                 while True:
                     try:
                         msg, consumed = parse_frame(buf)
-                    except IOError:
+                    except Exception:  # noqa: BLE001 - ANY malformed frame
+                        # (bad magic raises IOError, but a bogus dtype tag
+                        # or shape raises TypeError/ValueError from numpy)
+                        # must cost the sender its connection, never the
+                        # IO thread.
                         self._drop_conn(sock)
                         break
                     if msg is None:
