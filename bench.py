@@ -365,16 +365,29 @@ def main() -> None:
         }))
         return
 
+    import jax
+
+    # A dead-but-fast-failing accelerator plugin lets jax fall back to
+    # CPU silently; a CPU number must NEVER masquerade as the chip
+    # headline. Treat that as an outage, same as an unreachable tunnel.
+    dev = jax.devices()[0]
+    _log(f"backend: {dev.platform} ({len(jax.devices())} device(s), "
+         f"{getattr(dev, 'device_kind', '?')})")
+    if dev.platform == "cpu":
+        _log("backend resolved to CPU (accelerator plugin failed) — "
+             "recording zeros, not a CPU throughput")
+        print(json.dumps({
+            "metric": "w2v_words_per_sec", "value": 0.0,
+            "unit": "words/sec/chip", "vs_baseline": 0.0,
+            "error": "jax resolved to the CPU backend (accelerator plugin "
+                     "failed fast); refusing to record a CPU number as "
+                     "the chip headline",
+        }))
+        return
+
     import multiverso_tpu as mv
 
     mv.init([])
-    try:
-        import jax
-        dev = jax.devices()[0]
-        _log(f"backend: {dev.platform} ({len(jax.devices())} device(s), "
-             f"{getattr(dev, 'device_kind', '?')})")
-    except Exception:  # noqa: BLE001 - informational only
-        pass
     try:
         updates_per_sec = bench_matrix_table()
         try:
