@@ -340,9 +340,9 @@ def bench_pallas_rows() -> None:
 def main() -> None:
     here = os.path.dirname(os.path.abspath(__file__))
     _open_evidence(here)
-    if not _probe_backend_with_retry():
-        _log("backend unreachable after retry schedule (tunneled TPU "
-             "down) — recording zeros")
+    def record_outage(error: str) -> None:
+        """One zeros record format for EVERY no-chip path, always carrying
+        the last-measured-value provenance."""
         recorded, src = None, "BENCH_BASELINE.json"
         for name in ("BENCH_LATEST.json", "BENCH_BASELINE.json"):
             path = os.path.join(here, name)
@@ -358,31 +358,39 @@ def main() -> None:
         print(json.dumps({
             "metric": "w2v_words_per_sec", "value": 0.0,
             "unit": "words/sec/chip", "vs_baseline": 0.0,
-            "error": "jax backend unreachable after 6 probes with backoff "
-                     "over ~13 min (tunnel outage; see BENCH_EVIDENCE.txt); "
-                     "last measured value on this chip: "
+            "error": f"{error}; last measured value on this chip: "
                      f"{recorded} ({src}, docs/BENCHMARK.md)",
         }))
+
+    if not _probe_backend_with_retry():
+        _log("backend unreachable after retry schedule (tunneled TPU "
+             "down) — recording zeros")
+        record_outage("jax backend unreachable after 6 probes with "
+                      "backoff over ~13 min (tunnel outage; see "
+                      "BENCH_EVIDENCE.txt)")
         return
 
     import jax
 
     # A dead-but-fast-failing accelerator plugin lets jax fall back to
     # CPU silently; a CPU number must NEVER masquerade as the chip
-    # headline. Treat that as an outage, same as an unreachable tunnel.
-    dev = jax.devices()[0]
+    # headline. Treat that — and a backend that flapped between the
+    # probe and here — as an outage, same as an unreachable tunnel.
+    try:
+        dev = jax.devices()[0]
+    except Exception as e:  # noqa: BLE001 - must still emit the JSON line
+        _log(f"backend init failed after a passing probe: {e}")
+        record_outage("jax backend init failed after a passing probe "
+                      "(tunnel flapped mid-startup)")
+        return
     _log(f"backend: {dev.platform} ({len(jax.devices())} device(s), "
          f"{getattr(dev, 'device_kind', '?')})")
     if dev.platform == "cpu":
         _log("backend resolved to CPU (accelerator plugin failed) — "
              "recording zeros, not a CPU throughput")
-        print(json.dumps({
-            "metric": "w2v_words_per_sec", "value": 0.0,
-            "unit": "words/sec/chip", "vs_baseline": 0.0,
-            "error": "jax resolved to the CPU backend (accelerator plugin "
-                     "failed fast); refusing to record a CPU number as "
-                     "the chip headline",
-        }))
+        record_outage("jax resolved to the CPU backend (accelerator "
+                      "plugin failed fast); refusing to record a CPU "
+                      "number as the chip headline")
         return
 
     import multiverso_tpu as mv
