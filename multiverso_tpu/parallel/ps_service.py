@@ -2087,8 +2087,15 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
                                      lambda cache: cache[req])
 
     def load_state(self, payload: Dict[str, np.ndarray]) -> None:
+        """Restore this rank's SHARD and mark its whole bitmap stale (the
+        reference initializes all-stale), so every worker re-pulls the
+        restored rows. The worker-side incremental caches are KEPT: rows
+        fresh on REMOTE shards were not restored and their cached values
+        remain correct — clearing the cache while only the local bitmap
+        resets would serve zeros for them. In a full-cluster restore
+        every shard resets its own bitmap, so every row re-ships and
+        stale cache contents are overwritten either way."""
         super().load_state(payload)
         st = self._service._sparse.get(self.table_id)
-        if st is not None:      # restore: everything stale again (the
-            st.stale[:] = True  # reference initializes all-stale)
-        self._incr_cache.clear()
+        if st is not None:
+            st.stale[:] = True

@@ -216,6 +216,32 @@ def test_sparse_keyed_incremental_get(two_rank_world):
     np.testing.assert_allclose(got[1], 107.0)
 
 
+def test_sparse_checkpoint_restore_resets_staleness(two_rank_world):
+    """Restore marks EVERYTHING stale (the reference initializes
+    all-stale): a fresh bit promises the worker cache holds the current
+    row, and caches are not part of the checkpoint."""
+    svc0, svc1, peers = two_rank_world
+    m0 = DistributedSparseMatrixTable(31, 8, 2, svc0, peers, rank=0)
+    DistributedSparseMatrixTable(31, 8, 2, svc1, peers, rank=1)
+    m0.add_rows([1, 5], np.ones((2, 2), dtype=np.float32),
+                AddOption(worker_id=0))
+    m0.get(GetOption(worker_id=0))              # prime: all fresh
+    assert m0.get(GetOption(worker_id=0)) is not None
+    assert m0.last_incremental_rows == 0
+
+    saved = m0.store_state()
+    m0.add_rows([1], np.ones((1, 2), dtype=np.float32),
+                AddOption(worker_id=0))         # diverge
+    m0.load_state(saved)                        # restore rank-0 shard
+
+    got = m0.get(GetOption(worker_id=0))
+    # rank 0's shard (rows 0-3) re-shipped from the restored truth; the
+    # whole local bitmap went stale, so >= the local shard's rows ship.
+    assert m0.last_incremental_rows >= 4
+    np.testing.assert_allclose(got[1], 1.0)     # checkpoint value, not 2
+    np.testing.assert_allclose(got[5], 1.0)
+
+
 _SPARSE_WORKER = r"""
 import os, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"
