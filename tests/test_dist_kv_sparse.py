@@ -372,3 +372,42 @@ def test_sparse_sgd_reference_loose_semantics(two_rank_world):
     got0 = m0.get(GetOption(worker_id=0))
     assert m0.last_incremental_rows >= 1
     np.testing.assert_allclose(got0[2], -2.0)
+
+
+def test_bsp_kv_identical_views(sync_two_rank_world):
+    """KV tables under -sync=true: hash-routed adds/gets tick every
+    server uniformly (key residues rarely cover all shards), and each
+    worker's i-th get sees both workers' first i adds."""
+    import threading
+    svc0, svc1, peers = sync_two_rank_world
+    k0 = DistributedKVTable(33, svc0, peers, rank=0)
+    k1 = DistributedKVTable(33, svc1, peers, rank=1)
+    assert k0._bsp
+    rounds = 4
+    views = {0: [], 1: []}
+    errors = []
+
+    def worker(table, gid, key):
+        try:
+            for i in range(rounds):
+                table.add([key], [10 ** gid])      # worker g adds 10^g
+                views[gid].append(int(table.get([2])[0])
+                                  + int(table.get([3])[0]))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    # worker 0 only touches key 2 (shard 0), worker 1 only key 3
+    # (shard 1) — the wedge shape without uniform ticks.
+    threads = [threading.Thread(target=worker, args=(k0, 0, 2)),
+               threading.Thread(target=worker, args=(k1, 1, 3))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+        assert not th.is_alive(), "BSP KV worker wedged"
+    assert not errors, errors
+    # i-th view = (i+1) * (1 + 10): both workers' first i+1 adds, and
+    # identical across workers.
+    for i in range(rounds):
+        assert views[0][i] == views[1][i] == (i + 1) * 11, \
+            (i, views[0][i], views[1][i])
