@@ -92,15 +92,7 @@ def bench_word2vec() -> tuple:
     vocab_size = 50_000
     n_sent, sent_len = 2_000, 500      # 1M words
     # Zipfian word frequencies like natural text.
-    zipf = 1.0 / np.arange(1, vocab_size + 1)
-    zipf /= zipf.sum()
-
-    d = Dictionary(min_count=1)
-    d.words = [f"w{i}" for i in range(vocab_size)]
-    d.word2id = {w: i for i, w in enumerate(d.words)}
-    counts = np.maximum((zipf * n_sent * sent_len).astype(int), 1)
-    d.counts = counts.tolist()
-
+    d, zipf = Dictionary.synthetic_zipf(vocab_size, n_sent * sent_len)
     sentences = [rng.choice(vocab_size, size=sent_len, p=zipf)
                  .astype(np.int32) for _ in range(n_sent)]
 
@@ -133,7 +125,12 @@ def bench_word2vec() -> tuple:
     headline, roofline = run("float32")
     for dtype, compact in (("bfloat16", True), ("float32", False)):
         try:
-            run(dtype, compact)     # secondaries: stderr only
+            wps, _ = run(dtype, compact)
+            if dtype == "bfloat16" and compact:
+                # bf16 words/sec rides the driver JSON next to f32
+                # (VERDICT r4 #2): halved gather/scatter bytes is the top
+                # roofline lever, so its measured effect must be recorded.
+                roofline = dict(roofline, w2v_words_per_sec_bf16=round(wps, 1))
         except Exception as e:  # noqa: BLE001 - comparison is best-effort
             _log(f"{dtype}/compact={compact} comparison skipped: {e}")
 
@@ -180,12 +177,7 @@ def bench_big_vocab() -> None:
     rng = np.random.default_rng(3)
     vocab_size = 1_000_000
     n_sent, sent_len = 500, 500      # 250K words: a scale probe, not a fit
-    zipf = 1.0 / np.arange(1, vocab_size + 1)
-    zipf /= zipf.sum()
-    d = Dictionary(min_count=1)
-    d.words = [f"w{i}" for i in range(vocab_size)]
-    d.word2id = {w: i for i, w in enumerate(d.words)}
-    d.counts = np.maximum((zipf * 1e8).astype(int), 1).tolist()
+    d, zipf = Dictionary.synthetic_zipf(vocab_size, int(1e8))
     sentences = [rng.choice(vocab_size, size=sent_len, p=zipf)
                  .astype(np.int32) for _ in range(n_sent)]
     cfg = Word2VecConfig(embedding_size=128, window=5, negative=5,
@@ -337,6 +329,29 @@ def bench_pallas_rows() -> None:
          f"vs Pallas/tiled {tiled_ms:.2f}ms")
 
 
+def _virtual_trend(here: str) -> dict:
+    """Latest CPU-relative trend numbers (bench_virtual.py) so the driver
+    record carries a perf signal even on a tunnel outage. Explicitly
+    labeled: NEVER comparable to the chip headline."""
+    path = os.path.join(here, "BENCH_VIRTUAL.json")
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    sec = rec.get("secondary", {})
+    return {"virtual_cpu_trend": {
+        "dp4xtp2_words_per_sec": rec.get("value"),
+        "dist2_words_per_sec": sec.get("dist2_words_per_sec"),
+        "sharded_over_single": sec.get("sharded_over_single"),
+        "date": sec.get("date"), "git": sec.get("git"),
+        "note": "8-device VIRTUAL CPU mesh (bench_virtual.py) — "
+                "round-over-round trend only, not chip-comparable",
+    }}
+
+
 def main() -> None:
     here = os.path.dirname(os.path.abspath(__file__))
     _open_evidence(here)
@@ -360,6 +375,7 @@ def main() -> None:
             "unit": "words/sec/chip", "vs_baseline": 0.0,
             "error": f"{error}; last measured value on this chip: "
                      f"{recorded} ({src}, docs/BENCHMARK.md)",
+            "secondary": _virtual_trend(here),
         }))
 
     if not _probe_backend_with_retry():
@@ -440,7 +456,7 @@ def main() -> None:
         "unit": "words/sec/chip",
         "vs_baseline": round(vs_baseline, 3),
         "secondary": {"matrix_param_updates_per_sec": round(updates_per_sec),
-                      **roofline},
+                      **roofline, **_virtual_trend(here)},
     }))
 
 

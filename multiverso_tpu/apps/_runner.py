@@ -73,18 +73,43 @@ def spawn_ranks(module: str, args: List[str], world: int,
     return rc
 
 
-def pin_cpu_for_local_rank(args: List[str], device_flag: str) -> None:
-    """Spawned ranks pin jax to CPU BEFORE any backend init (the axon
-    sitecustomize force-selects the tunneled TPU; N local ranks would
-    contend for the one chip). ``-<device_flag>=default`` keeps the
-    auto-selection for one-rank-per-host deployments."""
-    if f"-{device_flag}=default" in args:
-        return
+def _flag_value(args: List[str], name: str) -> Optional[str]:
+    """Raw-argv value of ``-name=v`` (or ``--name=v`` — the consuming
+    parser strips either prefix, so the launcher must accept both).
+    Last occurrence wins, matching the parser's semantics."""
+    for a in reversed(args):
+        stripped = a.lstrip("-")
+        if stripped.startswith(f"{name}="):
+            return stripped.split("=", 1)[1]
+    return None
+
+
+def _pin_jax_cpu() -> None:
+    """Pin jax to CPU before backend init (the axon sitecustomize ignores
+    the JAX_PLATFORMS env var, so this must happen in-process)."""
     try:
         import jax
         jax.config.update("jax_platforms", "cpu")
     except RuntimeError:
         pass  # backend already up; use what we have
+
+
+def pin_cpu_for_local_rank(args: List[str], device_flag: str) -> None:
+    """Spawned ranks pin jax to CPU BEFORE any backend init (the axon
+    sitecustomize force-selects the tunneled TPU; N local ranks would
+    contend for the one chip). ``-<device_flag>=default`` keeps the
+    auto-selection for one-rank-per-host deployments."""
+    if _flag_value(args, device_flag) == "default":
+        return
+    _pin_jax_cpu()
+
+
+def pin_device_if_requested(args: List[str], device_flag: str) -> None:
+    """Single-process mode keeps jax's platform auto-selection (the chip)
+    unless the user explicitly passes ``-<device_flag>=cpu`` — the escape
+    hatch for driving a CLI on a host whose TPU tunnel is down."""
+    if _flag_value(args, device_flag) == "cpu":
+        _pin_jax_cpu()
 
 
 def rendezvous(rdv: str, rank: int, world: int, address,

@@ -64,9 +64,17 @@ def _body(argv: List[str]) -> int:
     return 0
 
 
+configure.define_string("lda_device", "default",
+                        "jax platform (cpu|default); -lda_device=cpu pins "
+                        "CPU before backend init (tunnel-down hosts)")
+
+
 def main(argv=None) -> int:
-    from multiverso_tpu.apps._runner import run_app
-    return run_app(_body, argv)
+    from multiverso_tpu.apps._runner import pin_device_if_requested, run_app
+
+    args = argv if argv is not None else sys.argv[1:]
+    pin_device_if_requested(args, device_flag="lda_device")
+    return run_app(_body, args)
 
 
 if __name__ == "__main__":

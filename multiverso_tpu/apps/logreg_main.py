@@ -145,6 +145,7 @@ def _body(argv: List[str]) -> int:
 
 def main(argv=None) -> int:
     from multiverso_tpu.apps._runner import (pin_cpu_for_local_rank,
+                                             pin_device_if_requested,
                                              run_app, spawn_ranks)
 
     args = argv if argv is not None else sys.argv[1:]
@@ -157,6 +158,8 @@ def main(argv=None) -> int:
                            rank_flag="lr_rank")
     if has_rank:
         pin_cpu_for_local_rank(args, device_flag="lr_device")
+    else:
+        pin_device_if_requested(args, device_flag="lr_device")
     return run_app(_body, args)
 
 

@@ -161,6 +161,7 @@ configure.define_string("w2v_device", "cpu",
 
 def main(argv=None) -> int:
     from multiverso_tpu.apps._runner import (pin_cpu_for_local_rank,
+                                             pin_device_if_requested,
                                              run_app, spawn_ranks)
 
     args = argv if argv is not None else sys.argv[1:]
@@ -175,6 +176,8 @@ def main(argv=None) -> int:
                            rank_flag="w2v_rank")
     if has_rank:
         pin_cpu_for_local_rank(args, device_flag="w2v_device")
+    else:
+        pin_device_if_requested(args, device_flag="w2v_device")
     return run_app(_body, args)
 
 

@@ -1,0 +1,132 @@
+// multiverso_tpu C# binding: P/Invoke declarations + managed wrappers.
+//
+// Parity with the reference's managed wrapper
+// (binding/C#/MultiversoCLR/MultiversoCLR.h:12-43 — an id-based
+// Init/CreateTable/Get/Add surface over the C boundary). Here the C
+// boundary is the framed-TCP PS wire client in runtime/src/mv_client.cpp
+// (libmvtpu_host.so): a CLR host is a foreign client of Python-served
+// shards, so Init takes the peer list. Compiles with any .NET >= 5 or
+// Mono; no CLR toolchain ships in the build image, so this file is
+// validated structurally (symbol cross-check) by
+// tests/test_binding_artifacts.py.
+
+using System;
+using System.Collections.Generic;
+using System.Runtime.InteropServices;
+
+namespace MultiversoTpu
+{
+    internal static class Native
+    {
+        private const string Lib = "mvtpu_host";   // libmvtpu_host.so
+
+        [DllImport(Lib)] internal static extern int MV_ConnectClient(
+            string peers, out IntPtr client);
+        [DllImport(Lib)] internal static extern void MV_CloseClient(
+            IntPtr client);
+        [DllImport(Lib)] internal static extern int MV_NumServers(
+            IntPtr client);
+
+        [DllImport(Lib)] internal static extern int MV_NewArrayTable(
+            IntPtr client, int tableId, long size, out IntPtr table);
+        [DllImport(Lib)] internal static extern int MV_AddArrayTable(
+            IntPtr table, float[] delta, long size);
+        [DllImport(Lib)] internal static extern int MV_GetArrayTable(
+            IntPtr table, float[] data, long size);
+
+        [DllImport(Lib)] internal static extern int MV_NewMatrixTable(
+            IntPtr client, int tableId, long numRow, long numCol,
+            out IntPtr table);
+        [DllImport(Lib)] internal static extern int MV_AddMatrixTableByRows(
+            IntPtr table, float[] deltas, int[] rowIds, long n);
+        [DllImport(Lib)] internal static extern int MV_GetMatrixTableByRows(
+            IntPtr table, float[] data, int[] rowIds, long n);
+
+        [DllImport(Lib)] internal static extern int MV_NewKVTable(
+            IntPtr client, int tableId, out IntPtr table);
+        [DllImport(Lib)] internal static extern int MV_AddKVTable(
+            IntPtr table, long[] keys, long[] values, long n);
+        [DllImport(Lib)] internal static extern int MV_GetKVTable(
+            IntPtr table, long[] keys, long[] values, long n);
+
+        [DllImport(Lib)] internal static extern void MV_FreeTable(
+            IntPtr table);
+
+        internal static void Check(int rc, string what)
+        {
+            if (rc != 0)
+                throw new InvalidOperationException(
+                    $"multiverso: {what} failed (rc={rc})");
+        }
+    }
+
+    /// Id-based managed surface mirroring MultiversoCLR.h:12-43:
+    /// Init, CreateTable(rows, cols), Get/Add by table id.
+    public static class MultiversoTpu
+    {
+        private static IntPtr _client = IntPtr.Zero;
+        private static readonly Dictionary<int, IntPtr> _tables = new();
+
+        /// Connect to Python-served shards: peers = "host:p1;host:p2;...".
+        public static void Init(string peers)
+        {
+            Native.Check(Native.MV_ConnectClient(peers, out _client),
+                         "connect");
+        }
+
+        public static int NumServers() => Native.MV_NumServers(_client);
+
+        public static void Shutdown()
+        {
+            foreach (var t in _tables.Values) Native.MV_FreeTable(t);
+            _tables.Clear();
+            if (_client != IntPtr.Zero) Native.MV_CloseClient(_client);
+            _client = IntPtr.Zero;
+        }
+
+        /// rows == 0 → 1-D array table of `cols` elements; rows > 0 → a
+        /// row-sharded matrix (ref CreateTable(rows, cols, eleType)).
+        public static void CreateTable(int tableId, long rows, long cols)
+        {
+            IntPtr t;
+            if (rows == 0)
+                Native.Check(Native.MV_NewArrayTable(
+                    _client, tableId, cols, out t), "new array");
+            else
+                Native.Check(Native.MV_NewMatrixTable(
+                    _client, tableId, rows, cols, out t), "new matrix");
+            _tables[tableId] = t;
+        }
+
+        public static void CreateKVTable(int tableId)
+        {
+            Native.Check(Native.MV_NewKVTable(_client, tableId, out var t),
+                         "new kv");
+            _tables[tableId] = t;
+        }
+
+        public static void Get(int tableId, float[] data) =>
+            Native.Check(Native.MV_GetArrayTable(
+                _tables[tableId], data, data.Length), "array get");
+
+        public static void Add(int tableId, float[] delta) =>
+            Native.Check(Native.MV_AddArrayTable(
+                _tables[tableId], delta, delta.Length), "array add");
+
+        public static void GetRows(int tableId, float[] data, int[] rowIds) =>
+            Native.Check(Native.MV_GetMatrixTableByRows(
+                _tables[tableId], data, rowIds, rowIds.Length), "matrix get");
+
+        public static void AddRows(int tableId, float[] deltas, int[] rowIds) =>
+            Native.Check(Native.MV_AddMatrixTableByRows(
+                _tables[tableId], deltas, rowIds, rowIds.Length), "matrix add");
+
+        public static void GetKV(int tableId, long[] keys, long[] values) =>
+            Native.Check(Native.MV_GetKVTable(
+                _tables[tableId], keys, values, keys.Length), "kv get");
+
+        public static void AddKV(int tableId, long[] keys, long[] values) =>
+            Native.Check(Native.MV_AddKVTable(
+                _tables[tableId], keys, values, keys.Length), "kv add");
+    }
+}

@@ -1,0 +1,44 @@
+-- Lua demo against Python-served PS shards (ref binding/lua/demos/xor).
+-- Driven by tests/test_binding_artifacts.py when luajit is available:
+--   luajit demo.lua <libmvtpu_host.so> <peers> <array_id> <matrix_id> <kv_id>
+-- Mirrors examples/c_table_demo.c: read Python's seeds, push deltas,
+-- print LUA_DEMO_OK on success.
+
+package.path = (arg[0]:match('(.*/)') or './') .. '?.lua;' .. package.path
+local mv = require 'init'
+
+local so, peers = arg[1], arg[2]
+local aid, mid, kid = tonumber(arg[3]), tonumber(arg[4]), tonumber(arg[5])
+
+mv.init{so = so, peers = peers}
+assert(mv.num_servers() >= 1, 'no servers')
+
+-- Array: Python seeded 100+i (i 0-based); push +i, so it becomes 100+2i.
+local at = mv.ArrayTableHandler:new(aid, 10)
+local v = at:get()
+for i = 1, 10 do
+  assert(v[i] == 100 + (i - 1), 'array seed mismatch at ' .. i)
+end
+local delta = {}
+for i = 1, 10 do delta[i] = i - 1 end
+at:add(delta)
+
+-- Matrix: rows {1,3,6} seeded at 10.0; push +1 everywhere on those rows.
+local mt = mv.MatrixTableHandler:new(mid, 8, 3)
+local rows = mt:get({1, 3, 6})
+for i = 1, 3 do
+  for j = 1, 3 do
+    assert(rows[i][j] == 10.0, 'matrix seed mismatch')
+  end
+end
+local ones = {{1, 1, 1}, {1, 1, 1}, {1, 1, 1}}
+mt:add({1, 3, 6}, ones)
+
+-- KV: keys {4, 7} seeded at 1000; push +k.
+local kt = mv.KVTableHandler:new(kid)
+local got = kt:get({4, 7})
+assert(got[1] == 1000 and got[2] == 1000, 'kv seed mismatch')
+kt:add({4, 7}, {4, 7})
+
+mv.shutdown()
+print('LUA_DEMO_OK')

@@ -1,0 +1,180 @@
+-- multiverso_tpu Lua binding (LuaJIT FFI).
+--
+-- Parity with the reference Lua/Torch package (binding/lua/init.lua:7-66,
+-- ArrayTableHandler.lua:6-56, MatrixTableHandler.lua:6-66): same handler
+-- surface, re-based on this framework's C boundary — the framed-TCP PS
+-- wire client in runtime/src/mv_client.cpp (libmvtpu_host.so). A Lua host
+-- is a *foreign client* of Python-served shards, so init takes the peer
+-- list instead of argc/argv.
+--
+-- Usage:
+--   local mv = require 'multiverso'
+--   mv.init{so = '/path/to/libmvtpu_host.so', peers = 'host:p1;host:p2'}
+--   local tbl = mv.ArrayTableHandler:new(table_id, size)
+--   tbl:add(delta); local v = tbl:get()
+--   mv.shutdown()
+
+local ffi = require 'ffi'
+
+ffi.cdef[[
+int  MV_ConnectClient(const char* peers, void** out_client);
+void MV_CloseClient(void* client);
+int  MV_NumServers(void* client);
+int  MV_NewArrayTable(void* client, int table_id, long long size,
+                      void** out_table);
+int  MV_AddArrayTable(void* table, const float* delta, long long size);
+int  MV_GetArrayTable(void* table, float* data, long long size);
+int  MV_NewMatrixTable(void* client, int table_id, long long num_row,
+                       long long num_col, void** out_table);
+int  MV_AddMatrixTableByRows(void* table, const float* deltas,
+                             const int* row_ids, long long n);
+int  MV_GetMatrixTableByRows(void* table, float* data, const int* row_ids,
+                             long long n);
+int  MV_NewKVTable(void* client, int table_id, void** out_table);
+int  MV_AddKVTable(void* table, const long long* keys,
+                   const long long* values, long long n);
+int  MV_GetKVTable(void* table, const long long* keys, long long* values,
+                   long long n);
+void MV_FreeTable(void* table);
+]]
+
+local mv = {}
+local lib = nil
+local client = nil
+
+local function check(rc, what)
+  if rc ~= 0 then
+    error(('multiverso: %s failed (rc=%d)'):format(what, rc))
+  end
+end
+
+--- Connect to the PS shards. opts: {so=path, peers='host:port;...'}.
+function mv.init(opts)
+  assert(opts and opts.peers, 'mv.init{so=..., peers=...} required')
+  lib = ffi.load(opts.so or 'libmvtpu_host.so')
+  local out = ffi.new('void*[1]')
+  check(lib.MV_ConnectClient(opts.peers, out), 'connect')
+  client = out[0]
+  return mv
+end
+
+function mv.num_servers()
+  return tonumber(lib.MV_NumServers(client))
+end
+
+function mv.shutdown()
+  if client ~= nil then lib.MV_CloseClient(client); client = nil end
+end
+
+local function new_handler(proto)
+  proto.__index = proto
+  return proto
+end
+
+-- 1-D dense float table (ref ArrayTableHandler.lua:6-56).
+mv.ArrayTableHandler = new_handler{}
+
+function mv.ArrayTableHandler:new(table_id, size)
+  local out = ffi.new('void*[1]')
+  check(lib.MV_NewArrayTable(client, table_id, size, out), 'new array')
+  return setmetatable(
+      {_t = ffi.gc(out[0], lib.MV_FreeTable), _size = size}, self)
+end
+
+--- add(delta): delta is a Lua array (1-based) or float* cdata.
+function mv.ArrayTableHandler:add(delta)
+  local buf = ffi.new('float[?]', self._size)
+  if type(delta) == 'table' then
+    for i = 1, self._size do buf[i - 1] = delta[i] end
+  else
+    ffi.copy(buf, delta, self._size * 4)
+  end
+  check(lib.MV_AddArrayTable(self._t, buf, self._size), 'array add')
+end
+
+--- get() -> Lua array (1-based).
+function mv.ArrayTableHandler:get()
+  local buf = ffi.new('float[?]', self._size)
+  check(lib.MV_GetArrayTable(self._t, buf, self._size), 'array get')
+  local out = {}
+  for i = 1, self._size do out[i] = buf[i - 1] end
+  return out
+end
+
+-- Row-sharded dense matrix (ref MatrixTableHandler.lua:6-66).
+mv.MatrixTableHandler = new_handler{}
+
+function mv.MatrixTableHandler:new(table_id, num_row, num_col)
+  local out = ffi.new('void*[1]')
+  check(lib.MV_NewMatrixTable(client, table_id, num_row, num_col, out),
+        'new matrix')
+  return setmetatable(
+      {_t = ffi.gc(out[0], lib.MV_FreeTable),
+       _rows = num_row, _cols = num_col}, self)
+end
+
+--- add(row_ids, deltas): row_ids 1-based Lua array of 0-based row ids;
+--- deltas row-major — either array-of-row-arrays matching row_ids, or one
+--- flat array of n*num_col values.
+function mv.MatrixTableHandler:add(row_ids, deltas)
+  local n = #row_ids
+  local ids = ffi.new('int[?]', n)
+  for i = 1, n do ids[i - 1] = row_ids[i] end
+  local buf = ffi.new('float[?]', n * self._cols)
+  if type(deltas[1]) == 'table' then
+    for i = 1, n do
+      for j = 1, self._cols do
+        buf[(i - 1) * self._cols + j - 1] = deltas[i][j]
+      end
+    end
+  else
+    for k = 1, n * self._cols do buf[k - 1] = deltas[k] end
+  end
+  check(lib.MV_AddMatrixTableByRows(self._t, buf, ids, n), 'matrix add')
+end
+
+--- get(row_ids) -> array of row arrays, aligned with row_ids.
+function mv.MatrixTableHandler:get(row_ids)
+  local n = #row_ids
+  local ids = ffi.new('int[?]', n)
+  for i = 1, n do ids[i - 1] = row_ids[i] end
+  local buf = ffi.new('float[?]', n * self._cols)
+  check(lib.MV_GetMatrixTableByRows(self._t, buf, ids, n), 'matrix get')
+  local out = {}
+  for i = 1, n do
+    local row = {}
+    for j = 1, self._cols do row[j] = buf[(i - 1) * self._cols + j - 1] end
+    out[i] = row
+  end
+  return out
+end
+
+-- Hash-routed int64 KV table (ref include/multiverso/table/kv_table.h).
+mv.KVTableHandler = new_handler{}
+
+function mv.KVTableHandler:new(table_id)
+  local out = ffi.new('void*[1]')
+  check(lib.MV_NewKVTable(client, table_id, out), 'new kv')
+  return setmetatable({_t = ffi.gc(out[0], lib.MV_FreeTable)}, self)
+end
+
+function mv.KVTableHandler:add(keys, values)
+  local n = #keys
+  local ks = ffi.new('long long[?]', n)
+  local vs = ffi.new('long long[?]', n)
+  for i = 1, n do ks[i - 1] = keys[i]; vs[i - 1] = values[i] end
+  check(lib.MV_AddKVTable(self._t, ks, vs, n), 'kv add')
+end
+
+function mv.KVTableHandler:get(keys)
+  local n = #keys
+  local ks = ffi.new('long long[?]', n)
+  local vs = ffi.new('long long[?]', n)
+  for i = 1, n do ks[i - 1] = keys[i]; vs[i - 1] = 0 end
+  check(lib.MV_GetKVTable(self._t, ks, vs, n), 'kv get')
+  local out = {}
+  for i = 1, n do out[i] = tonumber(vs[i - 1]) end
+  return out
+end
+
+return mv

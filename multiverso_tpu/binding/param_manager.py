@@ -113,7 +113,9 @@ class TorchParamManager:
         with torch.no_grad():
             for p, size in zip(self._params, self._sizes):
                 chunk = flat[offset:offset + size].reshape(tuple(p.shape))
-                p.copy_(torch.from_numpy(np.ascontiguousarray(chunk)))
+                # Copy: `flat` may be a read-only view (e.g. of a jax.Array)
+                # and torch.from_numpy warns on non-writable buffers.
+                p.copy_(torch.from_numpy(np.array(chunk, copy=True)))
                 offset += size
 
     def sync(self) -> None:

@@ -31,14 +31,52 @@ def _table_pytree(table: Any) -> Optional[Dict[str, Any]]:
     return tree
 
 
-def save_all(directory: str, step: int = 0) -> str:
-    """Checkpoint every registered table with per-shard parallel IO."""
+class AsyncSaveHandle:
+    """In-flight checkpoint: device→host staging is complete when
+    :func:`save_all_async` returns (so training may keep mutating tables),
+    storage writes finish in background threads until
+    :meth:`wait_until_finished`."""
+
+    def __init__(self, root: str, checkpointers: list) -> None:
+        self.root = root
+        self._ckptrs = checkpointers
+
+    def wait_until_finished(self) -> str:
+        ckptrs, self._ckptrs = self._ckptrs, []
+        first_error = None
+        for ckptr in ckptrs:    # join + close EVERY writer even if one fails
+            try:
+                ckptr.wait_until_finished()
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                first_error = first_error or e
+            finally:
+                try:
+                    ckptr.close()
+                except Exception as e:  # noqa: BLE001
+                    first_error = first_error or e
+        if first_error is not None:
+            raise first_error
+        return self.root
+
+
+def save_all_async(directory: str, step: int = 0) -> AsyncSaveHandle:
+    """Start checkpointing every registered table; returns once device
+    buffers are staged to host (orbax ``AsyncCheckpointer.save``), so the
+    caller can continue training while the writes land. Call
+    ``wait_until_finished()`` before relying on the files.
+
+    The staged snapshot is consistent: functional table updates *replace*
+    ``store.data`` rather than mutating it, and orbax copies device data to
+    host inside ``save``, so adds issued after this returns cannot leak into
+    the checkpoint.
+    """
     import orbax.checkpoint as ocp
 
     zoo = Zoo.get()
     check(zoo.started, "runtime not started")
     root = os.path.join(os.path.abspath(directory), f"orbax_{step:012d}")
-    with ocp.StandardCheckpointer() as ckptr:
+    ckptrs = []
+    try:
         for i, table in enumerate(zoo.tables):
             name = getattr(table, "name", f"table_{i}")
             tree = _table_pytree(table)
@@ -48,8 +86,28 @@ def save_all(directory: str, step: int = 0) -> str:
                 np.savez(os.path.join(root, f"{name}.npz"),
                          **table.store_state())
                 continue
+            # One checkpointer per table so background writes proceed in
+            # parallel; StandardCheckpointer is an AsyncCheckpointer in
+            # orbax. Appended BEFORE save so a failed save still gets
+            # joined/closed by the except path below.
+            ckptr = ocp.StandardCheckpointer()
+            ckptrs.append(ckptr)
             ckptr.save(os.path.join(root, name), tree)
-    return root
+    except Exception:
+        # Join + close writers already started; don't leak their threads
+        # (best-effort — the save error is the one worth raising).
+        try:
+            AsyncSaveHandle(root, ckptrs).wait_until_finished()
+        except Exception:  # noqa: BLE001
+            pass
+        raise
+    return AsyncSaveHandle(root, ckptrs)
+
+
+def save_all(directory: str, step: int = 0) -> str:
+    """Blocking checkpoint of every registered table (async under the
+    hood — per-table background writers joined before returning)."""
+    return save_all_async(directory, step).wait_until_finished()
 
 
 def load_all(checkpoint_dir: str) -> None:

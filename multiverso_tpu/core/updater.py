@@ -204,6 +204,55 @@ class DCASGDUpdater(Updater):
                 {"backup": backup})
 
 
+class DCASGDAUpdater(DCASGDUpdater):
+    """Adaptive-lambda DC-ASGD (the reference factory's ``dcasgda``,
+    ``src/updater/updater.cpp:53`` — named, source absent; implemented from
+    the DC-ASGD formulation's adaptive variant): the compensation strength
+    tracks the gradient's second moment, ``m = eps_m*m + (1-eps_m)*g*g``,
+    and the effective lambda is ``lam / sqrt(m + eps)`` elementwise — large
+    recent gradients shrink the compensation, so early noisy steps are not
+    over-corrected while stale late steps still are."""
+
+    name = "dcasgda"
+    eps_m = 0.95
+    eps = 1e-7
+
+    def init_state(self, shape, dtype, num_workers):
+        st = super().init_state(shape, dtype, num_workers)
+        st["m"] = jnp.zeros(tuple(shape), dtype=jnp.float32)
+        return st
+
+    def update_dense(self, data, state, delta, opt):
+        worker_id, _, lr, _, lam = opt
+        g = delta.astype(jnp.float32)
+        d32 = data.astype(jnp.float32)
+        m = self.eps_m * state["m"] + (1.0 - self.eps_m) * g * g
+        lam_eff = lam / jnp.sqrt(m + self.eps)
+        backup_w = state["backup"][worker_id]
+        step = lr * (g + lam_eff * g * g * (d32 - backup_w))
+        new_data = d32 - step
+        backup = state["backup"].at[worker_id].set(new_data)
+        return new_data.astype(data.dtype), {"backup": backup, "m": m}
+
+    def update_rows(self, data, state, rows, delta, opt):
+        worker_id, _, lr, _, lam = opt
+        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
+        g = delta.astype(jnp.float32)
+        m_rows_prev = jnp.take(state["m"], rows, axis=0, mode="clip")
+        m_rows = self.eps_m * m_rows_prev + (1.0 - self.eps_m) * g * g
+        m = state["m"].at[rows].set(m_rows, mode="drop")
+        lam_eff = lam / jnp.sqrt(m_rows + self.eps)
+        d_rows = jnp.take(data, rows, axis=0, mode="clip").astype(jnp.float32)
+        backup_rows = jnp.take(state["backup"][worker_id], rows, axis=0,
+                               mode="clip")
+        step = lr * (g + lam_eff * g * g * (d_rows - backup_rows))
+        new_rows = d_rows - step
+        backup = state["backup"].at[worker_id, rows].set(new_rows,
+                                                         mode="drop")
+        return (data.at[rows].set(new_rows.astype(data.dtype), mode="drop"),
+                {"backup": backup, "m": m})
+
+
 class FTRLUpdater(Updater):
     """FTRL-proximal with server-resident {z, n} state.
 
@@ -257,6 +306,7 @@ _REGISTRY: Dict[str, Callable[[], Updater]] = {
     "adagrad": AdaGradUpdater,
     "ftrl": FTRLUpdater,
     "dcasgd": DCASGDUpdater,
+    "dcasgda": DCASGDAUpdater,
 }
 
 

@@ -43,6 +43,20 @@ class Dictionary:
             d.counts.append(count)
         return d
 
+    @classmethod
+    def synthetic_zipf(cls, vocab_size: int, n_words: int):
+        """A fabricated Zipf-ranked vocabulary for benchmarks (the
+        zero-egress image has no text8; natural text is Zipf-shaped).
+        Returns ``(dictionary, probs)`` with ``probs`` the rank-frequency
+        distribution to sample synthetic sentences from."""
+        zipf = 1.0 / np.arange(1, vocab_size + 1)
+        zipf /= zipf.sum()
+        d = cls(min_count=1)
+        d.words = [f"w{i}" for i in range(vocab_size)]
+        d.word2id = {w: i for i, w in enumerate(d.words)}
+        d.counts = np.maximum((zipf * n_words).astype(int), 1).tolist()
+        return d, zipf
+
     def __len__(self) -> int:
         return len(self.words)
 

@@ -283,23 +283,47 @@ class DistributedWord2Vec:
         if self._initialized:
             return
         self._initialized = True
+        V, D = len(self.dict), self.cfg.embedding_size
         if self.rank == 0:
-            V, D = len(self.dict), self.cfg.embedding_size
             rng = np.random.default_rng(self.cfg.seed)
             init = rng.uniform(-0.5 / D, 0.5 / D, size=(V, D)) \
                 .astype(np.float32)
             self.w_in.add_rows(np.arange(V, dtype=np.int32), init)
+        elif self.w_in._bsp:
+            # BSP: non-masters issue one zero add so every worker's add
+            # clock ticks uniformly — the reference binding's master-init
+            # trick (binding/python/multiverso/tables.py: master sets
+            # init_value, everyone else adds zeros). One row suffices:
+            # an add ticks each server's clock exactly once regardless of
+            # payload (_bsp_tick_parts fans a tick to non-routed servers).
+            self.w_in.add_rows(np.zeros(1, dtype=np.int32),
+                               np.zeros((1, D), dtype=np.float32))
 
     def train(self, sentences: Iterable[Sequence[int]],
-              epochs: Optional[int] = None) -> dict:
+              epochs: Optional[int] = None,
+              on_block=None) -> dict:
+        """Train; ``on_block(block_index, trained_words)`` fires after every
+        data block (progress hook — the fault drill and dashboards use it).
+        In BSP mode the worker retires its server-side clocks when done
+        (``Zoo::FinishTrain`` on shutdown, ref src/zoo.cpp:106,152-161) so
+        peers with more data don't wait on it forever."""
         epochs = epochs if epochs is not None else self.cfg.epochs
+        check(not getattr(self, "_bsp_retired", False),
+              "train() is single-shot in BSP mode: this worker's clocks "
+              "were retired by finish_train at the end of the previous "
+              "call (pass all epochs in one call, as the reference's "
+              "one-shot Zoo::FinishTrain contract requires)")
         self._maybe_master_init()
         t0 = time.perf_counter()
+        n_blocks = 0
         for _ in range(epochs):
             for block in BlockStream(iter(sentences), self.cfg.block_words,
                                      prefetch=self.cfg.pipeline):
                 self.trained_words += self._train_block(block)
                 self._sync_word_count()
+                n_blocks += 1
+                if on_block is not None:
+                    on_block(n_blocks, self.trained_words)
         # Drain staged pushes so peers (e.g. the saving master) see this
         # worker's last deltas after their barrier.
         for table in (self.w_in, self.w_out, self.g_in, self.g_out,
@@ -311,6 +335,17 @@ class DistributedWord2Vec:
         if self._wc_pending is not None:
             self.word_count.wait(self._wc_pending)
             self._wc_pending = None
+        # BSP: this worker is done — set its clocks to infinity on every
+        # shard (Server_Finish_Train, ref src/zoo.cpp:106 via StopPS +
+        # src/server.cpp:190-213) so peers still training never gate on it.
+        # Post-retire reads (e.g. the master's embeddings() pull) drain once
+        # every worker has retired (INF <= INF is admissible).
+        if self.w_in._bsp:
+            self._bsp_retired = True
+            for table in (self.w_in, self.w_out, self.g_in, self.g_out,
+                          self.word_count):
+                if table is not None:
+                    table.finish_train()
         elapsed = time.perf_counter() - t0
         self.words_per_sec = self.trained_words / max(elapsed, 1e-9)
         return {"words": self.trained_words,

@@ -29,6 +29,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 SEQ_AXIS = "seq"
 
 
+def _pvary(x, axis):
+    """Mark ``x`` as varying over ``axis`` (jax>=0.9 renamed pvary to
+    pcast(..., to='varying'))."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    return jax.lax.pvary(x, axis)
+
+
 def _block_attn(q, k, v, scale, mask=None):
     """Scores for one (q-block, kv-block) pair plus streaming-softmax stats.
     q: [B, H, Sq, D]; k, v: [B, H, Sk, D]; mask: [Sq, Sk] additive."""
@@ -88,10 +96,10 @@ def ring_attention_block(q_blk: jax.Array, k_blk: jax.Array,
     B, H, _, D = q_blk.shape
     # Fresh accumulators are "unvarying" over the mesh axis until marked;
     # the carry must match the ppermute outputs' varying type.
-    init = (jax.lax.pvary(jnp.zeros((B, H, Sq, D), q_blk.dtype), axis),
-            jax.lax.pvary(jnp.full((B, H, Sq, 1), -jnp.inf,
-                                   q_blk.dtype), axis),
-            jax.lax.pvary(jnp.zeros((B, H, Sq, 1), q_blk.dtype), axis),
+    init = (_pvary(jnp.zeros((B, H, Sq, D), q_blk.dtype), axis),
+            _pvary(jnp.full((B, H, Sq, 1), -jnp.inf,
+                            q_blk.dtype), axis),
+            _pvary(jnp.zeros((B, H, Sq, 1), q_blk.dtype), axis),
             k_blk, v_blk)
     (o, _, l, _, _), _ = jax.lax.scan(body, init, jnp.arange(n))
     return o / jnp.maximum(l, 1e-20)

@@ -1,0 +1,108 @@
+"""Lua + C# binding artifacts (VERDICT r4 #5).
+
+The reference ships a LuaJIT-FFI package (binding/lua/init.lua:7-66) and a
+managed C# wrapper (binding/C#/MultiversoCLR/MultiversoCLR.h:12-43). Here
+both ride the framed-TCP C boundary (runtime/src/mv_client.cpp). Neither
+luajit nor a CLR ships in this image, so the artifacts are validated in two
+tiers: (1) ALWAYS — every function the Lua ffi.cdef / C# DllImport block
+declares must exist in libmvtpu_host.so with those exact names (a renamed
+or removed export breaks this test, keeping the artifacts honest); (2) if
+``luajit`` is on PATH, the demo runs live against two Python-served shards,
+exactly like the C demo in test_c_api_ffi.py.
+"""
+
+import ctypes
+import os
+import re
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.runtime import ffi
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LUA_DIR = os.path.join(REPO, "multiverso_tpu", "binding", "lua")
+CS_FILE = os.path.join(REPO, "multiverso_tpu", "binding", "csharp",
+                       "MultiversoTpu.cs")
+
+
+def _so_path():
+    ffi.load()
+    return os.path.join(REPO, "multiverso_tpu", "runtime",
+                        "libmvtpu_host.so")
+
+
+def _declared_lua_symbols():
+    src = open(os.path.join(LUA_DIR, "init.lua")).read()
+    cdef = re.search(r"ffi\.cdef\[\[(.*?)\]\]", src, re.S).group(1)
+    return re.findall(r"\b(MV_\w+)\s*\(", cdef)
+
+
+def _declared_cs_symbols():
+    src = open(CS_FILE).read()
+    return re.findall(r"extern\s+\w+\s+(MV_\w+)\s*\(", src)
+
+
+def test_lua_cdef_symbols_match_so():
+    lib = ctypes.CDLL(_so_path())
+    syms = _declared_lua_symbols()
+    assert len(syms) >= 13, "cdef block lost declarations"
+    for sym in syms:
+        assert hasattr(lib, sym), f"init.lua declares missing symbol {sym}"
+
+
+def test_csharp_dllimport_symbols_match_so():
+    lib = ctypes.CDLL(_so_path())
+    syms = _declared_cs_symbols()
+    assert len(syms) >= 13, "DllImport block lost declarations"
+    for sym in syms:
+        assert hasattr(lib, sym), f"MultiversoTpu.cs declares missing {sym}"
+
+
+def test_lua_and_csharp_cover_same_surface():
+    assert set(_declared_lua_symbols()) == set(_declared_cs_symbols())
+
+
+@pytest.mark.skipif(shutil.which("luajit") is None,
+                    reason="luajit not installed (artifact gated like gs://)")
+def test_lua_demo_against_python_shards(mv_env):
+    from multiverso_tpu.parallel.ps_service import (DistributedArrayTable,
+                                                    DistributedKVTable,
+                                                    DistributedMatrixTable,
+                                                    PSService)
+
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    AID, MID, KID = 211, 212, 213
+    try:
+        a0 = DistributedArrayTable(AID, 10, svc0, peers, rank=0)
+        a1 = DistributedArrayTable(AID, 10, svc1, peers, rank=1)
+        m0 = DistributedMatrixTable(MID, 8, 3, svc0, peers, rank=0)
+        DistributedMatrixTable(MID, 8, 3, svc1, peers, rank=1)
+        k0 = DistributedKVTable(KID, svc0, peers, rank=0)
+        DistributedKVTable(KID, svc1, peers, rank=1)
+
+        a0.add(np.arange(100, 110, dtype=np.float32))
+        m0.add_rows([1, 3, 6], np.full((3, 3), 10.0, dtype=np.float32))
+        k0.add([4, 7], [1000, 1000])
+
+        peer_str = ";".join(f"{h}:{p}" for h, p in peers)
+        proc = subprocess.run(
+            ["luajit", os.path.join(LUA_DIR, "demo.lua"), _so_path(),
+             peer_str, str(AID), str(MID), str(KID)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, \
+            f"lua demo failed:\n{proc.stdout}\n{proc.stderr}"
+        assert "LUA_DEMO_OK" in proc.stdout
+
+        np.testing.assert_allclose(
+            a1.get(), np.arange(100, 110, dtype=np.float32)
+            + np.arange(10, dtype=np.float32))
+        np.testing.assert_allclose(m0.get_rows([1, 3, 6]),
+                                   np.full((3, 3), 11.0))
+        np.testing.assert_array_equal(k0.get([4, 7]), [1004, 1007])
+    finally:
+        svc0.close()
+        svc1.close()

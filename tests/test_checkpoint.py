@@ -146,6 +146,31 @@ def test_orbax_backend_roundtrip(tmp_path, mv_env):
     assert len(a.store.data.sharding.device_set) == mv.num_servers()
 
 
+def test_orbax_async_save_overlaps_training(tmp_path, mv_env):
+    """``save_all_async`` returns after device→host staging; training adds
+    issued while the write is in flight must NOT leak into the checkpoint
+    (snapshot consistency), and the handle joins the background writers."""
+    from multiverso_tpu.core import checkpoint_orbax as co
+
+    m = mv.create_table(mv.MatrixTableOption(num_row=512, num_col=64,
+                                             name="async_m"))
+    m.add(np.ones((512, 64), dtype=np.float32))
+    snap = m.get()
+
+    handle = co.save_all_async(str(tmp_path), step=3)
+    # "training" continues while the storage write is (possibly) in flight
+    for _ in range(3):
+        m.add(np.ones((512, 64), dtype=np.float32))
+    path = handle.wait_until_finished()
+    assert path == handle.root
+    np.testing.assert_allclose(m.get(), 4.0 * np.ones((512, 64)))
+
+    co.load_all(path)
+    np.testing.assert_allclose(m.get(), snap)   # pre-save snapshot, exactly
+    # idempotent second wait
+    assert handle.wait_until_finished() == path
+
+
 def test_bf16_momentum_state_dtype_roundtrip(tmp_path, mv_env):
     """Regression: widened-to-f32 updater state must restore to the live
     leaf dtype (momentum 'smooth' is bf16 for bf16 tables)."""
