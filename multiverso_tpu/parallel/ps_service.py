@@ -687,9 +687,8 @@ class PSService:
                     values = np.asarray(store.read_rows(rows))
                 reply = msg.create_reply()
                 reply.data = [rows + np.int32(row_offset),
-                              *pack_payload(values,
-                                            "sparse" if mode != "none"
-                                            else "none", clip=0.0)]
+                              *pack_payload(values, _reply_mode(mode),
+                                            clip=0.0)]
                 return reply
             if keys.size == 1 and int(keys[0]) == STALE_GET_KEY:
                 # Incremental whole-table Get: exactly the rows stale for
@@ -705,9 +704,8 @@ class PSService:
                     values = np.asarray(store.read_rows(rows))
                 reply = msg.create_reply()
                 reply.data = [rows + np.int32(row_offset),
-                              *pack_payload(values,
-                                            "sparse" if mode != "none"
-                                            else "none", clip=0.0)]
+                              *pack_payload(values, _reply_mode(mode),
+                                            clip=0.0)]
                 return reply
             with monitor("PS_SERVICE_GET"):   # ref server.cpp:37 monitor
                 if raw_wire:
@@ -724,8 +722,8 @@ class PSService:
             # FilterIn on the reply leg (ref ProcessGet,
             # sparse_matrix_table.cpp:261-309); onebit never applies to
             # absolute parameter values.
-            reply.data = pack_payload(
-                values, "sparse" if mode != "none" else "none", clip=0.0)
+            reply.data = pack_payload(values, _reply_mode(mode),
+                                      clip=0.0)
             return reply
         log.error("ps_service: unhandled type %d", msg.type)
         return None
@@ -887,7 +885,11 @@ def _opt_from_array(arr: np.ndarray) -> AddOption:
 #   2 onebit  — packed sign bits + two scales, with sender-held error
 #               feedback; opt-in (dense array add path only: quantizing
 #               absolute values or sparse row deltas would be lossy garbage)
-_WIRE_RAW, _WIRE_SPARSE, _WIRE_ONEBIT = 0, 1, 2
+#   3 bf16    — round-to-nearest-even bfloat16 truncation (uint16 wire
+#               halves), halving bytes on BOTH legs at bf16 delta/param
+#               precision; the TPU-native middle ground between raw and
+#               onebit (no sender state, works for row deltas and gets)
+_WIRE_RAW, _WIRE_SPARSE, _WIRE_ONEBIT, _WIRE_BF16 = 0, 1, 2, 3
 
 
 def _wire_mode() -> str:
@@ -902,6 +904,16 @@ def _wire_clip() -> float:
 
 def _marker(mode: int, shape: Tuple[int, ...]) -> np.ndarray:
     return np.asarray([mode, len(shape), *shape], dtype=np.int64)
+
+
+def _reply_mode(mode: str) -> str:
+    """Reply legs carry ABSOLUTE parameter values: onebit would be lossy
+    garbage there, so it degrades to lossless sparsify; bf16 stays bf16 —
+    opting into it means bf16 read precision on pulls too (that is where
+    half the wire bytes are)."""
+    if mode == "bf16":
+        return "bf16"
+    return "sparse" if mode != "none" else "none"
 
 
 def pack_payload(arr: np.ndarray, mode: str,
@@ -921,6 +933,9 @@ def pack_payload(arr: np.ndarray, mode: str,
             _wire_clip() if clip is None else clip).filter_in(arr)
         if compressed:
             return [_marker(_WIRE_SPARSE, arr.shape), idx, payload]
+    if mode == "bf16":
+        from multiverso_tpu.utils.quantization import f32_to_bf16_bits
+        return [_marker(_WIRE_BF16, arr.shape), f32_to_bf16_bits(arr)]
     return [_marker(_WIRE_RAW, arr.shape), arr]
 
 
@@ -938,6 +953,9 @@ def unpack_payload(blobs: List[np.ndarray]) -> np.ndarray:
     if mode == _WIRE_ONEBIT:
         return OneBitsFilter.decode(blobs[1], float(blobs[2][0]),
                                     float(blobs[2][1]), size).reshape(shape)
+    if mode == _WIRE_BF16:
+        from multiverso_tpu.utils.quantization import bf16_bits_to_f32
+        return bf16_bits_to_f32(blobs[1]).reshape(shape)
     raise IOError(f"unknown wire payload mode {mode}")
 
 
@@ -1966,9 +1984,17 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
         option = dataclasses.replace(
             option, worker_id=self._gid(option.worker_id))
         if self._mirror:
+            mirror_deltas = np.asarray(deltas, dtype=np.float32)
+            if _wire_mode() == "bf16":
+                # The freshness contract requires mirror == what the
+                # server applied; in bf16 mode that is the ROUNDED delta.
+                from multiverso_tpu.utils.quantization import (
+                    bf16_bits_to_f32, f32_to_bf16_bits)
+                mirror_deltas = bf16_bits_to_f32(
+                    f32_to_bf16_bits(mirror_deltas)).reshape(
+                        mirror_deltas.shape)
             np.add.at(self._cache_for(option.worker_id),
-                      np.asarray(rows, dtype=np.int64),
-                      np.asarray(deltas, dtype=np.float32))
+                      np.asarray(rows, dtype=np.int64), mirror_deltas)
         parts = []
         routed = self._route(rows)
         for s, ix in routed.items():

@@ -190,8 +190,9 @@ define_int("port", 55555, "transport port (ref zmq_net.h:21)")
 # Wire compression for the DCN table service (ref runs all sparse-table
 # traffic through SparseFilter, sparse_matrix_table.cpp:148-153; OneBits is
 # a stub there, quantization_util.h:160-161 — real here, behind the flag).
-define_string("wire_compression", "sparse", "none|sparse|onebit: filter for "
-              "DCN table payloads (ref quantization_util.h:10-164)")
+define_string("wire_compression", "sparse", "none|sparse|onebit|bf16: "
+              "filter for DCN table payloads (ref quantization_util.h:"
+              "10-164; bf16 = TPU-era addition, halves bytes both legs)")
 define_double("wire_compression_clip", 0.0, "SparseFilter clip threshold "
               "(entries with |x|<=clip drop; ref FilterIn)")
 # TPU-native additions.

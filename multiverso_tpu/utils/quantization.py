@@ -7,6 +7,10 @@ Parity with ``include/multiverso/util/quantization_util.h:10-164``:
   whether the payload is compressed (-1 = raw there; a bool here).
 * ``OneBitsFilter``: 1-bit quantization with per-buffer scale + error
   feedback — an empty stub in the reference (``:160-161``), implemented here.
+* ``f32_to_bf16_bits``/``bf16_bits_to_f32``: the TPU-era middle ground the
+  reference predates — bfloat16 wire truncation (round-to-nearest-even)
+  halves DCN bytes at ~3 decimal digits of delta precision, no sender
+  state needed.
 
 Used where bytes actually cross a slow link (host staging drains, DCN
 transfers, checkpoint streams); on-chip traffic needs no filtering — ICI
@@ -46,6 +50,30 @@ class SparseFilter:
         out = np.zeros(size, dtype=dtype)
         out[indices] = payload
         return out
+
+
+def f32_to_bf16_bits(arr: np.ndarray) -> np.ndarray:
+    """float32 -> bfloat16 bit pattern as uint16, round-to-nearest-even
+    (the TPU-native 16-bit format; numpy has no bf16 dtype, so the wire
+    carries the raw upper halves). NaNs map to quiet NaN — the rounding
+    bias would otherwise turn them into inf (low payload) or wrap to 0
+    (negative NaN), silently masking a diverged gradient."""
+    b = np.ascontiguousarray(arr, dtype=np.float32).view(np.uint32)
+    rounded = b + np.uint32(0x7FFF) + ((b >> np.uint32(16)) & np.uint32(1))
+    out = (rounded >> np.uint32(16)).astype(np.uint16)
+    nan = ((b & np.uint32(0x7F800000)) == np.uint32(0x7F800000)) \
+        & ((b & np.uint32(0x007FFFFF)) != 0)
+    if nan.any():
+        sign = (b[nan] >> np.uint32(16)).astype(np.uint16) \
+            & np.uint16(0x8000)
+        out[nan] = sign | np.uint16(0x7FC0)
+    return out
+
+
+def bf16_bits_to_f32(bits: np.ndarray) -> np.ndarray:
+    """uint16 bfloat16 bit pattern -> float32 (exact)."""
+    return (np.ascontiguousarray(bits, dtype=np.uint16)
+            .astype(np.uint32) << np.uint32(16)).view(np.float32)
 
 
 class OneBitsFilter:

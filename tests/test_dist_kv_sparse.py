@@ -411,3 +411,50 @@ def test_bsp_kv_identical_views(sync_two_rank_world):
     for i in range(rounds):
         assert views[0][i] == views[1][i] == (i + 1) * 11, \
             (i, views[0][i], views[1][i])
+
+
+def test_sparse_mirror_consistent_under_bf16_wire(two_rank_world):
+    """-wire_compression=bf16 with a plain-add sparse table: the client
+    mirrors the bf16-ROUNDED delta (what the server actually applied), so
+    mirror-fresh rows stay EXACTLY equal to server truth — no unbounded
+    mirror/server drift across repeated adds."""
+    from multiverso_tpu.utils.configure import set_flag
+
+    svc0, svc1, peers = two_rank_world
+    V = 16
+    m0 = DistributedSparseMatrixTable(77, V, 4, svc0, peers, rank=0)
+    m1 = DistributedSparseMatrixTable(77, V, 4, svc1, peers, rank=1)
+    rng = np.random.default_rng(5)
+    set_flag("wire_compression", "bf16")
+    try:
+        m0.get(GetOption(worker_id=0))     # prime writer cache: all fresh
+        for _ in range(50):
+            m0.add_rows(np.arange(V, dtype=np.int32),
+                        rng.normal(size=(V, 4)).astype(np.float32) * 0.01,
+                        AddOption(worker_id=0))
+        # writer's view: mirror-fresh rows, served from its cache
+        mine = m0.get(GetOption(worker_id=0))
+        assert m0.last_incremental_rows == 0   # cache hit, not re-shipped
+        # peer's view: everything re-pulled from server truth (bf16 reply
+        # of exact server values -> re-round server truth for comparison)
+        theirs = m1.get(GetOption(worker_id=0))
+        from multiverso_tpu.utils.quantization import (bf16_bits_to_f32,
+                                                       f32_to_bf16_bits)
+        server_rounded = bf16_bits_to_f32(
+            f32_to_bf16_bits(mine)).reshape(mine.shape)
+        np.testing.assert_allclose(theirs, server_rounded, rtol=0, atol=0)
+    finally:
+        set_flag("wire_compression", "sparse")
+
+
+def test_bf16_bits_nan_inf_preserved():
+    from multiverso_tpu.utils.quantization import (bf16_bits_to_f32,
+                                                   f32_to_bf16_bits)
+    x = np.array([np.nan, -np.nan, np.inf, -np.inf, 0.0], dtype=np.float32)
+    y = bf16_bits_to_f32(f32_to_bf16_bits(x))
+    assert np.isnan(y[0]) and np.isnan(y[1])
+    assert y[2] == np.inf and y[3] == -np.inf and y[4] == 0.0
+    # signaling-NaN bit pattern also maps to a quiet NaN, not inf
+    s = np.array([0x7F800001, 0xFFFFFFFF], dtype=np.uint32).view(np.float32)
+    z = bf16_bits_to_f32(f32_to_bf16_bits(s))
+    assert np.isnan(z).all(), z
