@@ -141,21 +141,32 @@ def bench_distributed_2proc(tmp_dir: str) -> dict:
 
     out = os.path.join(tmp_dir, "vectors.txt")
     t0 = time.perf_counter()
-    proc = subprocess.run(
-        [sys.executable, "-m", "multiverso_tpu.apps.word2vec_main",
-         f"-train_file={corpus}", f"-output_file={out}", "-size=64",
-         "-window=4", "-negative=5", "-min_count=1", "-epoch=1",
-         "-sample=0", "-world_size=2", "-batch_size=2048"],
-        capture_output=True, text=True, timeout=900,
-        cwd=os.path.dirname(os.path.abspath(__file__)))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "multiverso_tpu.apps.word2vec_main",
+             f"-train_file={corpus}", f"-output_file={out}", "-size=64",
+             "-window=4", "-negative=5", "-min_count=1", "-epoch=1",
+             "-sample=0", "-world_size=2", "-batch_size=2048"],
+            capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        _log("distributed 2-proc run TIMED OUT — no record")
+        return {"dist2_words_per_sec": None, "dist2_error": "timeout"}
     wall = time.perf_counter() - t0
     text = proc.stdout + proc.stderr
     if proc.returncode != 0:
         _log(f"distributed 2-proc run FAILED rc={proc.returncode}:\n"
              f"{text[-2000:]}")
-        return {"dist2_words_per_sec": 0.0, "dist2_error": "nonzero exit"}
+        return {"dist2_words_per_sec": None, "dist2_error": "nonzero exit"}
     rates = [float(m) for m in
              re.findall(r"rank \d+ trained: (\d+(?:\.\d+)?) words/sec", text)]
+    if not rates:
+        # A reworded log line must surface as a missing point, never as a
+        # fake 0.0 "regression" in the trend record.
+        _log("distributed 2-proc run printed no parseable per-rank "
+             f"words/sec — no record (tail: {text.strip()[-300:]!r})")
+        return {"dist2_words_per_sec": None,
+                "dist2_error": "no parseable rank rates"}
     total = round(sum(rates), 1)
     _log(f"virtual w2v[2-process distributed]: per-rank {rates} -> "
          f"{total} words/sec aggregate ({wall:.1f}s wall incl. spawn)")

@@ -1986,8 +1986,14 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
         if self._mirror:
             mirror_deltas = np.asarray(deltas, dtype=np.float32)
             if _wire_mode() == "bf16":
-                # The freshness contract requires mirror == what the
-                # server applied; in bf16 mode that is the ROUNDED delta.
+                # The freshness contract wants mirror == what the server
+                # applied; in bf16 mode that is the ROUNDED delta. Adds
+                # then contribute ZERO mirror/server drift — the only
+                # residual is the one rounding of the priming pull (bf16
+                # reply of a possibly-unrepresentable server value), so
+                # total drift is bounded by one bf16 rounding of the
+                # primed magnitude, never accumulating per add. That is
+                # the precision the operator opted into with bf16 wire.
                 from multiverso_tpu.utils.quantization import (
                     bf16_bits_to_f32, f32_to_bf16_bits)
                 mirror_deltas = bf16_bits_to_f32(

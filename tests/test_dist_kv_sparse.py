@@ -413,11 +413,14 @@ def test_bsp_kv_identical_views(sync_two_rank_world):
             (i, views[0][i], views[1][i])
 
 
-def test_sparse_mirror_consistent_under_bf16_wire(two_rank_world):
+def test_sparse_mirror_bounded_drift_under_bf16_wire(two_rank_world):
     """-wire_compression=bf16 with a plain-add sparse table: the client
     mirrors the bf16-ROUNDED delta (what the server actually applied), so
-    mirror-fresh rows stay EXACTLY equal to server truth — no unbounded
-    mirror/server drift across repeated adds."""
+    repeated adds contribute ZERO mirror/server drift — the only residual
+    is ONE bf16 rounding of the priming pull. Adversarial order: a peer
+    first drives the table to a non-bf16-representable value, THEN the
+    writer primes and hammers adds; drift must stay bounded by that one
+    rounding, not grow with the add count."""
     from multiverso_tpu.utils.configure import set_flag
 
     svc0, svc1, peers = two_rank_world
@@ -427,22 +430,40 @@ def test_sparse_mirror_consistent_under_bf16_wire(two_rank_world):
     rng = np.random.default_rng(5)
     set_flag("wire_compression", "bf16")
     try:
-        m0.get(GetOption(worker_id=0))     # prime writer cache: all fresh
-        for _ in range(50):
-            m0.add_rows(np.arange(V, dtype=np.int32),
-                        rng.normal(size=(V, 4)).astype(np.float32) * 0.01,
+        # peer makes server truth non-representable in bf16 (1 + 2^-10)
+        m1.add_rows(np.arange(V, dtype=np.int32),
+                    np.full((V, 4), 1.0, np.float32), AddOption(worker_id=0))
+        m1.add_rows(np.arange(V, dtype=np.int32),
+                    np.full((V, 4), 2.0 ** -10, np.float32),
+                    AddOption(worker_id=0))
+        m0.get(GetOption(worker_id=0))     # prime: cache = round(truth)
+        deltas = rng.normal(size=(50, V, 4)).astype(np.float32) * 0.01
+        for d in deltas:
+            m0.add_rows(np.arange(V, dtype=np.int32), d,
                         AddOption(worker_id=0))
         # writer's view: mirror-fresh rows, served from its cache
-        mine = m0.get(GetOption(worker_id=0))
+        mine = np.asarray(m0.get(GetOption(worker_id=0)))
         assert m0.last_incremental_rows == 0   # cache hit, not re-shipped
-        # peer's view: everything re-pulled from server truth (bf16 reply
-        # of exact server values -> re-round server truth for comparison)
-        theirs = m1.get(GetOption(worker_id=0))
+        # The mechanism, asserted exactly: cache == round(prime) + the sum
+        # of ROUNDED deltas (what the server applied). Mirroring the raw
+        # f32 deltas (the old bug) diverges from this immediately.
         from multiverso_tpu.utils.quantization import (bf16_bits_to_f32,
                                                        f32_to_bf16_bits)
-        server_rounded = bf16_bits_to_f32(
-            f32_to_bf16_bits(mine)).reshape(mine.shape)
-        np.testing.assert_allclose(theirs, server_rounded, rtol=0, atol=0)
+        rnd = lambda a: bf16_bits_to_f32(  # noqa: E731
+            f32_to_bf16_bits(a)).reshape(np.shape(a))
+        expect = rnd(np.full((V, 4), 1.0 + 2.0 ** -10, np.float32))
+        for d in deltas:
+            expect = expect + rnd(d)
+        np.testing.assert_array_equal(mine, expect)
+        # ...and total drift vs exact f64 server truth is bounded by ~the
+        # ONE prime rounding plus per-add rounding noise, not growing
+        # 50x: the unrounded-mirror bug shows up as order-of-magnitude
+        # larger deviation from this bound on typical draws.
+        truth = np.full((V, 4), 1.0 + 2.0 ** -10, np.float64)
+        for d in deltas:
+            truth = truth + rnd(d).astype(np.float64)
+        assert np.abs(mine - truth).max() < 2.0 ** -9, \
+            np.abs(mine - truth).max()
     finally:
         set_flag("wire_compression", "sparse")
 
