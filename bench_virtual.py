@@ -87,6 +87,44 @@ def run_mesh_phase(mesh_data: int, mesh_model: int, tag: str) -> float:
         mv.shutdown()
 
 
+def run_matrix_phase() -> float:
+    """CPU-relative port of the reference perf harness shape
+    (Test/test_matrix_perf.cpp:45-80, scaled down): row-update throughput
+    through the table layer on the virtual mesh. Catches regressions in
+    the apply_rows path (dispatch, dedup, donation) between chip windows.
+    Prints updates/sec at 10% coverage as the last stdout line."""
+    import jax.numpy as jnp
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.core.options import AddOption
+
+    NROW, NCOL, ITERS = 200_000, 50, 5
+    mv.init([])
+    try:
+        table = mv.create_table(mv.MatrixTableOption(NROW, NCOL,
+                                                     name="vperf_matrix"))
+        store = table.store
+        rng = np.random.default_rng(1)
+        opt = AddOption()
+        n_rows = NROW // 10
+        row_sets = [jnp.asarray(rng.integers(0, NROW, size=n_rows)
+                                .astype(np.int32)) for _ in range(ITERS)]
+        delta = jnp.ones((n_rows, NCOL), dtype=jnp.float32)
+        store.apply_rows(row_sets[0], delta, opt)     # compile
+        store.block()
+        t0 = time.perf_counter()
+        for i in range(ITERS):
+            store.apply_rows(row_sets[i], delta, opt)
+        store.block()
+        dt = time.perf_counter() - t0
+        ups = ITERS * n_rows * NCOL / dt
+        _log(f"virtual matrix[10% of {NROW}x{NCOL}]: "
+             f"{ups:.3g} param updates/sec")
+        return ups
+    finally:
+        mv.shutdown()
+
+
 def _spawn_phase(phase: str, timeout_s: int = 1200):
     """Run one mesh phase as a subprocess; its words/sec is the last
     stdout line. Returns None (never a fake 0.0) when the phase fails,
@@ -192,8 +230,12 @@ def main() -> None:
     if phase == "single":
         print(run_mesh_phase(1, 1, "single CPU device"))
         return
+    if phase == "matrix":
+        print(run_matrix_phase())
+        return
 
     shard = bench_sharded_vs_single()
+    matrix = _spawn_phase("matrix", timeout_s=600)
     with tempfile.TemporaryDirectory() as td:
         dist = bench_distributed_2proc(td)
 
@@ -203,6 +245,8 @@ def main() -> None:
         "unit": "words/sec (8-device VIRTUAL CPU mesh — not chip-comparable)",
         "vs_baseline": 0.0,
         "secondary": {**shard, **dist,
+                      "matrix_updates_per_sec":
+                      round(matrix) if matrix else None,
                       "cpu_cores": os.cpu_count(),
                       "date": time.strftime("%Y-%m-%d %H:%M UTC",
                                             time.gmtime())},

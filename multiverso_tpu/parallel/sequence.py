@@ -21,6 +21,8 @@ design (no host involvement per step).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -35,6 +37,17 @@ def _pvary(x, axis):
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis, to="varying")
     return jax.lax.pvary(x, axis)
+
+
+def _resolve_flash(use_flash, sq: int, sk: int, d: int) -> bool:
+    """The ONE flash-kernel gate: flag default + tile-shape support.
+    Resolved in the WRAPPERS (shapes known pre-shard_map) so check_vma is
+    only relaxed when the Pallas kernel genuinely runs."""
+    if use_flash is None:
+        from multiverso_tpu.utils.configure import get_flag
+        use_flash = bool(get_flag("flash_attention"))
+    return bool(use_flash) and sq % 128 == 0 and sk % 128 == 0 \
+        and d % 8 == 0
 
 
 def _block_attn(q, k, v, scale, mask=None):
@@ -52,7 +65,8 @@ def _block_attn(q, k, v, scale, mask=None):
 
 def ring_attention_block(q_blk: jax.Array, k_blk: jax.Array,
                          v_blk: jax.Array, axis: str, n: int,
-                         causal: bool = False) -> jax.Array:
+                         causal: bool = False,
+                         use_flash: Optional[bool] = None) -> jax.Array:
     """The per-device ring-attention body, for use INSIDE a shard_map.
 
     ``q_blk/k_blk/v_blk``: this device's [B, H, S/n, D] sequence block on a
@@ -61,28 +75,49 @@ def ring_attention_block(q_blk: jax.Array, k_blk: jax.Array,
     composing PP x SP, ``parallel/pipeline.py``) can run ring attention
     without nesting shard_maps. :func:`ring_attention` is the standalone
     wrapper.
+
+    ``use_flash`` routes the local block step through the Pallas
+    flash kernel (``ops/pallas_attention.py`` — streams Sk tiles through
+    VMEM instead of materializing the [Sq, Sk] score block in HBM);
+    ``None`` reads the ``-flash_attention`` flag (default off until
+    on-chip timing adopts it, same protocol as the scatter kernels).
     """
+    use_flash = _resolve_flash(use_flash, q_blk.shape[2], k_blk.shape[2],
+                               q_blk.shape[3])
     scale = 1.0 / np.sqrt(q_blk.shape[-1])
     my = jax.lax.axis_index(axis)
     Sq = q_blk.shape[2]
 
     def body(carry, step):
         o_acc, m_acc, l_acc, k_cur, v_cur = carry
-        if causal:
-            # ppermute sends i -> i+1, so after `step` rotations this
-            # device holds the K/V block that originated on device
-            # (my - step) mod n.
-            k_blk_idx = jnp.mod(my - step, n)
-            q_pos = my * Sq + jnp.arange(Sq)[:, None]
-            k_pos = k_blk_idx * Sq + jnp.arange(Sq)[None, :]
-            # Finite large-negative (not -inf): a fully-masked row
-            # would otherwise produce exp(-inf - -inf) = nan in the
-            # streaming softmax; -1e30 underflows cleanly and the
-            # merge's beta factor zeroes the block's contribution.
-            mask = jnp.where(k_pos > q_pos, -1e30, 0.0)
+        # ppermute sends i -> i+1, so after `step` rotations this device
+        # holds the K/V block that originated on device (my - step) mod n.
+        k_blk_idx = jnp.mod(my - step, n)
+        if use_flash:
+            from multiverso_tpu.ops.pallas_attention import flash_block_attn
+            # Causal masking happens INSIDE the kernel from these global
+            # offsets — no [Sq, Sk] mask ever materializes in HBM.
+            offsets = jnp.stack([my * Sq, k_blk_idx * Sq]) \
+                .astype(jnp.int32)
+            o, m, l = flash_block_attn(
+                q_blk, k_cur, v_cur, scale=float(scale), causal=causal,
+                offsets=offsets,
+                interpret=jax.default_backend() == "cpu", vma=(axis,))
+            o = o.astype(q_blk.dtype)
+            m = m.astype(q_blk.dtype)
+            l = l.astype(q_blk.dtype)
         else:
-            mask = None
-        o, m, l = _block_attn(q_blk, k_cur, v_cur, scale, mask)
+            if causal:
+                q_pos = my * Sq + jnp.arange(Sq)[:, None]
+                k_pos = k_blk_idx * Sq + jnp.arange(Sq)[None, :]
+                # Finite large-negative (not -inf): a fully-masked row
+                # would otherwise produce exp(-inf - -inf) = nan in the
+                # streaming softmax; -1e30 underflows cleanly and the
+                # merge's beta factor zeroes the block's contribution.
+                mask = jnp.where(k_pos > q_pos, -1e30, 0.0)
+            else:
+                mask = None
+            o, m, l = _block_attn(q_blk, k_cur, v_cur, scale, mask)
         m_new = jnp.maximum(m_acc, m)
         alpha = jnp.exp(m_acc - m_new)
         beta = jnp.exp(m - m_new)
@@ -117,14 +152,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     holds K/V block ``(i + step) % n`` at step ``step``).
     """
     n = mesh.shape[axis]
+    blk = q.shape[2] // n
+    use_flash = _resolve_flash(None, blk, blk, q.shape[3])
 
     def local(q_blk, k_blk, v_blk):
         return ring_attention_block(q_blk, k_blk, v_blk, axis, n,
-                                    causal=causal)
+                                    causal=causal, use_flash=use_flash)
 
     spec = P(None, None, axis, None)
+    # check_vma off on the flash path: jax's interpret/lowering of a
+    # pallas_call inside shard_map mixes varying and unvarying internals
+    # (jax suggests exactly this workaround in the error it raises).
     fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+                       out_specs=spec, check_vma=not use_flash)
     return fn(q, k, v)
 
 
@@ -142,6 +182,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     """
     n = mesh.shape[axis]
     scale = 1.0 / np.sqrt(q.shape[-1])
+    # After the layout swap every device holds the FULL sequence.
+    use_flash = _resolve_flash(None, q.shape[2], q.shape[2], q.shape[3])
 
     def local(q_blk, k_blk, v_blk):
         # [B, H, S/n, D] -> [B, H/n, S, D]
@@ -154,18 +196,26 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                                       tiled=True)
 
         qh, kh, vh = seq_to_head(q_blk), seq_to_head(k_blk), seq_to_head(v_blk)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
-        if causal:
-            S = s.shape[-1]
-            mask = jnp.tril(jnp.ones((S, S), dtype=bool))
-            s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        S = qh.shape[2]
+        if use_flash:
+            from multiverso_tpu.ops.pallas_attention import flash_block_attn
+            # Causal mask computed in-kernel (offsets zero: full sequence).
+            o, _, l = flash_block_attn(
+                qh, kh, vh, scale=float(scale), causal=causal,
+                interpret=jax.default_backend() == "cpu", vma=(axis,))
+            o = (o / jnp.maximum(l, 1e-20)).astype(qh.dtype)
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+            if causal:
+                mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+                s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
         return head_to_seq(o)
 
     spec = P(None, None, axis, None)
     fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
+                       out_specs=spec, check_vma=not use_flash)
     return fn(q, k, v)
 
 

@@ -199,6 +199,9 @@ define_double("wire_compression_clip", 0.0, "SparseFilter clip threshold "
 define_string("mesh_shape", "", "comma 'axis:size' list, e.g. 'server:8'; "
               "empty = one axis over all devices")
 define_bool("deterministic", False, "force deterministic reductions")
+define_bool("flash_attention", False, "route ring attention's local block "
+            "step through the Pallas flash kernel (ops/pallas_attention); "
+            "off until on-chip timing adopts it")
 # Multi-controller bring-up (the Controller/RegisterNode analog,
 # ref src/controller.cpp:38-80 -> jax.distributed coordination service).
 define_string("coordinator", "", "host:port of the jax.distributed "

@@ -106,6 +106,9 @@ def main() -> None:
         run_phase("pallas", [py, "-c", (
             "import sys; sys.path.insert(0, '.');"
             "import bench; bench.bench_pallas_rows()")], 600)
+    if "flash" not in args.skip:
+        run_phase("flash", [py, os.path.join(HERE, "bench_flash_attn.py")],
+                  600)
     if "bench" not in args.skip:
         run_phase("bench", [py, os.path.join(REPO, "bench.py")], 2400)
     log("session complete — results in ONCHIP_RESULTS.txt")

@@ -1,0 +1,141 @@
+"""Flash block-attention kernel vs the XLA formulation (interpret mode on
+CPU — the same two-tier protocol as the scatter kernels: exact math here,
+on-chip timing decides adoption)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multiverso_tpu.ops.pallas_attention import flash_block_attn, supported
+from multiverso_tpu.parallel.sequence import (_block_attn, ring_attention,
+                                              ring_attention_block)
+
+
+def _qkv(rng, B=2, H=3, Sq=256, Sk=384, D=64, dtype=np.float32):
+    q = jnp.asarray(rng.normal(size=(B, H, Sq, D)).astype(dtype))
+    k = jnp.asarray(rng.normal(size=(B, H, Sk, D)).astype(dtype))
+    v = jnp.asarray(rng.normal(size=(B, H, Sk, D)).astype(dtype))
+    return q, k, v
+
+
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_flash_matches_xla_block_attn(with_bias):
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    Sq, Sk = q.shape[2], k.shape[2]
+    bias = None
+    if with_bias:
+        bias = jnp.where(jnp.arange(Sk)[None, :] >
+                         jnp.arange(Sq)[:, None] + 100,
+                         -1e30, 0.0).astype(jnp.float32)
+    o1, m1, l1 = _block_attn(q, k, v, scale, bias)
+    o2, m2, l2 = flash_block_attn(q, k, v, bias, scale=float(scale),
+                                  interpret=True)
+    # tile-order-dependent rounding only; normalized outputs agree tightly
+    np.testing.assert_allclose(np.asarray(o2 / jnp.maximum(l2, 1e-20)),
+                               np.asarray(o1 / jnp.maximum(l1, 1e-20)),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_array_equal(np.asarray(m2), np.asarray(m1))
+    np.testing.assert_allclose(np.asarray(l2), np.asarray(l1), rtol=2e-5)
+
+
+def test_flash_in_kernel_causal_offsets_match_materialized_mask():
+    """causal=True + offsets must equal the XLA path with the equivalent
+    materialized k_pos > q_pos mask — the ring-step contract, with the
+    mask never leaving the kernel."""
+    rng = np.random.default_rng(5)
+    q, k, v = _qkv(rng, Sq=128, Sk=256)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    Sq, Sk = q.shape[2], k.shape[2]
+    for q_off, k_off in ((0, 0), (384, 128), (128, 384)):
+        mask = jnp.where((k_off + jnp.arange(Sk))[None, :] >
+                         (q_off + jnp.arange(Sq))[:, None],
+                         -1e30, 0.0)
+        o1, m1, l1 = _block_attn(q, k, v, scale, mask)
+        o2, m2, l2 = flash_block_attn(
+            q, k, v, scale=float(scale), causal=True,
+            offsets=jnp.asarray([q_off, k_off], jnp.int32),
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(m2), np.asarray(m1))
+        np.testing.assert_allclose(
+            np.asarray(o2 / jnp.maximum(l2, 1e-20)),
+            np.asarray(o1 / jnp.maximum(l1, 1e-20)),
+            rtol=2e-5, atol=2e-6, err_msg=f"offsets {q_off},{k_off}")
+
+
+def test_flash_fully_masked_rows_match_xla_convention():
+    """A ring step whose K/V block is entirely future (causal) must mirror
+    _block_attn's -1e30 convention exactly: finite o/m/l with m ~= -1e30,
+    so the streaming merge's beta factor zeroes the block's contribution."""
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, Sq=128, Sk=128)
+    scale = 0.125
+    bias = jnp.full((128, 128), -1e30, dtype=jnp.float32)
+    o1, m1, l1 = _block_attn(q, k, v, scale, bias)
+    o2, m2, l2 = flash_block_attn(q, k, v, bias, scale=scale,
+                                  interpret=True)
+    for a in (o2, m2, l2):
+        assert np.isfinite(np.asarray(a)).all()
+    np.testing.assert_array_equal(np.asarray(m2), np.asarray(m1))
+    np.testing.assert_allclose(np.asarray(l2), np.asarray(l1), rtol=1e-6)
+    # merge-zeroable: beta = exp(m - m_merged) underflows for any real m
+    assert float(np.asarray(m2).max()) <= -1e29
+
+
+def test_supported_gate():
+    rng = np.random.default_rng(2)
+    q, k, _ = _qkv(rng)
+    assert supported(q, k)
+    q_bad, k_bad, _ = _qkv(rng, Sq=100, Sk=128)
+    assert not supported(q_bad, k_bad)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_flag_matches_xla_path(mv_env, causal):
+    """Ulysses with -flash_attention=true equals its dense-softmax path."""
+    import multiverso_tpu as mv
+    from jax.sharding import Mesh
+
+    from multiverso_tpu.parallel.sequence import ulysses_attention
+
+    rng = np.random.default_rng(4)
+    B, H, S, D = 1, 8, 512, 32
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("seq",))
+
+    ref = ulysses_attention(q, k, v, mesh, causal=causal)
+    mv.set_flag("flash_attention", True)
+    try:
+        got = ulysses_attention(q, k, v, mesh, causal=causal)
+    finally:
+        mv.set_flag("flash_attention", False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_flag_matches_xla_path(mv_env, causal):
+    """End to end on the 8-device mesh: ring attention with
+    -flash_attention=true equals the XLA path (both exact softmax)."""
+    import multiverso_tpu as mv
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(3)
+    B, H, S, D = 1, 2, 1024, 32
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("seq",))
+
+    ref = ring_attention(q, k, v, mesh, causal=causal)
+    mv.set_flag("flash_attention", True)
+    try:
+        got = ring_attention(q, k, v, mesh, causal=causal)
+    finally:
+        mv.set_flag("flash_attention", False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
