@@ -138,44 +138,92 @@ def load_all(checkpoint_dir: str) -> int:
     return int(meta["step"])
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
+def latest_checkpoint(directory: str, prefix: str = "ckpt",
+                      selector: str = "meta.json") -> Optional[str]:
+    """Newest COMPLETE checkpoint dir: ``<prefix>_<step>`` containing the
+    ``selector`` file (the durability marker its writer creates last — a
+    crash mid-save leaves a selectorless, never-restored directory).
+    Ordered by numeric step."""
     if not os.path.isdir(directory):
         return None
     candidates = sorted(
-        d for d in os.listdir(directory)
-        if re.fullmatch(r"ckpt_\d{12}", d) and
-        os.path.exists(os.path.join(directory, d, "meta.json")))
+        (d for d in os.listdir(directory)
+         if re.fullmatch(rf"{prefix}_\d{{12}}", d) and
+         os.path.exists(os.path.join(directory, d, selector))),
+        key=lambda d: int(d.split("_")[1]))
     if not candidates:
         return None
     return os.path.join(directory, candidates[-1])
 
 
 class CheckpointManager:
-    """Periodic save + retention + resume."""
+    """Periodic save + retention + resume.
+
+    ``backend="npz"`` (default) writes reference-style per-table npz
+    streams synchronously. ``backend="orbax"`` uses the async orbax
+    backend: ``maybe_save`` returns once device buffers are staged and
+    the storage write lands in background threads, so the periodic
+    trigger overlaps training; at most one save is in flight (the next
+    trigger — or ``finalize()`` — joins the previous one first)."""
 
     def __init__(self, directory: str, save_every_steps: int = 1000,
-                 keep_last: int = 3):
+                 keep_last: int = 3, backend: str = "npz"):
+        check(backend in ("npz", "orbax"), f"unknown backend {backend!r}")
         self.directory = directory
         self.save_every_steps = max(1, save_every_steps)
         self.keep_last = max(1, keep_last)
+        self.backend = backend
         self._last_saved_step = -1
+        self._pending = None     # in-flight orbax AsyncSaveHandle
 
     def maybe_save(self, step: int) -> Optional[str]:
+        """Returns the checkpoint root when a save was triggered. NOTE the
+        orbax backend's contract: the returned root is still being written
+        in the background and is DURABLE only once its ``manifest.json``
+        appears (written by the join at the next trigger or
+        ``finalize()``); restore paths select on that marker."""
         if step % self.save_every_steps != 0 or step == self._last_saved_step:
             return None
+        if self.backend == "orbax":
+            from multiverso_tpu.core import checkpoint_orbax as co
+            self._join_pending()          # at most one save in flight
+            handle = co.save_all_async(self.directory, step)
+            self._pending = handle
+            self._last_saved_step = step
+            return handle.root
         path = save_all(self.directory, step)
         self._last_saved_step = step
         self._prune()
         return path
 
+    def _join_pending(self) -> None:
+        if self._pending is not None:
+            self._pending.wait_until_finished()
+            self._pending = None
+            self._prune()
+
+    def finalize(self) -> None:
+        """Join the in-flight async save (call before shutdown/restore)."""
+        self._join_pending()
+
     def _prune(self) -> None:
         if not os.path.isdir(self.directory):
             return
+        # Numeric-step order, prefix-agnostic: a directory holding both
+        # backends' checkpoints must never retention-delete the NEWEST
+        # steps because of lexicographic prefix ordering.
         ckpts = sorted(
-            d for d in os.listdir(self.directory)
-            if re.fullmatch(r"ckpt_\d{12}", d))
+            (d for d in os.listdir(self.directory)
+             if re.fullmatch(r"(ckpt|orbax)_\d{12}", d)),
+            key=lambda d: int(d.split("_")[1]))
         for stale in ckpts[:-self.keep_last]:
             full = os.path.join(self.directory, stale)
+            if stale.startswith("orbax_"):
+                # Nested orbax tree; orbax's own commit markers are the
+                # selector, so recursive removal is safe.
+                import shutil
+                shutil.rmtree(full, ignore_errors=True)
+                continue
             try:   # concurrent ranks may prune the same shared directory
                 # Manifests go FIRST: latest_checkpoint selects on
                 # meta.json, so a crash (or racing rank) mid-prune leaves
@@ -192,6 +240,17 @@ class CheckpointManager:
                 pass
 
     def restore_latest(self) -> Optional[int]:
+        if self.backend == "orbax":
+            from multiverso_tpu.core import checkpoint_orbax as co
+            self._join_pending()
+            # manifest.json is the durability marker the async join writes
+            # LAST — an interrupted save has none and is never restored.
+            path = latest_checkpoint(self.directory, prefix="orbax",
+                                     selector="manifest.json")
+            if path is None:
+                return None
+            co.load_all(path)
+            return int(os.path.basename(path).split("_")[1])
         path = latest_checkpoint(self.directory)
         if path is None:
             return None

@@ -35,13 +35,21 @@ class AsyncSaveHandle:
     """In-flight checkpoint: device→host staging is complete when
     :func:`save_all_async` returns (so training may keep mutating tables),
     storage writes finish in background threads until
-    :meth:`wait_until_finished`."""
+    :meth:`wait_until_finished` — which, on success, writes the
+    ``manifest.json`` durability marker. A root WITHOUT a manifest is an
+    interrupted save and must never be restored (``latest_complete``
+    skips it)."""
 
-    def __init__(self, root: str, checkpointers: list) -> None:
+    def __init__(self, root: str, checkpointers: list,
+                 table_names=None) -> None:
         self.root = root
         self._ckptrs = checkpointers
+        self._tables = list(table_names or [])
 
     def wait_until_finished(self) -> str:
+        import json
+        import time as _time
+
         ckptrs, self._ckptrs = self._ckptrs, []
         first_error = None
         for ckptr in ckptrs:    # join + close EVERY writer even if one fails
@@ -56,6 +64,12 @@ class AsyncSaveHandle:
                     first_error = first_error or e
         if first_error is not None:
             raise first_error
+        if self._tables:        # durability marker: all writers landed
+            tmp = os.path.join(self.root, "manifest.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"tables": self._tables, "time": _time.time()}, f)
+            os.replace(tmp, os.path.join(self.root, "manifest.json"))
+            self._tables = []
         return self.root
 
 
@@ -76,9 +90,11 @@ def save_all_async(directory: str, step: int = 0) -> AsyncSaveHandle:
     check(zoo.started, "runtime not started")
     root = os.path.join(os.path.abspath(directory), f"orbax_{step:012d}")
     ckptrs = []
+    names = []
     try:
         for i, table in enumerate(zoo.tables):
             name = getattr(table, "name", f"table_{i}")
+            names.append(name)
             tree = _table_pytree(table)
             if tree is None:
                 # host-resident tables (KV): save via their own npz payload
@@ -95,13 +111,14 @@ def save_all_async(directory: str, step: int = 0) -> AsyncSaveHandle:
             ckptr.save(os.path.join(root, name), tree)
     except Exception:
         # Join + close writers already started; don't leak their threads
-        # (best-effort — the save error is the one worth raising).
+        # (best-effort — the save error is the one worth raising). No
+        # table_names: a failed save must never gain a manifest.
         try:
             AsyncSaveHandle(root, ckptrs).wait_until_finished()
         except Exception:  # noqa: BLE001
             pass
         raise
-    return AsyncSaveHandle(root, ckptrs)
+    return AsyncSaveHandle(root, ckptrs, table_names=names)
 
 
 def save_all(directory: str, step: int = 0) -> str:
