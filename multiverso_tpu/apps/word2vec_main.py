@@ -33,6 +33,9 @@ configure.define_bool("cbow", False, "CBOW instead of skip-gram")
 configure.define_bool("hs", False, "hierarchical softmax")
 configure.define_int("batch_size", 8192, "pairs per device minibatch")
 configure.define_bool("is_pipeline", True, "prefetch pipeline")
+configure.define_bool("param_prefetch", False,
+                      "distributed: double-buffered param pulls (one-block"
+                      " stale views; the reference's is_pipeline trade)")
 configure.define_int("data_block_size", 100000, "words per block")
 configure.define_string("w2v_optimizer", "adagrad", "adagrad|sgd")
 configure.define_bool("use_device_pipeline", True,
@@ -74,6 +77,7 @@ def _cfg_from_flags(device_pipeline: bool) -> "Word2VecConfig":
         optimizer=configure.get_flag("w2v_optimizer"),
         block_words=configure.get_flag("data_block_size"),
         pipeline=configure.get_flag("is_pipeline"),
+        param_prefetch=configure.get_flag("param_prefetch"),
         device_pipeline=(device_pipeline and
                          configure.get_flag("use_device_pipeline")),
         block_sentences=configure.get_flag("block_sentences"),

@@ -206,6 +206,11 @@ class CheckpointManager:
         """Join the in-flight async save (call before shutdown/restore)."""
         self._join_pending()
 
+    def _is_complete(self, name: str) -> bool:
+        selector = "manifest.json" if name.startswith("orbax_") \
+            else "meta.json"
+        return os.path.exists(os.path.join(self.directory, name, selector))
+
     def _prune(self) -> None:
         if not os.path.isdir(self.directory):
             return
@@ -216,7 +221,19 @@ class CheckpointManager:
             (d for d in os.listdir(self.directory)
              if re.fullmatch(r"(ckpt|orbax)_\d{12}", d)),
             key=lambda d: int(d.split("_")[1]))
-        for stale in ckpts[:-self.keep_last]:
+        # Only COMPLETE checkpoints (selector file present) count toward
+        # keep_last — an interrupted save must never displace a restorable
+        # one. Incomplete dirs older than the newest complete checkpoint
+        # are crash garbage and go too; NEWER incomplete dirs are left
+        # alone (a peer rank's save may be in flight on a shared dir).
+        complete = [d for d in ckpts if self._is_complete(d)]
+        stales = complete[:-self.keep_last]
+        if complete:
+            newest = int(complete[-1].split("_")[1])
+            stales += [d for d in ckpts
+                       if not self._is_complete(d)
+                       and int(d.split("_")[1]) < newest]
+        for stale in stales:
             full = os.path.join(self.directory, stale)
             if stale.startswith("orbax_"):
                 # Nested orbax tree; orbax's own commit markers are the

@@ -89,6 +89,15 @@ def save_all_async(directory: str, step: int = 0) -> AsyncSaveHandle:
     zoo = Zoo.get()
     check(zoo.started, "runtime not started")
     root = os.path.join(os.path.abspath(directory), f"orbax_{step:012d}")
+    if os.path.isdir(root):
+        # A leftover root for this step: either a crash-interrupted save
+        # (no manifest — the join writes it last) or a re-save after
+        # restore landed on the same step. Either way orbax refuses to
+        # write into an existing destination, so clear it.
+        import shutil
+        log.info("orbax: clearing leftover checkpoint root %s "
+                 "(interrupted save or re-saved step)", root)
+        shutil.rmtree(root, ignore_errors=True)
     ckptrs = []
     names = []
     try:

@@ -228,19 +228,52 @@ class DistributedWord2Vec:
                               rm_out(points), codes, lmask))
         return ids_in, ids_out, group
 
-    def _train_block(self, block: List[Sequence[int]]) -> int:
+    def _prepare_block(self, block: List[Sequence[int]]):
+        """Host-side stage: pair generation + touched-row collection."""
         batches = list(self.generator.batches(block))
         if not batches:
-            return 0
+            return None
         ids_in, ids_out, group = self._collect_and_remap(batches)
-        # Pull (RequestParameter analog); with sparse tables the pull is
-        # incremental — only rows re-staled since the last block ship.
-        local_in = self.w_in.get_rows(ids_in, self._pull_opt)
-        local_out = self.w_out.get_rows(ids_out, self._pull_opt)
+        return block, ids_in, ids_out, group
+
+    def _issue_pulls(self, prep) -> list:
+        """Fire ALL four pulls async — one round-trip window instead of
+        2-4 sequential ones (the reference's trainers overlap pulls the
+        same way, ps_model.cpp:236-271). Dense tables only."""
+        _, ids_in, ids_out, _ = prep
+        ops = [self.w_in.get_rows_async(ids_in),
+               self.w_out.get_rows_async(ids_out)]
+        if self._adagrad:
+            ops.append(self.g_in.get_rows_async(ids_in))
+            ops.append(self.g_out.get_rows_async(ids_out))
+        return ops
+
+    def _train_block(self, block: List[Sequence[int]]) -> int:
+        prep = self._prepare_block(block)
+        if prep is None:
+            return 0
+        ops = self._issue_pulls(prep) if self._pull_opt is None else None
+        return self._finish_block(prep, ops)
+
+    def _finish_block(self, prep, ops) -> int:
+        block, ids_in, ids_out, group = prep
+        # Sparse tables keep the sequential incremental protocol (keyed
+        # UpdateGetState is stateful per pull and only re-ships rows
+        # re-staled since the last one).
+        if ops is not None:
+            local_in = self.w_in.wait(ops[0])
+            local_out = self.w_out.wait(ops[1])
+        else:
+            local_in = self.w_in.get_rows(ids_in, self._pull_opt)
+            local_out = self.w_out.get_rows(ids_out, self._pull_opt)
         old_in, old_out = local_in.copy(), local_out.copy()
         if self._adagrad:
-            local_gin = self.g_in.get_rows(ids_in, self._pull_opt)
-            local_gout = self.g_out.get_rows(ids_out, self._pull_opt)
+            if ops is not None:
+                local_gin = self.g_in.wait(ops[2])
+                local_gout = self.g_out.wait(ops[3])
+            else:
+                local_gin = self.g_in.get_rows(ids_in, self._pull_opt)
+                local_gout = self.g_out.get_rows(ids_out, self._pull_opt)
             old_gin, old_gout = local_gin.copy(), local_gout.copy()
         else:
             local_gin = jnp.zeros_like(local_in)
@@ -316,14 +349,40 @@ class DistributedWord2Vec:
         self._maybe_master_init()
         t0 = time.perf_counter()
         n_blocks = 0
+        # Double-buffered param prefetch (cfg.param_prefetch): block N+1's
+        # pulls are in flight while block N computes. Async dense mode
+        # only — BSP needs strict per-worker op order and sparse pulls
+        # are stateful.
+        prefetch = (self.cfg.param_prefetch and self._pull_opt is None
+                    and not self.w_in._bsp)
+
+        def done_one(words: int) -> None:
+            nonlocal n_blocks
+            self.trained_words += words
+            self._sync_word_count()
+            n_blocks += 1
+            if on_block is not None:
+                on_block(n_blocks, self.trained_words)
+
         for _ in range(epochs):
-            for block in BlockStream(iter(sentences), self.cfg.block_words,
-                                     prefetch=self.cfg.pipeline):
-                self.trained_words += self._train_block(block)
-                self._sync_word_count()
-                n_blocks += 1
-                if on_block is not None:
-                    on_block(n_blocks, self.trained_words)
+            stream = BlockStream(iter(sentences), self.cfg.block_words,
+                                 prefetch=self.cfg.pipeline)
+            if prefetch:
+                pending = None
+                for block in stream:
+                    prep = self._prepare_block(block)
+                    if prep is None:
+                        done_one(0)     # block numbering parity with the
+                        continue        # non-prefetch path (on_block fires)
+                    ops = self._issue_pulls(prep)
+                    if pending is not None:
+                        done_one(self._finish_block(*pending))
+                    pending = (prep, ops)
+                if pending is not None:
+                    done_one(self._finish_block(*pending))
+            else:
+                for block in stream:
+                    done_one(self._train_block(block))
         # Drain staged pushes so peers (e.g. the saving master) see this
         # worker's last deltas after their barrier.
         for table in (self.w_in, self.w_out, self.g_in, self.g_out,

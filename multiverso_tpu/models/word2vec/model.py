@@ -60,6 +60,13 @@ class Word2VecConfig:
     optimizer: str = "adagrad"      # adagrad | sgd
     block_words: int = 100_000
     pipeline: bool = True
+    # Distributed mode: double-buffered param prefetch — issue block N+1's
+    # table pulls BEFORE computing block N, overlapping the PS round trip
+    # with device compute (the reference's is_pipeline GetAsync swap,
+    # ps_model.cpp:236-271 / distributed_wordembedding.cpp:203-212).
+    # Pulled views are >= one block stale (the documented pipeline trade);
+    # async dense tables only (BSP and sparse keep strict ordering).
+    param_prefetch: bool = False
     scan_group: int = 32            # minibatches per jitted scan dispatch
     # Embedding storage dtype: "float32" or "bfloat16" (math stays f32;
     # bf16 halves HBM bytes per gather/scatter — the dominant cost).

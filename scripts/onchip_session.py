@@ -109,6 +109,9 @@ def main() -> None:
     if "flash" not in args.skip:
         run_phase("flash", [py, os.path.join(HERE, "bench_flash_attn.py")],
                   600)
+    if "batchsweep" not in args.skip:
+        run_phase("batchsweep",
+                  [py, os.path.join(HERE, "bench_batch_sweep.py")], 1200)
     if "bench" not in args.skip:
         run_phase("bench", [py, os.path.join(REPO, "bench.py")], 2400)
     log("session complete — results in ONCHIP_RESULTS.txt")

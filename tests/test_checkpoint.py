@@ -177,6 +177,44 @@ def test_checkpoint_manager_orbax_async_backend(tmp_path, mv_env):
     np.testing.assert_allclose(m.get(), snapshots[step])
 
 
+def test_orbax_crash_recovery_resave_and_retention(tmp_path, mv_env):
+    """The two crash-path regressions: (1) resuming after an interrupted
+    save must be able to RE-SAVE the same step (the leftover manifest-less
+    root is cleared, orbax would otherwise refuse the existing
+    destination); (2) retention must count only COMPLETE checkpoints
+    toward keep_last — a newer manifest-less leftover must neither
+    displace a manifested checkpoint nor be selected by restore."""
+    from multiverso_tpu.core.checkpoint import CheckpointManager
+
+    a = mv.create_table(mv.ArrayTableOption(size=32, name="crash_a"))
+    mgr = CheckpointManager(str(tmp_path), save_every_steps=2, keep_last=1,
+                            backend="orbax")
+    a.add(np.ones(32, dtype=np.float32))
+    assert mgr.maybe_save(2)
+    mgr.finalize()
+    snap = np.asarray(a.get())
+
+    # crash-interrupted save at step 4: root exists, no manifest
+    os.makedirs(tmp_path / "orbax_000000000004" / "crash_a")
+    # prune (via a later join) must keep manifested step 2, not count 4
+    mgr._prune()
+    assert (tmp_path / "orbax_000000000002" / "manifest.json").exists()
+    # restore ignores the leftover and recovers step 2
+    a.add(np.ones(32, dtype=np.float32))
+    assert mgr.restore_latest() == 2
+    np.testing.assert_allclose(a.get(), snap)
+    # ...and re-saving step 4 after resume succeeds (leftover cleared)
+    mgr._last_saved_step = -1
+    a.add(np.ones(32, dtype=np.float32))
+    assert mgr.maybe_save(4)
+    mgr.finalize()
+    assert (tmp_path / "orbax_000000000004" / "manifest.json").exists()
+    # older incomplete garbage is pruned once a newer complete one exists
+    os.makedirs(tmp_path / "orbax_000000000003")
+    mgr._prune()
+    assert not (tmp_path / "orbax_000000000003").exists()
+
+
 def test_orbax_async_save_overlaps_training(tmp_path, mv_env):
     """``save_all_async`` returns after device→host staging; training adds
     issued while the write is in flight must NOT leak into the checkpoint

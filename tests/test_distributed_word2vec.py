@@ -407,6 +407,50 @@ def test_fault_drill_bsp_finish_train_unblocks_survivors(tmp_path):
         assert stats["words"] > 0
 
 
+def test_two_rank_param_prefetch_pipeline(mv_env):
+    """param_prefetch=True: block N+1's pulls are in flight while block N
+    computes (the reference's is_pipeline double buffer). Views are one
+    block stale by design — training must still separate topics and both
+    ranks converge to the same table."""
+    sents = _corpus(300)
+    d = Dictionary.build(sents, min_count=1)
+    ids = [d.encode(s) for s in sents]
+    cfg = Word2VecConfig(embedding_size=32, batch_size=256, window=4,
+                         negative=5, min_count=1, sample=0, sg=True,
+                         epochs=3, learning_rate=0.1, block_words=500,
+                         pipeline=False, seed=3, optimizer="adagrad",
+                         param_prefetch=True)
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    try:
+        w0 = DistributedWord2Vec(cfg, d, svc0, peers, rank=0)
+        w1 = DistributedWord2Vec(cfg, d, svc1, peers, rank=1)
+        threads = [
+            threading.Thread(target=w0.train, args=(ids[0::2],)),
+            threading.Thread(target=w1.train, args=(ids[1::2],)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "prefetch training hung"
+        # small blocks -> the double buffer actually cycled many times
+        assert w0.trained_words == sum(len(s) for s in ids[0::2]) * 3
+        emb = w0.embeddings()
+        emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-12)
+        a_ids = [d.word2id[w] for w in d.words if w.startswith("a")]
+        b_ids = [d.word2id[w] for w in d.words if w.startswith("b")]
+        intra = np.mean([emb[i] @ emb[j]
+                         for i in a_ids for j in a_ids if i != j])
+        inter = np.mean([emb[i] @ emb[j] for i in a_ids for j in b_ids])
+        assert intra > inter + 0.1, f"intra={intra:.3f} inter={inter:.3f}"
+        np.testing.assert_allclose(w1.embeddings(), w0.embeddings(),
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        svc0.close()
+        svc1.close()
+
+
 def test_two_rank_distributed_bf16_wire(mv_env):
     """-wire_compression=bf16: every pull/push crosses the wire as bf16
     halves (half the DCN bytes of f32) and training still separates
