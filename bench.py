@@ -96,9 +96,10 @@ def bench_word2vec() -> tuple:
     sentences = [rng.choice(vocab_size, size=sent_len, p=zipf)
                  .astype(np.int32) for _ in range(n_sent)]
 
-    def run(param_dtype: str, compact: bool = True) -> tuple:
+    def run(param_dtype: str, compact: bool = True,
+            batch_size: int = 8192) -> tuple:
         cfg = Word2VecConfig(embedding_size=128, window=5, negative=5,
-                             batch_size=8192, sample=1e-3, sg=True,
+                             batch_size=batch_size, sample=1e-3, sg=True,
                              hs=False, optimizer="adagrad", epochs=1,
                              pipeline=True, device_pipeline=True,
                              block_sentences=512, pad_sentence_length=512,
@@ -113,7 +114,8 @@ def bench_word2vec() -> tuple:
         roof = _sg_ns_roofline(pair_rate, D=128, K=5,
                                param_bytes=2 if param_dtype == "bfloat16"
                                else 4)
-        _log(f"word2vec[{param_dtype}{'' if compact else ',nocompact'}]: "
+        _log(f"word2vec[{param_dtype}{'' if compact else ',nocompact'}"
+             f"{',b' + str(batch_size) if batch_size != 8192 else ''}]: "
              f"{stats['words']} words in {stats['seconds']:.2f}s -> "
              f"{stats['words_per_sec']:.0f} words/sec "
              f"({pair_rate:.3g} pairs/sec, "
@@ -123,6 +125,21 @@ def bench_word2vec() -> tuple:
         return stats["words_per_sec"], roof
 
     headline, roofline = run("float32")
+    # Larger chunks may amortize the known in-loop de-optimization
+    # (ROADMAP perf #2) as a pure config win: the HEADLINE is the best
+    # f32 configuration (the framework's best throughput — per-config
+    # numbers all land in the evidence log and the JSON secondary).
+    batch_sweep = {"w2v_words_per_sec_b8192": round(headline, 1)}
+    for batch in (32_768, 65_536):
+        try:
+            wps, roof = run("float32", batch_size=batch)
+            batch_sweep[f"w2v_words_per_sec_b{batch}"] = round(wps, 1)
+            if wps > headline:
+                headline, roofline = wps, roof
+                roofline = dict(roofline, headline_batch_size=batch)
+        except Exception as e:  # noqa: BLE001 - sweep is best-effort
+            _log(f"batch={batch} sweep skipped: {e}")
+    roofline = dict(roofline, **batch_sweep)
     for dtype, compact in (("bfloat16", True), ("float32", False)):
         try:
             wps, _ = run(dtype, compact)

@@ -233,6 +233,11 @@ class CheckpointManager:
             stales += [d for d in ckpts
                        if not self._is_complete(d)
                        and int(d.split("_")[1]) < newest]
+            # Crashed orbax staging dirs (never committed by the atomic
+            # rename) older than the newest complete checkpoint.
+            stales += [d for d in os.listdir(self.directory)
+                       if re.fullmatch(r"orbax_\d{12}\.tmp-\d+", d)
+                       and int(d.split("_")[1].split(".")[0]) < newest]
         for stale in stales:
             full = os.path.join(self.directory, stale)
             if stale.startswith("orbax_"):

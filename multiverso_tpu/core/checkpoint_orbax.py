@@ -35,19 +35,25 @@ class AsyncSaveHandle:
     """In-flight checkpoint: device→host staging is complete when
     :func:`save_all_async` returns (so training may keep mutating tables),
     storage writes finish in background threads until
-    :meth:`wait_until_finished` — which, on success, writes the
-    ``manifest.json`` durability marker. A root WITHOUT a manifest is an
-    interrupted save and must never be restored (``latest_complete``
-    skips it)."""
+    :meth:`wait_until_finished`.
 
-    def __init__(self, root: str, checkpointers: list,
+    Commit protocol: all writers target a ``<root>.tmp-<pid>`` staging
+    dir; the join writes the ``manifest.json`` durability marker INSIDE
+    the staging dir and only then renames it to the final root — so the
+    commit is one atomic rename, a crash at ANY earlier point leaves any
+    previous checkpoint for this step untouched, and a root with a
+    manifest is complete by construction (restore selects on it)."""
+
+    def __init__(self, root: str, staging: str, checkpointers: list,
                  table_names=None) -> None:
         self.root = root
+        self._staging = staging
         self._ckptrs = checkpointers
         self._tables = list(table_names or [])
 
     def wait_until_finished(self) -> str:
         import json
+        import shutil
         import time as _time
 
         ckptrs, self._ckptrs = self._ckptrs, []
@@ -64,11 +70,16 @@ class AsyncSaveHandle:
                     first_error = first_error or e
         if first_error is not None:
             raise first_error
-        if self._tables:        # durability marker: all writers landed
-            tmp = os.path.join(self.root, "manifest.json.tmp")
+        if self._tables:        # commit: manifest into staging, then swap
+            tmp = os.path.join(self._staging, "manifest.json.tmp")
             with open(tmp, "w") as f:
                 json.dump({"tables": self._tables, "time": _time.time()}, f)
-            os.replace(tmp, os.path.join(self.root, "manifest.json"))
+            os.replace(tmp, os.path.join(self._staging, "manifest.json"))
+            if os.path.isdir(self.root):
+                # Same-step re-save (resume path): the old copy goes only
+                # now, with the replacement fully durable in staging.
+                shutil.rmtree(self.root, ignore_errors=True)
+            os.replace(self._staging, self.root)
             self._tables = []
         return self.root
 
@@ -89,15 +100,13 @@ def save_all_async(directory: str, step: int = 0) -> AsyncSaveHandle:
     zoo = Zoo.get()
     check(zoo.started, "runtime not started")
     root = os.path.join(os.path.abspath(directory), f"orbax_{step:012d}")
-    if os.path.isdir(root):
-        # A leftover root for this step: either a crash-interrupted save
-        # (no manifest — the join writes it last) or a re-save after
-        # restore landed on the same step. Either way orbax refuses to
-        # write into an existing destination, so clear it.
+    # All writes go to a pid-scoped staging dir; the join commits it to
+    # ``root`` with one atomic rename (see AsyncSaveHandle). A leftover
+    # staging dir from OUR pid pattern is a dead prior attempt.
+    staging = f"{root}.tmp-{os.getpid()}"
+    if os.path.isdir(staging):
         import shutil
-        log.info("orbax: clearing leftover checkpoint root %s "
-                 "(interrupted save or re-saved step)", root)
-        shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(staging, ignore_errors=True)
     ckptrs = []
     names = []
     try:
@@ -107,8 +116,8 @@ def save_all_async(directory: str, step: int = 0) -> AsyncSaveHandle:
             tree = _table_pytree(table)
             if tree is None:
                 # host-resident tables (KV): save via their own npz payload
-                os.makedirs(root, exist_ok=True)
-                np.savez(os.path.join(root, f"{name}.npz"),
+                os.makedirs(staging, exist_ok=True)
+                np.savez(os.path.join(staging, f"{name}.npz"),
                          **table.store_state())
                 continue
             # One checkpointer per table so background writes proceed in
@@ -117,17 +126,17 @@ def save_all_async(directory: str, step: int = 0) -> AsyncSaveHandle:
             # joined/closed by the except path below.
             ckptr = ocp.StandardCheckpointer()
             ckptrs.append(ckptr)
-            ckptr.save(os.path.join(root, name), tree)
+            ckptr.save(os.path.join(staging, name), tree)
     except Exception:
         # Join + close writers already started; don't leak their threads
         # (best-effort — the save error is the one worth raising). No
-        # table_names: a failed save must never gain a manifest.
+        # table_names: a failed save must never commit.
         try:
-            AsyncSaveHandle(root, ckptrs).wait_until_finished()
+            AsyncSaveHandle(root, staging, ckptrs).wait_until_finished()
         except Exception:  # noqa: BLE001
             pass
         raise
-    return AsyncSaveHandle(root, ckptrs, table_names=names)
+    return AsyncSaveHandle(root, staging, ckptrs, table_names=names)
 
 
 def save_all(directory: str, step: int = 0) -> str:
