@@ -228,16 +228,33 @@ class CheckpointManager:
         # alone (a peer rank's save may be in flight on a shared dir).
         complete = [d for d in ckpts if self._is_complete(d)]
         stales = complete[:-self.keep_last]
-        if complete:
-            newest = int(complete[-1].split("_")[1])
+        newest = int(complete[-1].split("_")[1]) if complete else None
+        if newest is not None:
             stales += [d for d in ckpts
                        if not self._is_complete(d)
                        and int(d.split("_")[1]) < newest]
-            # Crashed orbax staging dirs (never committed by the atomic
-            # rename) older than the newest complete checkpoint.
-            stales += [d for d in os.listdir(self.directory)
-                       if re.fullmatch(r"orbax_\d{12}\.tmp-\d+", d)
-                       and int(d.split("_")[1].split(".")[0]) < newest]
+        for d in os.listdir(self.directory):
+            m = re.fullmatch(r"orbax_(\d{12})\.tmp-\d+", d)
+            if not m:
+                continue
+            full = os.path.join(self.directory, d)
+            step = int(m.group(1))
+            if os.path.exists(os.path.join(full, "manifest.json")):
+                # Manifested staging (crash between manifest and rename):
+                # restorable, so keep it until a committed root of the
+                # same-or-newer step supersedes it.
+                if newest is not None and step <= newest:
+                    stales.append(d)
+            else:
+                # Manifest-less staging: a dead save — but only if it is
+                # actually dead (age gate: a LIVE save of a concurrent
+                # process also looks like this on a shared directory).
+                try:
+                    age = time.time() - os.path.getmtime(full)
+                except OSError:
+                    continue
+                if age > 900:
+                    stales.append(d)
         for stale in stales:
             full = os.path.join(self.directory, stale)
             if stale.startswith("orbax_"):
@@ -261,18 +278,32 @@ class CheckpointManager:
             except OSError:
                 pass
 
+    def _orbax_candidates(self):
+        """Every MANIFESTED orbax dir — committed roots AND manifested
+        staging dirs (a crash between 'manifest written' and 'rename
+        landed' leaves the complete checkpoint under its staging name;
+        the manifest, not the name, is the durability marker). Returns
+        [(step, is_plain_root, name)]."""
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for d in os.listdir(self.directory):
+            m = re.fullmatch(r"orbax_(\d{12})(\.tmp-\d+)?", d)
+            if m and os.path.exists(os.path.join(self.directory, d,
+                                                 "manifest.json")):
+                out.append((int(m.group(1)), m.group(2) is None, d))
+        return out
+
     def restore_latest(self) -> Optional[int]:
         if self.backend == "orbax":
             from multiverso_tpu.core import checkpoint_orbax as co
             self._join_pending()
-            # manifest.json is the durability marker the async join writes
-            # LAST — an interrupted save has none and is never restored.
-            path = latest_checkpoint(self.directory, prefix="orbax",
-                                     selector="manifest.json")
-            if path is None:
+            cands = self._orbax_candidates()
+            if not cands:
                 return None
-            co.load_all(path)
-            return int(os.path.basename(path).split("_")[1])
+            step, _, name = max(cands)   # newest step; plain root wins ties
+            co.load_all(os.path.join(self.directory, name))
+            return step
         path = latest_checkpoint(self.directory)
         if path is None:
             return None

@@ -75,9 +75,12 @@ class AsyncSaveHandle:
             with open(tmp, "w") as f:
                 json.dump({"tables": self._tables, "time": _time.time()}, f)
             os.replace(tmp, os.path.join(self._staging, "manifest.json"))
+            # From here the STAGING dir is itself a complete, manifested,
+            # restorable checkpoint (restore selection accepts manifested
+            # ``.tmp-`` dirs exactly for the crash windows below), so the
+            # old same-step copy may go and the rename may land in any
+            # order without ever leaving zero restorable copies.
             if os.path.isdir(self.root):
-                # Same-step re-save (resume path): the old copy goes only
-                # now, with the replacement fully durable in staging.
                 shutil.rmtree(self.root, ignore_errors=True)
             os.replace(self._staging, self.root)
             self._tables = []

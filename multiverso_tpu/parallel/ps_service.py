@@ -1119,7 +1119,8 @@ class DistributedTableBase:
     RETRY_WINDOW = 15.0      # rediscovery window for a restarting peer
 
     def __init__(self, table_id: int, service: PSService,
-                 peers: List[Tuple[str, int]], rank: int):
+                 peers: List[Tuple[str, int]], rank: int,
+                 announce: bool = True):
         self.table_id = table_id
         self.rank = rank
         self.world = len(peers)
@@ -1143,8 +1144,14 @@ class DistributedTableBase:
         # replicated on every service): a restarted rank re-registers its
         # new address with every live peer and traffic rediscovers it on
         # the next failed request — no manual reconnect(), any seat may
-        # die, rank 0 included.
-        service.enable_directory(rank, peers)
+        # die, rank 0 included. ``announce=False`` defers the
+        # registration: a RESTARTING seat must restore its shard
+        # checkpoint FIRST and only then announce (call
+        # ``service.enable_directory(rank, peers)``) — announcing early
+        # lets a peer's retried add land on the fresh shard and be
+        # OVERWRITTEN by the restore, silently losing an acked write.
+        if announce:
+            service.enable_directory(rank, peers)
         self._op_lock = threading.RLock()
         self._pending: "collections.OrderedDict[int, _PendingOp]" = \
             collections.OrderedDict()
@@ -1448,8 +1455,9 @@ class DistributedArrayTable(DistributedTableBase):
 
     def __init__(self, table_id: int, size: int,
                  service: PSService, peers: List[Tuple[str, int]],
-                 rank: int, dtype=np.float32, updater: str = "default"):
-        super().__init__(table_id, service, peers, rank)
+                 rank: int, dtype=np.float32, updater: str = "default",
+                 announce: bool = True):
+        super().__init__(table_id, service, peers, rank, announce=announce)
         self.name = f"dist_array_{table_id}"
         self.size = size
         self.offsets = reference_server_offsets(size, self.world)
@@ -1588,8 +1596,9 @@ class DistributedMatrixTable(DistributedTableBase):
 
     def __init__(self, table_id: int, num_row: int, num_col: int,
                  service: PSService, peers: List[Tuple[str, int]],
-                 rank: int, dtype=np.float32, updater: str = "default"):
-        super().__init__(table_id, service, peers, rank)
+                 rank: int, dtype=np.float32, updater: str = "default",
+                 announce: bool = True):
+        super().__init__(table_id, service, peers, rank, announce=announce)
         self.name = f"dist_matrix_{table_id}"
         self.num_row = num_row
         self.num_col = num_col
@@ -1826,8 +1835,9 @@ class DistributedKVTable(DistributedTableBase):
     round-3 gap). Checkpointing rides the standard per-rank shard path."""
 
     def __init__(self, table_id: int, service: PSService,
-                 peers: List[Tuple[str, int]], rank: int, dtype=np.int64):
-        super().__init__(table_id, service, peers, rank)
+                 peers: List[Tuple[str, int]], rank: int, dtype=np.int64,
+                 announce: bool = True):
+        super().__init__(table_id, service, peers, rank, announce=announce)
         self.name = f"dist_kv_{table_id}"
         self.value_dtype = np.dtype(dtype)
         self.local_store = KVServerStore(self.name, dtype)
@@ -1939,7 +1949,8 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
 
     def __init__(self, table_id: int, num_row: int, num_col: int,
                  service: PSService, peers: List[Tuple[str, int]],
-                 rank: int, dtype=np.float32, updater: str = "default"):
+                 rank: int, dtype=np.float32, updater: str = "default",
+                 announce: bool = True):
         # Bitmap semantics are always the reference's loose UpdateAddState
         # (_SparseShardState docstring). Plain-add clients ADDITIONALLY
         # mirror their own delta into their cache so rows that were fresh
@@ -1954,7 +1965,7 @@ class DistributedSparseMatrixTable(DistributedMatrixTable):
         self._incr_cache: Dict[int, np.ndarray] = {}
         self.last_incremental_rows = 0   # observability (tests/monitor)
         super().__init__(table_id, num_row, num_col, service, peers, rank,
-                         dtype=dtype, updater=updater)
+                         dtype=dtype, updater=updater, announce=announce)
         self.name = f"dist_sparse_matrix_{table_id}"
         from multiverso_tpu.core.updater import Updater
         self._mirror = type(self.local_store.updater) is Updater

@@ -215,6 +215,42 @@ def test_orbax_crash_recovery_resave_and_retention(tmp_path, mv_env):
     assert not (tmp_path / "orbax_000000000003").exists()
 
 
+def test_orbax_manifested_staging_is_restorable(tmp_path, mv_env):
+    """Crash between 'manifest written' and 'rename landed': the complete
+    checkpoint sits under its staging name. Restore must select it (the
+    manifest, not the name, is the durability marker), and prune must
+    keep it until a committed root supersedes it."""
+    import shutil
+
+    from multiverso_tpu.core.checkpoint import CheckpointManager
+
+    a = mv.create_table(mv.ArrayTableOption(size=16, name="stage_a"))
+    mgr = CheckpointManager(str(tmp_path), save_every_steps=1, keep_last=2,
+                            backend="orbax")
+    a.add(np.ones(16, dtype=np.float32))
+    mgr.maybe_save(1)
+    mgr.finalize()
+    a.add(np.ones(16, dtype=np.float32))          # state for "step 3"
+    mgr._last_saved_step = -1
+    mgr.maybe_save(3)
+    mgr.finalize()
+    # simulate the crash window: step-3 commit exists only as manifested
+    # staging (rename never landed)
+    shutil.move(str(tmp_path / "orbax_000000000003"),
+                str(tmp_path / "orbax_000000000003.tmp-99999"))
+    mgr._prune()                                  # must NOT delete it
+    assert (tmp_path / "orbax_000000000003.tmp-99999").exists()
+    a.add(np.ones(16, dtype=np.float32))          # drift
+    assert mgr.restore_latest() == 3
+    np.testing.assert_allclose(a.get(), 2.0)
+    # a committed root at the same-or-newer step supersedes the staging
+    mgr._last_saved_step = -1
+    mgr.maybe_save(4)
+    mgr.finalize()
+    mgr._prune()
+    assert not (tmp_path / "orbax_000000000003.tmp-99999").exists()
+
+
 def test_orbax_async_save_overlaps_training(tmp_path, mv_env):
     """``save_all_async`` returns after device→host staging; training adds
     issued while the write is in flight must NOT leak into the checkpoint

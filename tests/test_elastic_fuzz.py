@@ -28,12 +28,18 @@ TABLE = 400
 
 
 def _seat(rank, peers, restore_uri=None):
+    """Restart protocol: restore the shard FIRST, announce to the
+    directory SECOND (announce=False + enable_directory) — announcing
+    early would let a peer's retried add land on the fresh shard and be
+    overwritten by the restore (an acked-write loss this fuzz caught)."""
     svc = PSService()
     peers = list(peers)
     peers[rank] = svc.address
-    table = DistributedArrayTable(TABLE, SIZE, svc, peers, rank=rank)
+    table = DistributedArrayTable(TABLE, SIZE, svc, peers, rank=rank,
+                                  announce=False)
     if restore_uri:
         ckpt.load_table(table, restore_uri)
+    svc.enable_directory(rank, peers)
     return svc, table, peers
 
 
@@ -100,3 +106,47 @@ def test_rolling_restart_fuzz(mv_env, tmp_path):
     np.testing.assert_allclose(got1, acked, rtol=0, atol=0)
     for s in services:
         s.close()
+
+
+def test_restart_restore_before_announce_keeps_acked_writes(mv_env,
+                                                            tmp_path):
+    """The acked-write-loss race the fuzz caught, pinned deterministically:
+    while a seat is down, a peer's add sits in the directory-retry loop.
+    If the restarted seat announced BEFORE restoring, that add could land
+    on the fresh shard and be overwritten by the restore. With
+    announce=False + restore + enable_directory, the un-announced seat is
+    unreachable until its state is back, so the acked add survives."""
+    import time as _time
+
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    t0 = DistributedArrayTable(90, 40, svc0, peers, rank=0)
+    t1 = DistributedArrayTable(90, 40, svc1, peers, rank=1)
+    t0.add(np.full(40, 2.0, dtype=np.float32))
+    uri = f"file://{tmp_path}/seat1.npz"
+    ckpt.save_table(t1, uri)
+    svc1.close()
+
+    done = []
+
+    def bg_add():
+        t0.add(np.ones(40, dtype=np.float32))   # retries until reachable
+        done.append(True)
+
+    th = threading.Thread(target=bg_add)
+    th.start()
+    _time.sleep(1.0)                 # the add is now in the retry loop
+    svc1b = PSService()
+    peers2 = [peers[0], svc1b.address]
+    t1b = DistributedArrayTable(90, 40, svc1b, peers2, rank=1,
+                                announce=False)
+    ckpt.load_table(t1b, uri)
+    _time.sleep(1.0)
+    assert not done, "un-announced seat must not be discoverable"
+    svc1b.enable_directory(1, peers2)
+    th.join(timeout=30)
+    assert done, "add never landed after announce"
+    np.testing.assert_allclose(t0.get(), 3.0)   # baseline 2 + acked 1
+    np.testing.assert_allclose(t1b.get(), 3.0)
+    svc1b.close()
+    svc0.close()

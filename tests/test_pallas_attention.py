@@ -84,6 +84,25 @@ def test_flash_fully_masked_rows_match_xla_convention():
     assert float(np.asarray(m2).max()) <= -1e29
 
 
+def test_flash_bf16_inputs_compute_in_f32():
+    """bf16 q/k/v (the TPU training dtype): kernel math runs f32 and must
+    match the XLA path computed on the same bf16 inputs."""
+    rng = np.random.default_rng(6)
+    q, k, v = _qkv(rng, Sq=128, Sk=256, D=64)
+    q = q.astype(jnp.bfloat16)
+    k = k.astype(jnp.bfloat16)
+    v = v.astype(jnp.bfloat16)
+    scale = 0.125
+    o1, m1, l1 = _block_attn(q.astype(jnp.float32),
+                             k.astype(jnp.float32),
+                             v.astype(jnp.float32), scale, None)
+    o2, m2, l2 = flash_block_attn(q, k, v, scale=scale, interpret=True)
+    np.testing.assert_allclose(np.asarray(o2 / jnp.maximum(l2, 1e-20)),
+                               np.asarray(o1 / jnp.maximum(l1, 1e-20)),
+                               rtol=2e-5, atol=2e-6)
+    assert o2.dtype == jnp.float32    # stats/output stay full precision
+
+
 def test_supported_gate():
     rng = np.random.default_rng(2)
     q, k, _ = _qkv(rng)
