@@ -217,6 +217,7 @@ class PSService:
         self._listener.listen(128)
         self.address = self._listener.getsockname()
         self._running = True
+        self._reg_stop = threading.Event()   # interrupts registration retry
         self._selector = selectors.DefaultSelector()
         self._selector.register(self._listener, selectors.EVENT_READ, None)
         self._decoders: Dict[socket.socket, bytearray] = {}
@@ -746,28 +747,45 @@ class PSService:
             for r, addr in enumerate(peers):
                 self._directory.setdefault(r, tuple(addr))
             self._directory[rank] = tuple(self.address)
-        # Fan the registrations out CONCURRENTLY with a short budget:
-        # serial 10s connects to not-yet-listening cross-host peers would
-        # block table construction for minutes on a cold start. Stragglers
-        # finish in the background (daemon threads) — registration is
-        # best-effort either way, the static seed list covers the start.
+        # Fan the registrations out CONCURRENTLY with a short foreground
+        # budget: serial 10s connects to not-yet-listening cross-host
+        # peers would block table construction for minutes on a cold
+        # start. Stragglers keep RETRYING in the background (daemon
+        # threads) until acked or the service closes — a RESTARTED seat's
+        # registration is the only way peers rediscover it, and one 3s
+        # shot dies under load (a busy dispatcher can take >3s to ack,
+        # silently stranding every peer's retry loop on the dead
+        # address; caught by the BSP fault drill under a loaded box).
         threads = []
         for r, addr in enumerate(peers):
             if r == rank:
                 continue
 
             def reg(r=r, addr=tuple(addr)):
-                try:
-                    self._register_with(addr, timeout=3)
-                except OSError as e:
-                    log.warning("directory registration with rank %d "
-                                "failed: %s", r, e)
+                deadline = time.monotonic() + 600.0
+                delay = 1.0
+                while self._running and time.monotonic() < deadline:
+                    # Re-resolve each attempt: the peer may itself have
+                    # re-registered at a new address mid-loop.
+                    target = self.lookup(r) or addr
+                    try:
+                        if not self._running:   # close() raced us: a
+                            return              # dead seat must not
+                        self._register_with(target, timeout=10)   # re-add
+                        return                  # its address to peers
+                    except OSError as e:
+                        log.warning("directory registration with rank %d "
+                                    "failed (retrying): %s", r, e)
+                    # Event, not sleep: close() interrupts the backoff.
+                    if self._reg_stop.wait(delay):
+                        return
+                    delay = min(delay * 2, 10.0)
 
             th = threading.Thread(target=reg, daemon=True)
             th.start()
             threads.append(th)
         for th in threads:
-            th.join(timeout=3)
+            th.join(timeout=3)   # fast path completes inline; rest retry
 
     def _register_with(self, directory_addr: Tuple[str, int],
                        timeout: float = 10) -> None:
@@ -778,7 +796,8 @@ class PSService:
                             np.frombuffer(host.encode(), dtype=np.uint8)])
         with socket.create_connection(directory_addr, timeout=timeout) as s:
             send_message(s, msg)
-            recv_message(s)     # ack
+            if recv_message(s) is None:     # clean EOF = NOT acked
+                raise OSError("registration connection closed before ack")
 
     def lookup(self, rank: int) -> Optional[Tuple[str, int]]:
         with self._lock:
@@ -840,6 +859,7 @@ class PSService:
 
     def close(self) -> None:
         self._running = False
+        self._reg_stop.set()                # interrupt registration retries
         try:
             self._queue.put_nowait(None)    # wake + stop the dispatcher
         except Exception:  # noqa: BLE001 - full queue: dispatcher is live

@@ -308,7 +308,7 @@ def _wait_progress(rdv, rank, min_blocks, timeout, procs):
     raise AssertionError(f"rank {rank} never reached {min_blocks} blocks")
 
 
-def _drain(procs, timeout=900):
+def _drain(procs, timeout=1200):
     outs = []
     deadline = time.time() + timeout
     for p in procs:
@@ -335,13 +335,13 @@ def test_fault_drill_async_worker_killed_and_readmitted(tmp_path):
                min_count=1, sample=0, sg=True, epochs=4, learning_rate=0.1,
                block_words=400, pipeline=False, seed=3, optimizer="adagrad")
     base = dict(repo=_REPO, corpus=corpus, rdv=rdv, world=3, cfg=cfg,
-                mode="train", sync=False, retry_window=300.0)
+                mode="train", sync=False, retry_window=600.0)
 
     procs = [_spawn({**base, "rank": r}) for r in range(3)]
     victim = procs[2]
     try:
         # mid-epoch: the victim has trained >= 2 blocks but nobody is done
-        _wait_progress(rdv, 2, 2, timeout=240, procs=procs)
+        _wait_progress(rdv, 2, 2, timeout=600, procs=procs)
         assert not os.path.exists(os.path.join(rdv, "done0"))
         victim.send_signal(signal.SIGKILL)
         victim.wait(timeout=30)
@@ -377,14 +377,14 @@ def test_fault_drill_bsp_finish_train_unblocks_survivors(tmp_path):
                min_count=1, sample=0, sg=True, epochs=3, learning_rate=0.05,
                block_words=400, pipeline=False, seed=3, optimizer="sgd")
     base = dict(repo=_REPO, corpus=corpus, rdv=rdv, world=3, cfg=cfg,
-                sync=True, retry_window=300.0)
+                sync=True, retry_window=600.0)
 
     procs = [_spawn({**base, "rank": r, "mode": "train",
                      "barrier_ranks": [0, 1]}) for r in range(3)]
     victim = procs[2]
     seat = None
     try:
-        _wait_progress(rdv, 2, 1, timeout=240, procs=procs)
+        _wait_progress(rdv, 2, 1, timeout=600, procs=procs)
         victim.send_signal(signal.SIGKILL)
         victim.wait(timeout=30)
         # seat restart: shards re-served at a new address + finish_train
