@@ -6,9 +6,9 @@ formulation (``_block_attn``) materializes the [B, H, Sq, Sk] score block
 in HBM each step; this kernel streams Sk tiles through VMEM with the
 online-softmax recurrence, so HBM traffic per ring step drops from
 O(Sq*Sk) scores to O(Sq*D + Sk*D) rows — the flash-attention trade
-(SNIPPETS.md pattern; jax's own ``pallas.ops.tpu.flash_attention`` uses
-the same grid shape but does not expose the (o, m, l) streaming stats the
-ring merge needs, hence this kernel).
+(jax's own ``pallas.ops.tpu.flash_attention`` uses the same grid shape
+but does not expose the (o, m, l) streaming stats the ring merge needs,
+hence this kernel).
 
 Returns UNNORMALIZED ``(o, m, l)`` exactly like ``_block_attn``:
 ``o = exp(s - m) @ v``, ``m = rowmax(s)``, ``l = rowsum(exp(s - m))`` —
