@@ -77,6 +77,11 @@ def _sg_ns_roofline(pairs_per_sec: float, D: int, K: int,
         "mfu_vs_bf16_peak": round(flops / _PEAK_BF16_FLOPS, 6),
         "model_hbm_bytes_per_sec": round(bw),
         "hbm_utilization": round(bw / _PEAK_HBM_BYTES, 4),
+        # Roofline trajectory fields (VERDICT next-step #4): every bench
+        # record carries the achieved table traffic and its % of the v5e
+        # HBM peak, so the perf story reads straight from BENCH_*.json.
+        "achieved_bytes_per_sec": round(bw),
+        "pct_hbm_roofline": round(100.0 * bw / _PEAK_HBM_BYTES, 2),
     }
 
 
@@ -97,14 +102,14 @@ def bench_word2vec() -> tuple:
                  .astype(np.int32) for _ in range(n_sent)]
 
     def run(param_dtype: str, compact: bool = True,
-            batch_size: int = 8192) -> tuple:
+            batch_size: int = 8192, dispatch_mode=None) -> tuple:
         cfg = Word2VecConfig(embedding_size=128, window=5, negative=5,
                              batch_size=batch_size, sample=1e-3, sg=True,
                              hs=False, optimizer="adagrad", epochs=1,
                              pipeline=True, device_pipeline=True,
                              block_sentences=512, pad_sentence_length=512,
                              param_dtype=param_dtype, compact_pairs=compact,
-                             seed=0)
+                             dispatch_mode=dispatch_mode, seed=0)
         w2v = Word2Vec(cfg, d)
         # Warm-up compiles the step outside the timer.
         w2v.train(sentences=sentences[:4])
@@ -115,7 +120,8 @@ def bench_word2vec() -> tuple:
                                param_bytes=2 if param_dtype == "bfloat16"
                                else 4)
         _log(f"word2vec[{param_dtype}{'' if compact else ',nocompact'}"
-             f"{',b' + str(batch_size) if batch_size != 8192 else ''}]: "
+             f"{',b' + str(batch_size) if batch_size != 8192 else ''}"
+             f"{',' + dispatch_mode if dispatch_mode else ''}]: "
              f"{stats['words']} words in {stats['seconds']:.2f}s -> "
              f"{stats['words_per_sec']:.0f} words/sec "
              f"({pair_rate:.3g} pairs/sec, "
@@ -151,6 +157,37 @@ def bench_word2vec() -> tuple:
         except Exception as e:  # noqa: BLE001 - comparison is best-effort
             _log(f"{dtype}/compact={compact} comparison skipped: {e}")
 
+    # Three-way dispatch-mode timing (docs/BENCHMARK.md Round 6): the same
+    # corpus/seed once per explicit mode, so one bench run settles
+    # in-graph loop vs host pipeline vs Pallas grid. At the 50K-vocab
+    # headline shape pallas_grid exceeds the VMEM residency budget and is
+    # expected to skip; the small-vocab trio below times the loop
+    # MECHANISM at a shape where all three run.
+    from multiverso_tpu.ops.pallas_sgns import sgns_grid_eligible
+    mode_stats = {}
+    for mode in ("in_graph", "pipelined_host", "pallas_grid"):
+        if mode == "pallas_grid" and not sgns_grid_eligible(
+                vocab_size, vocab_size, 128, 8192, 5, np.float32):
+            # The VMEM model already rules the kernel out at this vocab —
+            # don't burn chip time on a doomed compile (or, off-chip,
+            # minutes of interpret-mode execution).
+            _log(f"dispatch mode {mode} skipped at bench shape: "
+                 f"tables exceed the VMEM residency budget")
+            continue
+        try:
+            wps, roof = run("float32", dispatch_mode=mode)
+            mode_stats[f"w2v_words_per_sec_{mode}"] = round(wps, 1)
+            if wps > headline:
+                headline = wps
+                extras = {k: v for k, v in roofline.items()
+                          if k not in roof and k != "headline_batch_size"}
+                roofline = dict(roof, **extras,
+                                headline_dispatch_mode=mode)
+        except Exception as e:  # noqa: BLE001 - mode sweep is best-effort
+            _log(f"dispatch mode {mode} skipped at bench shape: {e}")
+    mode_stats.update(_bench_small_vocab_modes(rng))
+    roofline = dict(roofline, **mode_stats)
+
     # dp x tp sharded step when more than one device is attached (the
     # multi-chip path; on one chip the loss-identity is covered by
     # tests/test_word2vec.py::test_sharded_dpxtp_matches_single_device_*).
@@ -179,6 +216,45 @@ def bench_word2vec() -> tuple:
         except Exception as e:  # noqa: BLE001
             _log(f"sharded run skipped: {e}")
     return headline, roofline
+
+
+def _bench_small_vocab_modes(rng) -> dict:
+    """Three-way dispatch comparison at a vocab where the Pallas grid
+    kernel's whole-table VMEM residency is eligible — this times the
+    chunk-loop MECHANISM (in-graph fori vs host pipeline vs on-chip grid)
+    at equal shape. Not comparable to the 50K-vocab headline."""
+    from multiverso_tpu.models.word2vec import (Dictionary, Word2Vec,
+                                                Word2VecConfig)
+    from multiverso_tpu.ops.pallas_sgns import sgns_grid_eligible
+
+    V = next((v for v in (8192, 4096, 2048, 1024)
+              if sgns_grid_eligible(v, v, 128, 8192, 5, np.float32)), None)
+    if V is None:
+        return {}
+    d, zipf = Dictionary.synthetic_zipf(V, 500_000)
+    sentences = [rng.choice(V, size=500, p=zipf).astype(np.int32)
+                 for _ in range(1000)]
+    out = {}
+    for mode in ("in_graph", "pipelined_host", "pallas_grid"):
+        try:
+            cfg = Word2VecConfig(embedding_size=128, window=5, negative=5,
+                                 batch_size=8192, sample=1e-3, sg=True,
+                                 hs=False, optimizer="adagrad", epochs=1,
+                                 pipeline=True, device_pipeline=True,
+                                 block_sentences=512,
+                                 pad_sentence_length=512,
+                                 dispatch_mode=mode, seed=0)
+            w2v = Word2Vec(cfg, d)
+            w2v.train(sentences=sentences[:4])   # compile warm-up
+            w2v.trained_words = 0
+            stats = w2v.train(sentences=sentences)
+            out[f"w2v_wps_v{V}_{mode}"] = round(stats["words_per_sec"], 1)
+            _log(f"word2vec[V={V},{mode}]: "
+                 f"{stats['words_per_sec']:.0f} words/sec "
+                 f"(loss {stats['loss']:.4f})")
+        except Exception as e:  # noqa: BLE001 - trio is best-effort
+            _log(f"dispatch mode {mode} skipped at V={V}: {e}")
+    return out
 
 
 def bench_big_vocab() -> None:
@@ -390,6 +466,7 @@ def main() -> None:
         print(json.dumps({
             "metric": "w2v_words_per_sec", "value": 0.0,
             "unit": "words/sec/chip", "vs_baseline": 0.0,
+            "achieved_bytes_per_sec": 0.0, "pct_hbm_roofline": 0.0,
             "error": f"{error}; last measured value on this chip: "
                      f"{recorded} ({src}, docs/BENCHMARK.md)",
             "secondary": _virtual_trend(here),
@@ -459,6 +536,9 @@ def main() -> None:
         with open(os.path.join(here, "BENCH_LATEST.json"), "w") as f:
             json.dump({
                 "w2v_words_per_sec": round(words_per_sec, 1),
+                "achieved_bytes_per_sec":
+                    roofline.get("achieved_bytes_per_sec"),
+                "pct_hbm_roofline": roofline.get("pct_hbm_roofline"),
                 "note": "measured by bench.py on the attached chip at "
                         + time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
                         + f" (vs_baseline {round(vs_baseline, 3)}); this "
@@ -472,6 +552,8 @@ def main() -> None:
         "value": round(words_per_sec, 1),
         "unit": "words/sec/chip",
         "vs_baseline": round(vs_baseline, 3),
+        "achieved_bytes_per_sec": roofline.get("achieved_bytes_per_sec"),
+        "pct_hbm_roofline": roofline.get("pct_hbm_roofline"),
         "secondary": {"matrix_param_updates_per_sec": round(updates_per_sec),
                       **roofline, **_virtual_trend(here)},
     }))

@@ -44,6 +44,14 @@ configure.define_int("block_sentences", 512,
                      "sentences per device block (device pipeline)")
 configure.define_int("pad_sentence_length", 512,
                      "sentence pad length (device pipeline)")
+configure.define_string("dispatch_mode", "auto",
+                        "chunk-loop execution: auto|in_graph|"
+                        "pipelined_host|pallas_grid (sg-ns device "
+                        "pipeline; auto probes launch latency + VMEM fit"
+                        " — docs/MIGRATION.md decision table)")
+configure.define_int("dispatch_depth", 8,
+                     "pipelined_host: chunk dispatches in flight before "
+                     "the host waits on the oldest")
 # Distributed mode (the reference's `mpirun -np N ./wordembedding ...`,
 # deploy/docker recipe): -world_size=N spawns N worker ranks on this host,
 # each owning 1/N of the PS-sharded tables and training on a 1/N corpus
@@ -82,6 +90,8 @@ def _cfg_from_flags(device_pipeline: bool) -> "Word2VecConfig":
                          configure.get_flag("use_device_pipeline")),
         block_sentences=configure.get_flag("block_sentences"),
         pad_sentence_length=configure.get_flag("pad_sentence_length"),
+        dispatch_mode=configure.get_flag("dispatch_mode"),
+        dispatch_depth=configure.get_flag("dispatch_depth"),
     )
 
 

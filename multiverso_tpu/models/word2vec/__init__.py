@@ -4,8 +4,11 @@ from multiverso_tpu.models.word2vec.data import (BatchGenerator, BlockStream,
 from multiverso_tpu.models.word2vec.dictionary import (Dictionary,
                                                        HuffmanEncoder,
                                                        Sampler)
-from multiverso_tpu.models.word2vec.model import Word2Vec, Word2VecConfig
+from multiverso_tpu.models.word2vec.model import (DISPATCH_MODES, Word2Vec,
+                                                  Word2VecConfig,
+                                                  resolve_dispatch_mode)
 
 __all__ = ["Word2Vec", "Word2VecConfig", "Dictionary", "HuffmanEncoder",
            "Sampler", "BatchGenerator", "BlockStream", "SkipGramBatch",
-           "CbowBatch", "read_corpus"]
+           "CbowBatch", "read_corpus", "DISPATCH_MODES",
+           "resolve_dispatch_mode"]

@@ -77,15 +77,27 @@ class Word2VecConfig:
     # Compact valid pairs to the front of the device pair stream and skip
     # all-padding chunks (~2x fewer chunk steps at typical subsample rates).
     compact_pairs: bool = True
-    # Host-dispatched per-chunk steps (vs one in-graph loop per block).
-    # Standalone dispatches of the same update run ~20x faster than inside
-    # lax.scan/while_loop (XLA de-optimizes the scatter hot path in loop
-    # bodies) — but each dispatch pays the host->device launch latency, so
-    # this wins ONLY with a co-located host (real TPU VM, ~10us launches).
-    # Over a tunneled/remote chip (driver bench: ~40ms/launch) it loses
-    # badly. None = AUTO: probe the actual dispatch latency at init and
-    # flip it on when launches are cheap (<1ms) and the variant is sg-ns
-    # on a single device. The path is kept bitwise-equal-tested.
+    # How the fused chunk loop executes (sg-ns, single device):
+    #   "in_graph"       — one jitted block program; the chunk loop is a
+    #                      lax.fori_loop (pays XLA's ~20x loop-body scatter
+    #                      de-optimization, docs/BENCHMARK.md Round 2 #3,
+    #                      but costs ONE launch per block);
+    #   "pipelined_host" — per-chunk host dispatches with a depth-N
+    #                      in-flight window (dispatch_depth): donated table
+    #                      carries chain through the queue and the host
+    #                      never blocks per chunk, so launch latency
+    #                      overlaps device compute;
+    #   "pallas_grid"    — ONE launch per block, chunk loop as a sequential
+    #                      Pallas grid with VMEM-resident tables (no XLA
+    #                      loop body to de-optimize; needs the tables to
+    #                      fit VMEM — ops/pallas_sgns.sgns_grid_eligible);
+    #   None / "auto"    — resolve_dispatch_mode's decision table.
+    dispatch_mode: Optional[str] = None
+    # In-flight dispatch window for pipelined_host (chunks dispatched ahead
+    # of device completion before the host waits on the oldest).
+    dispatch_depth: int = 8
+    # DEPRECATED alias (pre-dispatch_mode): True -> "pipelined_host",
+    # False -> "in_graph", None -> AUTO. Ignored when dispatch_mode is set.
     chunk_dispatch: Optional[bool] = None
     block_sentences: int = 512      # sentences per device block
     pad_sentence_length: int = 512  # fixed sentence pad (longer ones split)
@@ -552,6 +564,89 @@ def measured_dispatch_latency_ms(n: int = 7) -> float:
     return float(np.median(times))
 
 
+DISPATCH_MODES = ("in_graph", "pipelined_host", "pallas_grid")
+
+
+def resolve_dispatch_mode(cfg: "Word2VecConfig", in_rows: int,
+                          out_rows: int) -> str:
+    """Three-way dispatch-mode decision (the extended chunk_dispatch AUTO).
+
+    Explicit ``dispatch_mode`` wins; the deprecated ``chunk_dispatch`` bool
+    maps onto it; AUTO applies the decision table (docs/MIGRATION.md):
+
+    1. variant is not sg-ns, or a dp x tp mesh is configured -> in_graph
+       (the fused block step is the only implementation of those paths);
+    2. on a real TPU whose four tables fit VMEM -> pallas_grid (one launch
+       per block AND no in-graph loop body: wins at any launch latency);
+    3. measured launch latency < CHUNK_DISPATCH_LATENCY_MS (co-located
+       host) -> pipelined_host (standalone dispatches are ~20x faster than
+       the in-graph loop and the depth-N window hides cheap launches);
+    4. otherwise (high-latency tunneled links, big-vocab) -> in_graph.
+    """
+    mode = cfg.dispatch_mode
+    if mode is None and cfg.chunk_dispatch is not None:
+        mode = "pipelined_host" if cfg.chunk_dispatch else "in_graph"
+    from multiverso_tpu.ops.pallas_sgns import sgns_grid_eligible
+    if mode not in (None, "auto"):
+        check(mode in DISPATCH_MODES,
+              f"dispatch_mode must be one of {DISPATCH_MODES} or 'auto'; "
+              f"got {mode!r}")
+        if mode == "pallas_grid" and jax.devices()[0].platform == "tpu":
+            # Fail at init with an actionable message instead of an
+            # opaque Mosaic VMEM error mid-training (CPU interpret mode
+            # has no VMEM limit, so only real chips are gated).
+            check(sgns_grid_eligible(
+                in_rows, out_rows, cfg.embedding_size, cfg.batch_size,
+                cfg.negative, np.dtype(cfg.param_dtype)),
+                "dispatch_mode=pallas_grid needs all four tables "
+                "VMEM-resident (~14MB budget, ops/pallas_sgns."
+                f"sgns_grid_eligible); vocab {in_rows}/{out_rows} x "
+                f"D={cfg.embedding_size} does not fit — use "
+                "pipelined_host or in_graph")
+        return mode
+    eligible = (cfg.sg and not cfg.hs
+                and cfg.mesh_data * cfg.mesh_model == 1)
+    if not eligible:
+        return "in_graph"
+    platform = jax.devices()[0].platform
+    if platform == "tpu" and sgns_grid_eligible(
+            in_rows, out_rows, cfg.embedding_size, cfg.batch_size,
+            cfg.negative, np.dtype(cfg.param_dtype)):
+        log.info("w2v dispatch auto: tables fit VMEM -> pallas_grid")
+        return "pallas_grid"
+    lat = measured_dispatch_latency_ms()
+    mode = ("pipelined_host" if lat < CHUNK_DISPATCH_LATENCY_MS
+            else "in_graph")
+    log.info("w2v dispatch auto: launch latency %.3fms -> %s", lat, mode)
+    return mode
+
+
+class _DispatchQueue:
+    """Depth-N in-flight dispatch window for pipelined_host.
+
+    ``push`` enqueues a per-chunk completion marker (the chunk's loss
+    array); once more than ``depth`` markers are in flight the host waits
+    on the OLDEST one — so up to ``depth`` launches overlap device compute
+    and the wait itself is overlapped by the younger queued chunks. This
+    bounds the dispatch queue (no launch storms / unbounded buffer chains
+    over slow links) without the per-chunk ``block_until_ready`` round trip
+    that made per-chunk dispatch lose 10x on tunneled links."""
+
+    def __init__(self, depth: int):
+        from collections import deque
+        self._depth = max(int(depth), 1)
+        self._fifo = deque()
+
+    def push(self, marker) -> None:
+        self._fifo.append(marker)
+        while len(self._fifo) > self._depth:
+            jax.block_until_ready(self._fifo.popleft())
+
+    def drain(self) -> None:
+        while self._fifo:
+            jax.block_until_ready(self._fifo.popleft())
+
+
 def build_chunked_pipeline(window: int, negative: int, chunk: int,
                            adagrad: bool):
     """Device pair-gen + HOST-dispatched per-chunk training steps.
@@ -725,36 +820,33 @@ class Word2Vec:
                 cfg.window, cfg.negative, cfg.batch_size, adagrad,
                 compact=cfg.compact_pairs, sg=cfg.sg, hs=cfg.hs,
                 huffman=self.huffman)
-            use_chunked = cfg.chunk_dispatch
-            if use_chunked is None:
-                # AUTO: per-chunk host dispatch sidesteps the in-graph
-                # loop's scatter de-optimization, but only pays when
-                # launches are cheap — probe and decide.
-                eligible = (cfg.sg and not cfg.hs
-                            and cfg.mesh_data * cfg.mesh_model == 1)
-                if eligible:
-                    lat = measured_dispatch_latency_ms()
-                    use_chunked = lat < CHUNK_DISPATCH_LATENCY_MS
-                    log.info("w2v chunk_dispatch auto: dispatch latency "
-                             "%.3fms -> %s", lat,
-                             "chunked" if use_chunked else "fused block")
-                else:
-                    use_chunked = False
-            self._chunk_dispatch = bool(use_chunked)
-            if self._chunk_dispatch:
+            self._dispatch_mode = resolve_dispatch_mode(
+                cfg, V, max(out_rows, 1))
+            if self._dispatch_mode != "in_graph":
                 check(cfg.sg and not cfg.hs,
-                      "chunk_dispatch (host-dispatched per-chunk steps) "
-                      "is the sg-ns perf experiment path; the fused "
-                      "device block step covers all four variants")
+                      f"dispatch_mode={self._dispatch_mode} (per-chunk "
+                      "host dispatch / Pallas grid) is the sg-ns perf "
+                      "experiment path; the fused device block step "
+                      "covers all four variants")
+                # pair_gen is shared by both alternative executions; the
+                # chunk/tail steps serve pipelined_host.
                 (self._pair_gen, self._chunk_step,
                  self._tail_step) = build_chunked_pipeline(
                     cfg.window, cfg.negative, cfg.batch_size, adagrad)
+            if self._dispatch_mode == "pallas_grid":
+                from multiverso_tpu.ops.pallas_sgns import \
+                    build_sgns_grid_step
+                # Off-TPU the kernel runs interpreted (tier-1 CPU
+                # coverage); Mosaic compilation is a real-chip concern.
+                self._grid_step = build_sgns_grid_step(
+                    cfg.batch_size, cfg.negative, adagrad,
+                    interpret=jax.devices()[0].platform != "tpu")
             self._sharded_mesh = None
             if cfg.mesh_data * cfg.mesh_model > 1:
-                check(not self._chunk_dispatch,
-                      "chunk_dispatch and a dp x tp mesh are mutually "
-                      "exclusive: per-chunk host dispatch would serialize "
-                      "the sharded step; pick one")
+                check(self._dispatch_mode == "in_graph",
+                      "pipelined_host/pallas_grid and a dp x tp mesh are "
+                      "mutually exclusive: both alternative executions "
+                      "would serialize the sharded step; pick one")
                 from jax.sharding import Mesh
                 n = cfg.mesh_data * cfg.mesh_model
                 devs = jax.devices()
@@ -986,15 +1078,31 @@ class Word2Vec:
             else:
                 buf = None
                 source = blocks
-            chunked = self._chunk_dispatch and not sharded
+            mode = self._dispatch_mode if not sharded else "in_graph"
             W, chunk = self.cfg.window, self.cfg.batch_size
+            inflight = _DispatchQueue(self.cfg.dispatch_depth)
             try:
                 for mat, lens, words in source:
                     with monitor("W2V_DEVICE_BLOCK"):
                         self._key, sub = jax.random.split(self._key)
                         lr = np.float32(self._current_lr() *
                                         self._push_scale)
-                        if chunked:
+                        if mode == "pallas_grid":
+                            # One launch runs the whole chunk grid
+                            # on-chip; tables are donated through the
+                            # kernel's input_output_aliases.
+                            (centers2d, contexts2d, negs,
+                             n_pairs) = self._pair_gen(
+                                self._neg_table, self._keep_prob, mat,
+                                lens, sub)
+                            (st_in.data, st_out.data, st_gin.data,
+                             st_gout.data, loss) = self._grid_step(
+                                st_in.data, st_out.data, st_gin.data,
+                                st_gout.data, centers2d, contexts2d,
+                                negs, n_pairs, jnp.asarray(lr))
+                            losses.append(loss)
+                            pair_counts.append(n_pairs)
+                        elif mode == "pipelined_host":
                             (centers2d, contexts2d, negs,
                              n_pairs) = self._pair_gen(
                                 self._neg_table, self._keep_prob, mat,
@@ -1013,12 +1121,16 @@ class Word2Vec:
                                     n_pairs, np.int32(i), lr_dev)
                                 tables = out[:4]
                                 block_loss.append(out[4])
+                                # Depth-N backpressure: waits (overlapped)
+                                # only once >depth chunks are in flight.
+                                inflight.push(out[4])
                             out = self._tail_step(
                                 *tables, centers2d, contexts2d, negs,
                                 n_pairs, lr_dev, jnp.int32(est))
                             (st_in.data, st_out.data, st_gin.data,
                              st_gout.data) = out[:4]
                             block_loss.append(out[4])
+                            inflight.push(out[4])
                             losses.append(jnp.sum(jnp.stack(block_loss)))
                             pair_counts.append(n_pairs)
                         else:
@@ -1032,6 +1144,7 @@ class Word2Vec:
                     self.trained_words += words
                     self.wordcount_table.add([_WORDCOUNT_KEY], [words])
             finally:
+                inflight.drain()
                 if buf is not None:
                     buf.close()
         jax.block_until_ready(st_in.data)

@@ -1,0 +1,15 @@
+"""TPU data-plane kernels (Pallas) and device-side table ops."""
+
+from multiverso_tpu.ops.pallas_rows import (gather_rows, scatter_add_rows,
+                                            scatter_add_sorted_rows,
+                                            tiled_scatter_add_rows,
+                                            tiled_scatter_add_sorted_rows,
+                                            tiled_scatter_eligible)
+from multiverso_tpu.ops.pallas_sgns import (build_sgns_grid_step,
+                                            sgns_grid_bytes,
+                                            sgns_grid_eligible)
+
+__all__ = ["gather_rows", "scatter_add_rows", "scatter_add_sorted_rows",
+           "tiled_scatter_add_rows", "tiled_scatter_add_sorted_rows",
+           "tiled_scatter_eligible", "build_sgns_grid_step",
+           "sgns_grid_bytes", "sgns_grid_eligible"]

@@ -37,6 +37,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x;
+# support both so the kernels load on every baked-in toolchain.
+CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
+
 def group_for_dtype(dtype) -> int:
     """Rows per grid step: the sublane tile is 8 for 4-byte types and 16
     for 2-byte types (bf16) — sub-tile VMEM scratch would be rejected by
@@ -185,7 +191,7 @@ def scatter_add_sorted_rows(table: jax.Array, sorted_ids: jax.Array,
         out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
         grid_spec=grid_spec,
         input_output_aliases={2: 0},   # table (after ids, deltas) -> out
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=CompilerParams(has_side_effects=True),
         interpret=interpret,
     )(sorted_ids.astype(jnp.int32), sorted_deltas, table)
 

@@ -1,0 +1,191 @@
+"""Pallas grid-resident skip-gram/negative-sampling chunk loop.
+
+The round-2 profiling finding (docs/BENCHMARK.md §3) is that the XLA sg-ns
+update is memory-bound-fast as a STANDALONE dispatch (0.05-0.12 ms per
+8192-pair chunk) but ~20x slower inside ``lax.scan``/``while_loop`` — XLA
+de-optimizes the gather/scatter hot path in loop bodies, and unrolling does
+not recover it. The host-dispatched workaround (``chunk_dispatch``) escapes
+the loop but pays one host->device launch per chunk, which loses 10x over
+high-latency (tunneled) links.
+
+This kernel is the third execution: the chunk loop becomes a **sequential
+Pallas grid**. Mosaic grids are a hardware loop over block fetches — there
+is no XLA loop body for the de-optimization to apply to — and the whole
+block (every chunk) costs ONE launch, so launch latency stops mattering
+entirely. Layout:
+
+* the four tables (w_in, w_out and their AdaGrad accumulators) are
+  block-mapped whole with a constant index map, so Mosaic fetches them into
+  VMEM once, keeps them **resident across every grid step**, and flushes
+  them back to HBM once at the end — the grid-resident carry that
+  ``lax.scan`` cannot express;
+* ``input_output_aliases`` donates the table buffers (same contract as
+  ``pallas_rows.scatter_add_sorted_rows``);
+* the compacted chunk streams from ``pair_gen`` ([n, chunk] centers and
+  contexts, [n, chunk, K] negatives) are block-mapped per grid step, so
+  Mosaic double-buffers the (small, int32) stream DMAs under compute;
+* the true pair count rides scalar prefetch and masks the tail chunk —
+  numerics are EXACT regardless of how many dead (all-padding) chunks the
+  static grid contains, mirroring the in-graph path's mask.
+
+The per-chunk math is ``raw_sg_ns_step`` itself — imported lazily from the
+model (the model imports this module, so a top-level import would cycle).
+Reusing the exact step function is what makes the mode swap safe: the same
+primitive sequence in the same order gives bitwise-identical table state
+(tests/test_pallas_sgns.py, tests/test_word2vec.py three-way test).
+
+VMEM is the constraint: whole-table residency needs all four tables (plus
+Mosaic's input copies) under the ~16 MB/core budget, i.e. small-to-medium
+vocabularies (``sgns_grid_eligible``). For >VMEM vocabs the follow-up is a
+row-DMA variant that keeps the tables in HBM (``pl.ANY``) and streams only
+the touched rows per chunk through ``pallas_rows``' per-row DMA machinery;
+the sorted-run scatter fold there must be restructured to sequential
+row-value folds before it can match XLA's duplicate-accumulation order
+bitwise, so it lands only with on-chip numbers. AUTO mode selection
+(``models/word2vec/model.py::resolve_dispatch_mode``) therefore offers this
+kernel only when the tables fit.
+
+On CPU the kernel runs in interpret mode (tier-1 coverage); on-chip
+compilation is validated at the next tunnel window (`scripts/perf_attrib.py`
+leg G times it against the fori_loop and standalone formulations).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from multiverso_tpu.ops.pallas_rows import CompilerParams
+
+# ~16 MB/core on v5e minus headroom for the stream blocks, loss scalar and
+# Mosaic's own double-buffering of the (small) stream inputs.
+VMEM_BUDGET_BYTES = 14 << 20
+
+
+def sgns_grid_bytes(in_rows: int, out_rows: int, dim: int, chunk: int,
+                    negative: int, param_dtype) -> int:
+    """VMEM bytes the grid-resident step needs: input + output residency
+    for the four tables (Mosaic does not fold aliased in/out blocks into
+    one buffer) plus double-buffered int32 stream blocks."""
+    p = np.dtype(param_dtype).itemsize
+    tables = (in_rows + out_rows) * dim * (p + 4)   # embeds + f32 accums
+    streams = chunk * 4 * (2 + negative)            # centers+contexts+negs
+    return 2 * tables + 2 * streams
+
+
+def sgns_grid_eligible(in_rows: int, out_rows: int, dim: int, chunk: int,
+                       negative: int, param_dtype,
+                       budget: int = VMEM_BUDGET_BYTES) -> bool:
+    """True when the whole-table grid-resident kernel fits VMEM."""
+    return sgns_grid_bytes(in_rows, out_rows, dim, chunk, negative,
+                           param_dtype) <= budget
+
+
+def _make_sgns_grid_kernel(raw_step, chunk: int):
+    def kernel(n_pairs_ref, centers_ref, contexts_ref, negs_ref, lr_ref,
+               w_in_in, w_out_in, g_in_in, g_out_in,
+               w_in, w_out, g_in, g_out, loss_ref):
+        g = pl.program_id(0)
+
+        # First grid step: seed the resident output blocks from the donated
+        # tables (out blocks are write-before-read on first visit; constant
+        # index maps keep them in VMEM for every later step).
+        @pl.when(g == 0)
+        def _():
+            w_in[:] = w_in_in[:]
+            w_out[:] = w_out_in[:]
+            g_in[:] = g_in_in[:]
+            g_out[:] = g_out_in[:]
+            loss_ref[0, 0] = jnp.float32(0.0)
+
+        # Tail/dead-chunk mask — same int math as the in-graph fori body
+        # (1-D iota is rejected by Mosaic, hence broadcasted_iota).
+        lane = jax.lax.broadcasted_iota(jnp.int32, (chunk, 1), 0)[:, 0]
+        m = ((g * chunk + lane) < n_pairs_ref[0]).astype(jnp.float32)
+        out = raw_step(w_in[:], w_out[:], g_in[:], g_out[:],
+                       centers_ref[0, :], contexts_ref[0, :],
+                       negs_ref[0, :, :], m, lr_ref[0, 0])
+        w_in[:] = out[0]
+        w_out[:] = out[1]
+        g_in[:] = out[2]
+        g_out[:] = out[3]
+        loss_ref[0, 0] = loss_ref[0, 0] + out[4]
+
+    return kernel
+
+
+def build_sgns_grid_step(chunk: int, negative: int, adagrad: bool,
+                         interpret: bool = False):
+    """Jitted whole-block sg-ns trainer: one launch runs every chunk as a
+    sequential Pallas grid with VMEM-resident tables.
+
+    Signature matches the chunked pipeline's operands::
+
+        step(w_in, w_out, g_in, g_out, centers2d, contexts2d, negatives3d,
+             n_pairs, lr) -> (w_in, w_out, g_in, g_out, loss)
+
+    where the streams are ``pair_gen`` outputs ([n, chunk] / [n, chunk, K])
+    and ``n_pairs`` is the true pair count (tail masking). Tables are
+    donated through ``input_output_aliases``.
+    """
+    # Lazy import: the model module imports this one at top level.
+    from multiverso_tpu.models.word2vec.model import raw_sg_ns_step
+    raw = raw_sg_ns_step(adagrad)
+    kernel = _make_sgns_grid_kernel(raw, chunk)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def step(w_in, w_out, g_in, g_out, centers2d, contexts2d, negatives3d,
+             n_pairs, lr):
+        n = centers2d.shape[0]
+        v_in, d = w_in.shape
+        v_out = w_out.shape[0]
+        const = lambda g, np_ref: (0, 0)  # noqa: E731 - resident blocks
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[
+                pl.BlockSpec((1, chunk), lambda g, np_ref: (g, 0)),
+                pl.BlockSpec((1, chunk), lambda g, np_ref: (g, 0)),
+                pl.BlockSpec((1, chunk, negative),
+                             lambda g, np_ref: (g, 0, 0)),
+                pl.BlockSpec((1, 1), const, memory_space=pltpu.SMEM),
+                pl.BlockSpec((v_in, d), const),
+                pl.BlockSpec((v_out, d), const),
+                pl.BlockSpec((v_in, d), const),
+                pl.BlockSpec((v_out, d), const),
+            ],
+            out_specs=[
+                pl.BlockSpec((v_in, d), const),
+                pl.BlockSpec((v_out, d), const),
+                pl.BlockSpec((v_in, d), const),
+                pl.BlockSpec((v_out, d), const),
+                pl.BlockSpec((1, 1), const, memory_space=pltpu.SMEM),
+            ],
+        )
+        outs = pl.pallas_call(
+            kernel,
+            out_shape=[
+                jax.ShapeDtypeStruct(w_in.shape, w_in.dtype),
+                jax.ShapeDtypeStruct(w_out.shape, w_out.dtype),
+                jax.ShapeDtypeStruct(g_in.shape, g_in.dtype),
+                jax.ShapeDtypeStruct(g_out.shape, g_out.dtype),
+                jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            ],
+            grid_spec=grid_spec,
+            # inputs: n_pairs(sp), centers, contexts, negs, lr, then tables
+            input_output_aliases={5: 0, 6: 1, 7: 2, 8: 3},
+            compiler_params=CompilerParams(
+                dimension_semantics=("arbitrary",)),  # sequential carry
+            interpret=interpret,
+        )(jnp.reshape(n_pairs, (1,)).astype(jnp.int32),
+          centers2d, contexts2d, negatives3d,
+          jnp.reshape(jnp.asarray(lr, jnp.float32), (1, 1)),
+          w_in, w_out, g_in, g_out)
+        return (*outs[:4], outs[4][0, 0])
+
+    return step

@@ -11,9 +11,13 @@ ONCHIP_RESULTS.txt:
                     path fix, VERDICT r3 #1)
   2. pallas       — XLA vs per-row-DMA vs tiled scatter at bench shape
                     (decides which kernel survives, VERDICT r3 #9)
-  3. dispatch     — launch-latency probe (validates the chunk_dispatch
+  3. dispatch     — launch-latency probe (validates the dispatch_mode
                     AUTO threshold for this link)
-  4. bench        — the full bench.py headline (words/sec + roofline)
+  4. modes        — the three-way chunk-loop comparison (in_graph vs
+                    pipelined_host vs pallas_grid) at the largest
+                    VMEM-eligible vocab (docs/BENCHMARK.md Round 6) —
+                    cheap, so a short window still settles it
+  5. bench        — the full bench.py headline (words/sec + roofline)
 
 Usage:  python scripts/onchip_session.py [--skip bench] [--quick]
 """
@@ -106,6 +110,14 @@ def main() -> None:
         run_phase("pallas", [py, "-c", (
             "import sys; sys.path.insert(0, '.');"
             "import bench; bench.bench_pallas_rows()")], 600)
+    if "modes" not in args.skip:
+        run_phase("modes", [py, "-c", (
+            "import sys; sys.path.insert(0, '.');"
+            "import numpy as np, bench, multiverso_tpu as mv;"
+            "mv.init([]);"
+            "print(bench._bench_small_vocab_modes("
+            "np.random.default_rng(0)));"
+            "mv.shutdown()")], 900)
     if "flash" not in args.skip:
         run_phase("flash", [py, os.path.join(HERE, "bench_flash_attn.py")],
                   600)

@@ -16,13 +16,25 @@ overhead lives by timing the same chunk workload under six formulations:
                       gathered rows and one final scatter applies the
                       delta (the candidate fix: if the loop copies its
                       carry per iteration, cost drops with carry size)
+  G pallas-grid     — the chunk loop as a sequential Pallas grid with
+                      VMEM-resident tables (ops/pallas_sgns): one launch,
+                      no XLA loop body. Runs at the largest VMEM-eligible
+                      vocab; H re-times the fori_loop at that SAME vocab
+                      so G/H isolates the loop mechanism at equal shape.
 
 If B-C >> D: the gather side de-optimizes. If B-D >> C: the scatter does.
 If E/F track A: the cost scales with CARRY SIZE -> per-iteration copies
 of the carried tables are the mechanism and the sub-table restructure is
-the fix. Run ON the chip (or a co-located host):
+the fix. If G tracks A (and beats H): the Pallas grid escapes the
+de-optimization AND the launch tax — the pallas_grid dispatch mode wins
+wherever its tables fit. Run ON the chip (or a co-located host):
 
     python scripts/perf_attrib.py [--vocab 50000] [--dim 128]
+
+``--dry-run`` shrinks every shape to seconds-on-CPU and runs all legs
+(Pallas in interpret mode) — the tier-1 smoke that keeps this harness
+from bit-rotting between chip windows (it is the designated tie-breaker
+and had never executed before a live window without it).
 """
 
 import argparse
@@ -50,7 +62,13 @@ def main() -> None:
     p.add_argument("--negative", type=int, default=5)
     p.add_argument("--chunks", type=int, default=16)
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--dry-run", action="store_true",
+                   help="tiny shapes, 1 iter, Pallas interpreted: CI "
+                        "smoke so the harness cannot bit-rot off-chip")
     args = p.parse_args()
+    if args.dry_run:
+        args.vocab, args.dim, args.chunk = 512, 32, 64
+        args.negative, args.chunks, args.iters = 2, 2, 1
 
     import jax
     import jax.numpy as jnp
@@ -213,6 +231,62 @@ def main() -> None:
     timeit("F fori-sub", jax.jit(loop_subtable,
                                  donate_argnums=(0, 1, 2, 3)),
            *tables(), centers, contexts, negs, mask, per_chunk=N)
+
+    # G: Pallas grid-resident chunk loop (one launch, VMEM-resident
+    # tables) + H: the fori_loop re-timed at G's vocab, so G/H compares
+    # the loop mechanism at equal shape even when VMEM forces Vg < V.
+    from multiverso_tpu.ops.pallas_sgns import (build_sgns_grid_step,
+                                                sgns_grid_eligible)
+    Vg = next((v for v in (V, 16384, 8192, 4096, 2048, 1024, 512)
+               if v <= V and sgns_grid_eligible(v, v, D, C, K,
+                                                np.float32)), None)
+    if Vg is None:
+        print(f"{'G pallas-grid':14s}  skipped: no VMEM-eligible vocab "
+              f"<= {V} at D={D} chunk={C}")
+        return
+    interp = jax.devices()[0].platform != "tpu"
+    cs_g, os_g, ns_g = centers % Vg, contexts % Vg, negs % Vg
+    n_pairs = jnp.int32(N * C)
+    g_rng = np.random.default_rng(7)
+
+    def g_tables():
+        return (jnp.asarray(g_rng.normal(size=(Vg, D)).astype(np.float32)),
+                jnp.zeros((Vg, D), jnp.float32),
+                jnp.zeros((Vg, D), jnp.float32),
+                jnp.zeros((Vg, D), jnp.float32))
+
+    grid = build_sgns_grid_step(chunk=C, negative=K, adagrad=True,
+                                interpret=interp)
+    out = grid(*g_tables(), cs_g, os_g, ns_g, n_pairs, lr)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(args.iters):
+        w = g_tables()
+        t0 = time.perf_counter()
+        out = grid(*w, cs_g, os_g, ns_g, n_pairs, lr)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    tag = f" (V={Vg}" + (", interpret)" if interp else ")")
+    print(f"{'G pallas-grid':14s} {best * 1e3 / N:8.3f} ms/chunk{tag}")
+
+    def loop_g(w_in, w_out, g_in, g_out):
+        def body(i, carry):
+            out = raw(*carry[:4], cs_g[i], os_g[i], ns_g[i], mask[i], lr)
+            return (*out[:4], carry[4] + out[4])
+        return jax.lax.fori_loop(
+            0, N, body, (w_in, w_out, g_in, g_out, jnp.float32(0)))
+
+    fn = jax.jit(loop_g, donate_argnums=(0, 1, 2, 3))
+    out = fn(*g_tables())
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(args.iters):
+        w = g_tables()
+        t0 = time.perf_counter()
+        out = fn(*w)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    print(f"{'H fori @ Vg':14s} {best * 1e3 / N:8.3f} ms/chunk (V={Vg})")
 
 
 if __name__ == "__main__":

@@ -44,6 +44,26 @@ def test_word2vec_cli_device_pipeline(tmp_path):
     assert out.exists()
 
 
+@pytest.mark.parametrize("mode", ["in_graph", "pipelined_host",
+                                  "pallas_grid"])
+def test_word2vec_cli_dispatch_modes(tmp_path, mode):
+    """-dispatch_mode reaches the model (Round 6 selector): every explicit
+    mode trains end to end through the CLI (pallas_grid interpreted on
+    CPU)."""
+    from multiverso_tpu.apps.word2vec_main import main
+
+    corpus = tmp_path / "corpus.txt"
+    out = tmp_path / "vectors.txt"
+    _write_corpus(str(corpus), n=60)
+    rc = main([f"-train_file={corpus}", f"-output_file={out}",
+               "-size=16", "-min_count=1", "-epoch=1", "-batch_size=128",
+               "-use_device_pipeline=true", "-block_sentences=64",
+               "-pad_sentence_length=16", f"-dispatch_mode={mode}",
+               "-dispatch_depth=2"])
+    assert rc == 0
+    assert out.exists()
+
+
 def test_word2vec_cli_missing_file():
     from multiverso_tpu.apps.word2vec_main import main
 

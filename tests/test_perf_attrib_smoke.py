@@ -1,0 +1,31 @@
+"""Tier-1 smoke for the perf-attribution harness.
+
+``scripts/perf_attrib.py`` is the designated tie-breaker for the in-graph
+loop de-optimization (docs/BENCHMARK.md Round 4) and runs for real only
+inside a live-chip window — without an off-chip smoke it can bit-rot
+between windows (and HAD never executed before one). ``--dry-run``
+shrinks every leg to seconds on CPU, including the Pallas grid leg in
+interpret mode."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(_REPO, "scripts", "perf_attrib.py")
+
+
+def test_perf_attrib_dry_run_cpu():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, _SCRIPT, "--dry-run"],
+                          cwd=_REPO, env=env, capture_output=True,
+                          text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    # every formulation leg reported a number (E legitimately skips when
+    # the dry-run vocab is already sub-table-sized)
+    for leg in ("A standalone", "B fori-full", "C fori-gather",
+                "D fori-scatter", "F fori-sub", "G pallas-grid",
+                "H fori @ Vg"):
+        assert leg in out, f"missing leg {leg!r}:\n{out}"
+    assert out.count("ms/chunk") >= 7

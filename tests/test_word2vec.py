@@ -363,6 +363,123 @@ def test_chunked_dispatch_matches_block_step_bitwise(mv_env):
     np.testing.assert_allclose(float(total_loss), float(ref[4]), rtol=1e-6)
 
 
+def test_dispatch_modes_three_way_bitwise(mv_env):
+    """ISSUE 2 acceptance: all three chunk-loop executions — in-graph
+    compacted block step, host-dispatched chunk chain (pipelined_host's
+    step functions), and the Pallas grid-resident kernel (interpret on
+    CPU) — produce bitwise-identical table state from one key."""
+    import jax
+    import jax.numpy as jnp
+    from multiverso_tpu.models.word2vec.model import (
+        build_chunked_pipeline, build_device_block_step,
+        expected_live_chunks)
+    from multiverso_tpu.ops.pallas_sgns import build_sgns_grid_step
+
+    rng = np.random.default_rng(5)
+    V, D, S, L, chunk, W, K = 80, 16, 6, 20, 32, 3, 2
+    neg_table = jnp.asarray(rng.integers(0, V, size=1024).astype(np.int32))
+    keep_prob_host = np.full(V, 0.8, dtype=np.float32)
+    keep_prob = jnp.asarray(keep_prob_host)
+    sents = jnp.asarray(rng.integers(0, V, size=(S, L)).astype(np.int32))
+    lengths = jnp.asarray(rng.integers(2, L + 1, size=S).astype(np.int32))
+    key = jax.random.PRNGKey(13)
+    lr = jnp.float32(0.05)
+
+    def init():
+        return [jnp.asarray(np.random.default_rng(1).normal(
+            size=(V, D)).astype(np.float32))] + \
+            [jnp.zeros((V, D), jnp.float32) for _ in range(3)]
+
+    # mode 1: in-graph compacted block step
+    block = build_device_block_step(W, K, chunk, adagrad=True, compact=True)
+    ref = block(*init(), neg_table, keep_prob, sents, lengths, key, lr)
+
+    # shared pair stream for modes 2 and 3
+    pair_gen, chunk_step, tail_step = build_chunked_pipeline(
+        W, K, chunk, adagrad=True)
+    centers2d, contexts2d, negs, n_pairs = pair_gen(
+        neg_table, keep_prob, sents, lengths, key)
+
+    # mode 2: host-dispatched chunk chain + exact tail
+    est = expected_live_chunks(keep_prob_host, np.asarray(sents),
+                               np.asarray(lengths), W, chunk,
+                               centers2d.shape[0])
+    tables = init()
+    host_loss = jnp.float32(0)
+    for i in range(est):
+        out = chunk_step(*tables, centers2d, contexts2d, negs, n_pairs,
+                         jnp.int32(i), lr)
+        tables = list(out[:4])
+        host_loss = host_loss + out[4]
+    out = tail_step(*tables, centers2d, contexts2d, negs, n_pairs, lr,
+                    start=est)
+    host_tables, host_loss = out[:4], host_loss + out[4]
+
+    # mode 3: Pallas grid (sequential on-chip loop, one dispatch)
+    grid = build_sgns_grid_step(chunk=chunk, negative=K, adagrad=True,
+                                interpret=True)
+    g_out = grid(*init(), centers2d, contexts2d, negs, n_pairs, lr)
+
+    assert int(n_pairs) == int(ref[5]) > 0
+    for a, b, c in zip(ref[:4], host_tables, g_out[:4]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    np.testing.assert_allclose(float(host_loss), float(ref[4]), rtol=1e-6)
+    np.testing.assert_allclose(float(g_out[4]), float(ref[4]), rtol=1e-6)
+
+
+def test_dispatch_mode_auto_decision_table(monkeypatch, mv_env):
+    """resolve_dispatch_mode: latency probe + variant/mesh gates +
+    legacy chunk_dispatch mapping + explicit-mode validation."""
+    import dataclasses
+    from multiverso_tpu.models.word2vec import model as m
+    from multiverso_tpu.utils.log import FatalError
+
+    cfg = Word2VecConfig(sg=True, hs=False, device_pipeline=True)
+    monkeypatch.setattr(m, "measured_dispatch_latency_ms", lambda: 0.05)
+    assert m.resolve_dispatch_mode(cfg, 1000, 1000) == "pipelined_host"
+    monkeypatch.setattr(m, "measured_dispatch_latency_ms", lambda: 40.0)
+    assert m.resolve_dispatch_mode(cfg, 1000, 1000) == "in_graph"
+    # non-sg-ns variants and meshes always use the fused block step
+    for variant in (dataclasses.replace(cfg, hs=True),
+                    dataclasses.replace(cfg, sg=False),
+                    dataclasses.replace(cfg, mesh_data=2)):
+        assert m.resolve_dispatch_mode(variant, 1000, 1000) == "in_graph"
+    # legacy bool maps onto the new modes
+    assert m.resolve_dispatch_mode(
+        dataclasses.replace(cfg, chunk_dispatch=True),
+        1000, 1000) == "pipelined_host"
+    assert m.resolve_dispatch_mode(
+        dataclasses.replace(cfg, chunk_dispatch=False),
+        1000, 1000) == "in_graph"
+    # explicit mode wins over the probe; unknown names are rejected
+    assert m.resolve_dispatch_mode(
+        dataclasses.replace(cfg, dispatch_mode="pallas_grid"),
+        1000, 1000) == "pallas_grid"
+    with pytest.raises(FatalError):
+        m.resolve_dispatch_mode(
+            dataclasses.replace(cfg, dispatch_mode="bogus"), 1000, 1000)
+
+
+@pytest.mark.parametrize("mode", ["pipelined_host", "pallas_grid"])
+def test_device_pipeline_explicit_dispatch_modes_train(mv_env, mode):
+    """End-to-end training under each explicit alternative execution
+    (Pallas grid runs interpreted on CPU) still separates topics."""
+    sents = _corpus(300)
+    d = Dictionary.build(sents, min_count=1)
+    cfg = Word2VecConfig(embedding_size=32, batch_size=512, window=4,
+                         negative=5, min_count=1, sample=0, sg=True,
+                         epochs=3, learning_rate=0.1, seed=3,
+                         device_pipeline=True, block_sentences=128,
+                         pad_sentence_length=16, pipeline=False,
+                         dispatch_mode=mode, dispatch_depth=4)
+    w2v = Word2Vec(cfg, d)
+    stats = w2v.train(sentences=[d.encode(s) for s in sents])
+    assert stats["pairs"] > 0
+    assert np.isfinite(stats["loss"])
+    _assert_topic_separation(w2v, d)
+
+
 def test_sharded_block_step_bitexact_vs_single(mv_env):
     """The dp4 x tp2 block step is BIT-EXACT against the single-device
     step on identical inputs at a vocab (4096 rows over 2 model shards)
