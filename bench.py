@@ -547,6 +547,11 @@ def main() -> None:
             }, f)
     except OSError:
         pass
+    # Bench records embed a compact telemetry snapshot (no bucket arrays):
+    # the run's Dashboard monitors (p50/p95/p99) and gauges travel with the
+    # headline number, so regressions diff via scripts/telemetry_report.py
+    # against any -telemetry_dir run (docs/OBSERVABILITY.md).
+    from multiverso_tpu.telemetry import metrics_snapshot
     print(json.dumps({
         "metric": "w2v_words_per_sec",
         "value": round(words_per_sec, 1),
@@ -555,7 +560,8 @@ def main() -> None:
         "achieved_bytes_per_sec": roofline.get("achieved_bytes_per_sec"),
         "pct_hbm_roofline": roofline.get("pct_hbm_roofline"),
         "secondary": {"matrix_param_updates_per_sec": round(updates_per_sec),
-                      **roofline, **_virtual_trend(here)},
+                      **roofline, **_virtual_trend(here),
+                      "telemetry": metrics_snapshot(buckets=False)},
     }))
 
 

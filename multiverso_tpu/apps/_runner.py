@@ -19,19 +19,34 @@ _USER_ERRORS = (FlagError, FatalError, OSError)
 def run_app(body: Callable[[List[str]], int],
             argv: Optional[List[str]] = None) -> int:
     """Parse flags + start the runtime, run ``body(remaining_argv)``,
-    always shut down. Returns a process exit code."""
+    always shut down. Returns a process exit code. When ``-telemetry_dir``
+    is set, a telemetry exporter runs for the body and writes its final
+    snapshot + Chrome trace after shutdown (so every rank of a spawned
+    world exports, launcher processes don't)."""
+    from multiverso_tpu.telemetry import (maybe_start_exporter_from_flags,
+                                          stop_exporter)
     try:
         remaining = mv.init(argv if argv is not None else sys.argv[1:])
     except _USER_ERRORS as e:
         log.error("%s", e)
         return 1
+    telemetry_on = False
     try:
+        # Inside the guarded region: an unwritable -telemetry_dir is a
+        # user error (one log line, exit 1) and must still shut down.
+        telemetry_on = maybe_start_exporter_from_flags()
         return body(remaining)
     except _USER_ERRORS as e:
         log.error("%s", e)
         return 1
     finally:
-        mv.shutdown()
+        try:
+            mv.shutdown()
+        finally:
+            # Even a failed shutdown must not cost the final snapshot —
+            # the failed run is the one an operator most wants to inspect.
+            if telemetry_on:
+                stop_exporter()
 
 
 # ---------------------------------------------------------------------------

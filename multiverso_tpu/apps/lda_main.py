@@ -59,8 +59,8 @@ def _body(argv: List[str]) -> int:
     topn = configure.get_flag("topn")
     for k in range(cfg.num_topics):
         top = ", ".join(dictionary.words[w] for w in lda.top_words(k, topn))
-        print(f"topic {k:3d}: {top}")
-    Dashboard.display()
+        log.raw(f"topic {k:3d}: {top}")
+    Dashboard.display(echo=True)
     return 0
 
 

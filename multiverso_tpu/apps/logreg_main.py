@@ -100,7 +100,7 @@ def _body_distributed(world: int, rank: int) -> int:
         if table is not None:
             table.close()
         svc.close()
-    Dashboard.display()
+    Dashboard.display(echo=True)
     return 0
 
 
@@ -139,7 +139,7 @@ def _body(argv: List[str]) -> int:
                       output_path=configure.get_flag("output_file") or
                       cfg.output_file or None)
         log.info("test accuracy: %.4f", acc)
-    Dashboard.display()
+    Dashboard.display(echo=True)
     return 0
 
 

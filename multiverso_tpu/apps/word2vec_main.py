@@ -134,7 +134,7 @@ def _body_distributed(world: int, rank: int) -> int:
         wait_all_done(rdv, rank, world)
     finally:
         svc.close()
-    Dashboard.display()
+    Dashboard.display(echo=True)
     return 0
 
 
@@ -162,7 +162,7 @@ def _body(argv: List[str]) -> int:
     stats = w2v.train(corpus_path=train_file)
     log.info("trained: %.0f words/sec", stats["words_per_sec"])
     w2v.save(configure.get_flag("output_file"))
-    Dashboard.display()
+    Dashboard.display(echo=True)
     return 0
 
 

@@ -42,8 +42,10 @@ clocks.  Use ``finish_train`` to retire a worker that stops participating.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List
 
+from multiverso_tpu.telemetry import gauge, histogram
 from multiverso_tpu.utils.log import check
 
 
@@ -74,7 +76,7 @@ class VectorClock:
 class SyncCoordinator:
     """One per table in sync mode; gates worker threads per the BSP rule."""
 
-    def __init__(self, num_workers: int):
+    def __init__(self, num_workers: int, name: str = ""):
         check(num_workers >= 1, "need at least one worker")
         self.num_workers = num_workers
         self._adds = VectorClock(num_workers)
@@ -84,6 +86,33 @@ class SyncCoordinator:
         # src/server.cpp ProcessGet).
         self._inflight_adds = [0] * num_workers
         self._cv = threading.Condition()
+        # Telemetry: gate wait time (the BSP barrier tax) + per-worker
+        # vector-clock lag — how many rounds each worker trails the most
+        # advanced worker, so the STRAGGLER reads positive (same polarity
+        # as ps_service.staleness.worker_<w>; docs/OBSERVABILITY.md).
+        # ``name`` qualifies the metric names so coordinators of different
+        # tables don't conflate into one stream, and the add/get clocks
+        # get SEPARATE gauges — interleaving both lag series into one
+        # stream would let a get-commit overwrite (mask) an add-side
+        # straggler between snapshots.
+        prefix = f"sync.{name}." if name else "sync."
+        self._h_add_wait = histogram(f"{prefix}gate_wait.add")
+        self._h_get_wait = histogram(f"{prefix}gate_wait.get")
+        self._g_add_staleness = [gauge(f"{prefix}staleness.add.worker_{w}")
+                                 for w in range(num_workers)]
+        self._g_get_staleness = [gauge(f"{prefix}staleness.get.worker_{w}")
+                                 for w in range(num_workers)]
+
+    def _sample_staleness_locked(self, clock: VectorClock,
+                                 gauges: List) -> None:
+        vals = [clock.value(w) for w in range(self.num_workers)]
+        finite = [v for v in vals if v != VectorClock.INF]
+        if not finite:
+            return      # every worker retired: lag is meaningless
+        hi = max(finite)
+        for w, g in enumerate(gauges):
+            if vals[w] != VectorClock.INF:
+                g.set(hi - vals[w])
 
     # -- gates -------------------------------------------------------------
     # Two-phase: acquire_* blocks until the op is in-clock; commit_* ticks
@@ -92,18 +121,25 @@ class SyncCoordinator:
     # include this worker's op (the reference avoids this by construction:
     # the single-threaded server actor both applies and clocks a message).
     def acquire_add(self, worker_id: int, timeout: float = 60.0) -> None:
-        with self._cv:
-            ok = self._cv.wait_for(
-                lambda: self._gets.min() >= self._gets.value(worker_id) or
-                self._adds.value(worker_id) == VectorClock.INF,
-                timeout)
-            check(ok, f"sync add gate timed out (worker {worker_id})")
-            self._inflight_adds[worker_id] += 1
+        t0 = time.perf_counter()
+        try:
+            with self._cv:
+                ok = self._cv.wait_for(
+                    lambda: self._gets.min() >= self._gets.value(worker_id)
+                    or self._adds.value(worker_id) == VectorClock.INF,
+                    timeout)
+                check(ok, f"sync add gate timed out (worker {worker_id})")
+                self._inflight_adds[worker_id] += 1
+        finally:
+            # finally: a timed-out wait is exactly the tail this
+            # histogram exists to expose — it must not escape recording.
+            self._h_add_wait.observe((time.perf_counter() - t0) * 1e3)
 
     def commit_add(self, worker_id: int) -> None:
         with self._cv:
             self._adds.tick(worker_id)
             self._inflight_adds[worker_id] -= 1
+            self._sample_staleness_locked(self._adds, self._g_add_staleness)
             self._cv.notify_all()
 
     def abort_add(self, worker_id: int) -> None:
@@ -117,17 +153,22 @@ class SyncCoordinator:
         # A get must not race ANY worker's admitted-but-uncommitted add
         # (the reference's single-threaded server applies and clocks each
         # add atomically, so a served get never observes a half-round).
-        with self._cv:
-            ok = self._cv.wait_for(
-                lambda: (self._adds.min() >= self._adds.value(worker_id) and
-                         not any(self._inflight_adds)) or
-                self._gets.value(worker_id) == VectorClock.INF,
-                timeout)
-            check(ok, f"sync get gate timed out (worker {worker_id})")
+        t0 = time.perf_counter()
+        try:
+            with self._cv:
+                ok = self._cv.wait_for(
+                    lambda: (self._adds.min() >= self._adds.value(worker_id)
+                             and not any(self._inflight_adds)) or
+                    self._gets.value(worker_id) == VectorClock.INF,
+                    timeout)
+                check(ok, f"sync get gate timed out (worker {worker_id})")
+        finally:
+            self._h_get_wait.observe((time.perf_counter() - t0) * 1e3)
 
     def commit_get(self, worker_id: int) -> None:
         with self._cv:
             self._gets.tick(worker_id)
+            self._sample_staleness_locked(self._gets, self._g_get_staleness)
             self._cv.notify_all()
 
     def finish_train(self, worker_id: int) -> None:

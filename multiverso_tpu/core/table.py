@@ -38,6 +38,24 @@ from multiverso_tpu.core.updater import Updater
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.utils.log import check
 
+# XLA's CPU collectives deadlock under concurrent dispatch: a sharded
+# store kernel expands to one participant per virtual device, all of which
+# must reach a rendezvous — but the host executor pool can be smaller than
+# the device count, so two in-flight runs interleave participants and each
+# waits forever for threads the other is holding (observed on a 2-core
+# host with the test env's 8 virtual devices: AllGather participants of
+# run A and run B parked at the same rendezvous). Multi-device CPU stores
+# therefore serialize dispatch AND execution process-wide; accelerators
+# keep fully async dispatch (the device stream already orders runs).
+# Scope: this guards store-vs-store only. Worker-side shard_maps
+# (collectives.py / sequence.py / pipeline.py) dispatched concurrently
+# with a store kernel on the same multi-device CPU mesh could in
+# principle wedge the same way; widening this into a lock around every
+# CPU collective dispatch is deferred until such an interleaving is
+# actually observed (worker collectives in tests run on the main thread
+# between store ops, and CPU meshes exist only in tests).
+_CPU_COLLECTIVE_LOCK = threading.Lock()
+
 
 class ServerStore:
     """Device-resident sharded storage for one table + its updater state.
@@ -106,6 +124,34 @@ class ServerStore:
             and type(updater).__name__ in ("Updater", "SGDUpdater"))
         self._build_kernels()
         self._lock = threading.Lock()
+        devices = list(self.sharding.device_set)
+        self._serial_exec = (len(devices) > 1
+                             and devices[0].platform == "cpu")
+
+    @contextlib.contextmanager
+    def _dispatch_scope(self):
+        """Store-kernel dispatch guard. On multi-device CPU this takes the
+        process-wide collective lock (outer) around the store lock, and the
+        caller must finish execution before leaving (see _CPU_COLLECTIVE_LOCK
+        above); elsewhere it is just the store lock."""
+        if self._serial_exec:
+            with _CPU_COLLECTIVE_LOCK, self._lock:
+                yield
+        else:
+            with self._lock:
+                yield
+
+    def _finish(self, out):
+        """Block on ``out`` (any pytree) when this store serializes
+        execution (multi-device CPU); pass it through untouched on
+        accelerators. Callers must pass EVERY output of the dispatched
+        executable: XLA's thunk-based CPU runtime readies outputs
+        per-defining-thunk, so blocking on a subset can release the
+        collective lock while sibling-output thunks still occupy the
+        rendezvous."""
+        if self._serial_exec:
+            jax.block_until_ready(out)
+        return out
 
     # -- jitted kernels ----------------------------------------------------
     def _build_kernels(self) -> None:
@@ -169,24 +215,26 @@ class ServerStore:
     # reference that a writer is about to invalidate. The lock is held only
     # for the (async) dispatch, never for device execution.
     def apply_dense(self, delta: jax.Array, opt: AddOption) -> None:
-        with self._lock:
+        with self._dispatch_scope():
             self.data, self.state = self._dense_update(
                 self.data, self.state, delta, *opt.scalars())
+            self._finish((self.data, self.state))
 
     def apply_rows(self, row_ids: jax.Array, delta: jax.Array,
                    opt: AddOption) -> None:
-        with self._lock:
+        with self._dispatch_scope():
             self.data, self.state = self._row_update(
                 self.data, self.state, row_ids, delta, *opt.scalars())
+            self._finish((self.data, self.state))
 
     def read(self) -> jax.Array:
         """Logical (unpadded) view of the whole table (fresh buffer)."""
-        with self._lock:
-            return self._access(self.data)
+        with self._dispatch_scope():
+            return self._finish(self._access(self.data))
 
     def read_rows(self, row_ids: jax.Array) -> jax.Array:
-        with self._lock:
-            return self._access_rows(self.data, row_ids)
+        with self._dispatch_scope():
+            return self._finish(self._access_rows(self.data, row_ids))
 
     def block(self) -> None:
         """Wait until all previously dispatched updates have executed."""
@@ -248,7 +296,8 @@ class WorkerTable:
         self._sync = None
         if zoo.sync_mode and zoo.num_local_workers > 1:
             from multiverso_tpu.core.sync_coordinator import SyncCoordinator
-            self._sync = SyncCoordinator(zoo.num_local_workers)
+            self._sync = SyncCoordinator(zoo.num_local_workers,
+                                         name=getattr(self, "name", ""))
 
     # -- BSP gates (no-ops in async mode / single-worker worlds). Context
     # managers so a raise during application releases the in-flight slot

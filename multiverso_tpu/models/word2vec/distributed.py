@@ -43,6 +43,7 @@ from multiverso_tpu.parallel.ps_service import (DistributedKVTable,
                                                 DistributedMatrixTable,
                                                 DistributedSparseMatrixTable,
                                                 PSService)
+from multiverso_tpu.telemetry import span
 from multiverso_tpu.utils.log import check, log
 
 
@@ -256,6 +257,10 @@ class DistributedWord2Vec:
         return self._finish_block(prep, ops)
 
     def _finish_block(self, prep, ops) -> int:
+        with span("w2v.dist_block", rank=self.rank):
+            return self._finish_block_inner(prep, ops)
+
+    def _finish_block_inner(self, prep, ops) -> int:
         block, ids_in, ids_out, group = prep
         # Sparse tables keep the sequential incremental protocol (keyed
         # UpdateGetState is stateful per pull and only re-ships rows

@@ -38,6 +38,7 @@ from multiverso_tpu.models.word2vec.data import (BatchGenerator, BlockStream,
 from multiverso_tpu.models.word2vec.dictionary import (Dictionary,
                                                        HuffmanEncoder,
                                                        Sampler)
+from multiverso_tpu.telemetry import gauge, span
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check, log
 
@@ -636,15 +637,21 @@ class _DispatchQueue:
         from collections import deque
         self._depth = max(int(depth), 1)
         self._fifo = deque()
+        # Window-occupancy gauge: how much of the depth-N budget the host
+        # actually keeps in flight (a persistently full window means the
+        # device is the bottleneck; an empty one, the host).
+        self._g_inflight = gauge("w2v.dispatch_inflight")
 
     def push(self, marker) -> None:
         self._fifo.append(marker)
         while len(self._fifo) > self._depth:
             jax.block_until_ready(self._fifo.popleft())
+        self._g_inflight.set(len(self._fifo))
 
     def drain(self) -> None:
         while self._fifo:
             jax.block_until_ready(self._fifo.popleft())
+        self._g_inflight.set(0)
 
 
 def build_chunked_pipeline(window: int, negative: int, chunk: int,
@@ -985,7 +992,7 @@ class Word2Vec:
                 source = groups
             try:
                 for stacked, words, pairs in source:
-                    with monitor("W2V_GROUP"):
+                    with span("w2v.group"), monitor("W2V_GROUP"):
                         losses.append(self._run_group(stacked))
                     total_pairs += pairs
                     self.trained_words += words
@@ -1081,9 +1088,15 @@ class Word2Vec:
             mode = self._dispatch_mode if not sharded else "in_graph"
             W, chunk = self.cfg.window, self.cfg.batch_size
             inflight = _DispatchQueue(self.cfg.dispatch_depth)
+            # Per-mode chunk-dispatch latency: the monitor name carries the
+            # dispatch_mode so runs under different modes diff cleanly in
+            # telemetry_report (AUTO selector introspection, PR 2 follow-up).
+            dispatch_mon = f"W2V_DISPATCH_{mode.upper()}"
             try:
                 for mat, lens, words in source:
-                    with monitor("W2V_DEVICE_BLOCK"):
+                    with span("w2v.device_block", mode=mode), \
+                            monitor("W2V_DEVICE_BLOCK"), \
+                            monitor(dispatch_mon):
                         self._key, sub = jax.random.split(self._key)
                         lr = np.float32(self._current_lr() *
                                         self._push_scale)

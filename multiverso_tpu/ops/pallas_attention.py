@@ -22,6 +22,7 @@ ROADMAP perf #3).
 from __future__ import annotations
 
 import functools
+import inspect
 
 import jax
 import jax.numpy as jnp
@@ -147,11 +148,16 @@ def flash_block_attn(q: jax.Array, k: jax.Array, v: jax.Array,
             lambda offs, qr, kr, vr, *rest, **kws: _kernel(
                 offs, qr, kr, vr, None, *rest, **kws), **kw)
 
-    vma_set = frozenset(vma) if vma else None
+    # Pre-VMA jax has no ``vma=`` kwarg on ShapeDtypeStruct — and nothing
+    # to declare either (mesh.shard_map disables the replication check
+    # there), so the annotation is simply dropped.
+    sds_kw = {}
+    if vma and "vma" in inspect.signature(jax.ShapeDtypeStruct).parameters:
+        sds_kw["vma"] = frozenset(vma)
     out_shape = [
-        jax.ShapeDtypeStruct((bh, Sq, D), jnp.float32, vma=vma_set),
-        jax.ShapeDtypeStruct((bh, Sq), jnp.float32, vma=vma_set),
-        jax.ShapeDtypeStruct((bh, Sq), jnp.float32, vma=vma_set),
+        jax.ShapeDtypeStruct((bh, Sq, D), jnp.float32, **sds_kw),
+        jax.ShapeDtypeStruct((bh, Sq), jnp.float32, **sds_kw),
+        jax.ShapeDtypeStruct((bh, Sq), jnp.float32, **sds_kw),
     ]
     out_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),

@@ -28,6 +28,7 @@ exercised in interpret mode on CPU plus numerically on the real chip.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Tuple
 
@@ -37,10 +38,30 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x;
-# support both so the kernels load on every baked-in toolchain.
-CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+# jax renamed TPUCompilerParams -> CompilerParams across 0.4.x/0.5.x and
+# grew fields (has_side_effects) along the way; support every baked-in
+# toolchain by resolving the class AND dropping a known-safe subset of
+# kwargs the local version lacks. Only has_side_effects may be dropped
+# (it just guards against DCE, and every caller consumes the aliased
+# table output); semantics-bearing fields like dimension_semantics must
+# never be silently stripped — a sequential grid treated as parallel
+# corrupts donated table state with no error.
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) or \
     getattr(pltpu, "TPUCompilerParams")
+_COMPILER_PARAMS_FIELDS = {
+    f.name for f in dataclasses.fields(_COMPILER_PARAMS_CLS)}
+_DROPPABLE_PARAMS = {"has_side_effects"}
+
+
+def CompilerParams(**kwargs):
+    missing = set(kwargs) - _COMPILER_PARAMS_FIELDS
+    if missing - _DROPPABLE_PARAMS:
+        raise TypeError(
+            f"{_COMPILER_PARAMS_CLS.__name__} on this jax version lacks "
+            f"required field(s) {sorted(missing - _DROPPABLE_PARAMS)}; "
+            "refusing to drop them silently")
+    return _COMPILER_PARAMS_CLS(**{k: v for k, v in kwargs.items()
+                                   if k in _COMPILER_PARAMS_FIELDS})
 
 
 def group_for_dtype(dtype) -> int:

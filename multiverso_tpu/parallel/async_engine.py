@@ -27,6 +27,7 @@ import numpy as np
 from multiverso_tpu.core.options import AddOption
 from multiverso_tpu.core.updater import SGDUpdater, Updater
 from multiverso_tpu.runtime.ffi import DeltaBuffer
+from multiverso_tpu.telemetry import gauge
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check
 
@@ -62,6 +63,11 @@ class AsyncTableEngine:
         self.flush_pending = flush_pending
         self.sparse_drain_max = sparse_drain_max
         self._flush_lock = threading.Lock()
+        # Telemetry: staged-delta depth, sampled at every stage/drain
+        # (ASYNC_FLUSH latency rides the monitor below). Qualified by the
+        # wrapped table's name so two engines don't share one stream.
+        self._g_depth = gauge(
+            f"async_engine.queue_depth.{getattr(table, 'name', 'local')}")
         # Optional background flusher: bounds the staging window by TIME as
         # well as by count (ASGD staleness bound).
         self._stop_flusher = threading.Event()
@@ -86,6 +92,7 @@ class AsyncTableEngine:
             return
         with monitor("ASYNC_STAGE_ADD"):
             self._buf.add_dense(np.asarray(delta, dtype=np.float32))
+        self._g_depth.set(self._buf.pending)
         if self._buf.pending >= self.flush_pending:
             self.flush()
 
@@ -97,6 +104,7 @@ class AsyncTableEngine:
         with monitor("ASYNC_STAGE_ADD"):
             self._buf.add_rows(np.asarray(row_ids, dtype=np.int32),
                                np.asarray(deltas, dtype=np.float32))
+        self._g_depth.set(self._buf.pending)
         if self._buf.pending >= self.flush_pending:
             self.flush()
 
@@ -107,17 +115,21 @@ class AsyncTableEngine:
         with self._flush_lock:
             if self._buf.pending == 0:
                 return
-            with monitor("ASYNC_FLUSH"):
-                if self._is_matrix:
-                    sparse = self._buf.drain_rows(self.sparse_drain_max)
-                    if sparse is not None:
-                        ids, rows = sparse
-                        if len(ids):
-                            self.table.store.apply_rows(ids, rows, AddOption())
-                        return
-                merged, n = self._buf.drain_dense()
-                if n:
-                    self.table.store.apply_dense(merged, AddOption())
+            try:
+                with monitor("ASYNC_FLUSH"):
+                    if self._is_matrix:
+                        sparse = self._buf.drain_rows(self.sparse_drain_max)
+                        if sparse is not None:
+                            ids, rows = sparse
+                            if len(ids):
+                                self.table.store.apply_rows(ids, rows,
+                                                            AddOption())
+                            return
+                    merged, n = self._buf.drain_dense()
+                    if n:
+                        self.table.store.apply_dense(merged, AddOption())
+            finally:
+                self._g_depth.set(self._buf.pending)
 
     # -- reads (read-your-writes) ------------------------------------------
     def get(self, *args, **kwargs) -> np.ndarray:

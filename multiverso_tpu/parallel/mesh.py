@@ -10,6 +10,8 @@ sharding) can be requested via the ``mesh_shape`` flag.
 
 from __future__ import annotations
 
+import functools
+import inspect
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -20,6 +22,41 @@ from multiverso_tpu.utils.configure import get_flag
 
 SERVER_AXIS = "server"
 WORKER_AXIS = "worker"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` across jax versions. jax >= 0.6 exposes it
+    top-level with the replication check named ``check_vma``; older jax
+    only has ``jax.experimental.shard_map.shard_map`` with the same flag
+    named ``check_rep``. All framework shard_maps route through here so a
+    container's jax pin can't take out every multi-device code path."""
+    sm, rep_kwarg = _resolve_shard_map()
+    if not hasattr(jax.lax, "pvary") and not hasattr(jax.lax, "pcast"):
+        # Pre-VMA jax: our bodies can't annotate varying-ness (pvary does
+        # not exist), so check_rep would reject correct programs — e.g.
+        # a scan whose carry becomes varying mid-loop. The check is a
+        # debugging aid, not semantics; disable it outright here.
+        check_vma = False
+    if check_vma is None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **{rep_kwarg: check_vma})
+
+
+@functools.lru_cache(maxsize=1)
+def _resolve_shard_map():
+    """Resolve the shard_map callable and the name of its replication-check
+    kwarg (``check_vma`` on jax >= 0.6, ``check_rep`` before) by probing
+    the signature once, so genuine TypeErrors from bad specs propagate
+    instead of being retried under the other spelling."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        params = inspect.signature(sm).parameters
+    except (TypeError, ValueError):  # C-accelerated / unsigned callable
+        params = {}
+    return sm, ("check_vma" if "check_vma" in params else "check_rep")
 
 
 def parse_mesh_spec(spec: str) -> Dict[str, int]:

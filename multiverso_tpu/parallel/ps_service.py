@@ -44,6 +44,7 @@ from multiverso_tpu.core.zoo import Zoo
 from multiverso_tpu.parallel.mesh import reference_server_offsets
 from multiverso_tpu.parallel.net import recv_message, send_message
 from multiverso_tpu.runtime.ffi import DeltaBuffer
+from multiverso_tpu.telemetry import gauge
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check, log
 from multiverso_tpu.utils.quantization import OneBitsFilter, SparseFilter
@@ -262,6 +263,13 @@ class PSService:
         self._applied_bytes: Dict[int, int] = {}
         self._queue: "_queue_mod.Queue" = _queue_mod.Queue(
             maxsize=self.MAX_QUEUE)
+        # Telemetry: pending-request depth + per-worker add-stream lag
+        # (docs/OBSERVABILITY.md). Counts/gauges are dispatcher-thread only.
+        self._g_queue_depth = gauge("ps_service.queue_depth")
+        self._g_deferred_depth = gauge("ps_service.deferred_depth")
+        self._worker_add_counts: Dict[int, int] = {}
+        self._top_add_count = 0
+        self._staleness_gauges: Dict[int, object] = {}
         self._io_thread = threading.Thread(target=self._io_loop, daemon=True)
         self._dispatch_thread = threading.Thread(target=self._dispatch_loop,
                                                  daemon=True)
@@ -458,8 +466,35 @@ class PSService:
         except OSError:
             pass
 
+    def _note_worker_add(self, worker: int) -> None:
+        """Per-worker staleness: how many applied Adds the slowest push
+        stream trails the fastest by — the async-mode analog of the BSP
+        vector-clock lag (in sync mode the gated apply order makes the two
+        coincide). Dispatcher-thread only. The full-sweep refresh (which
+        keeps a stalled straggler's lag growing in snapshots) runs only
+        when the LEADER advances; otherwise just the sender's gauge moves
+        — O(1) amortized on the throughput-critical dispatch thread."""
+        n = self._worker_add_counts.get(worker, 0) + 1
+        self._worker_add_counts[worker] = n
+        g = self._staleness_gauges.get(worker)
+        if g is None:
+            g = self._staleness_gauges[worker] = gauge(
+                f"ps_service.staleness.worker_{worker}")
+        if n > self._top_add_count:
+            self._top_add_count = n
+            for w, c in self._worker_add_counts.items():
+                gw = self._staleness_gauges.get(w)
+                if gw is None:
+                    gw = self._staleness_gauges[w] = gauge(
+                        f"ps_service.staleness.worker_{w}")
+                gw.set(n - c)
+        else:
+            g.set(self._top_add_count - n)
+
     def _dispatch_loop(self) -> None:
         while True:
+            self._g_queue_depth.set(self._queue.qsize())
+            self._g_deferred_depth.set(len(self._deferred))
             # Sweep parked requests on EVERY pass (rate-limited), not just
             # on queue lulls — sustained traffic must not starve deferred
             # deadlines/replays (their Reply_Error is what keeps BSP's
@@ -665,6 +700,9 @@ class PSService:
                     st = self._sparse.get(msg.table_id)
                     if st is not None:
                         st.on_add(local, opt.worker_id)
+            # opt.worker_id is always a non-negative global id here (every
+            # sender maps through _gid; AddOption defaults to 0).
+            self._note_worker_add(opt.worker_id)
             return msg.create_reply()
         if msg.type == MsgType.Request_Get:
             keys = msg.data[0]
@@ -1183,6 +1221,14 @@ class DistributedTableBase:
         self._stage_buf: Optional[DeltaBuffer] = None
         self._stage_opt: Optional[AddOption] = None
         self._onebit_filters: Dict[int, OneBitsFilter] = {}
+        # Telemetry: staged-delta depth (flush queue) + unwaited add
+        # batches in flight — the DCN-path async engine gauges, qualified
+        # per table so concurrent tables' streams don't conflate
+        # (docs/OBSERVABILITY.md).
+        self._g_stage_depth = gauge(
+            f"async_engine.queue_depth.table_{table_id}")
+        self._g_inflight_adds = gauge(
+            f"async_engine.inflight_adds.table_{table_id}")
 
     def _gid(self, worker_id: int) -> int:
         """Global BSP worker id: contiguous per process (rank * local + k;
@@ -1309,6 +1355,7 @@ class DistributedTableBase:
             overflow = (self._inflight_adds.popleft()
                         if len(self._inflight_adds) > self.MAX_INFLIGHT_ADDS
                         else None)
+            self._g_inflight_adds.set(len(self._inflight_adds))
         if overflow is not None:
             overflow.wait(self._op_timeout)
 
@@ -1328,7 +1375,12 @@ class DistributedTableBase:
         every in-flight add batch."""
         with self._op_lock:
             if self._stage_buf is not None and self._stage_buf.pending:
-                op = self._flush_staged_locked()
+                # Distinct from the local engine's ASYNC_FLUSH: ~us device
+                # dispatches and ~ms DCN round-trips must not share one
+                # histogram (a wire regression would drown in the mix).
+                with monitor("DCN_FLUSH"):     # drain + wire fire latency
+                    op = self._flush_staged_locked()
+                self._g_stage_depth.set(self._stage_buf.pending)
                 for sid in self._staged_ids:
                     if sid in self._pending:    # not yet evicted
                         self._insert_pending(sid, op)
@@ -1563,6 +1615,7 @@ class DistributedArrayTable(DistributedTableBase):
                     self.flush()   # option change: can't merge across it
                 self._stage_opt = option
                 self._stage_buf.add_dense(delta)
+                self._g_stage_depth.set(self._stage_buf.pending)
                 msg_id = self._next_msg_id()
                 self._staged_ids.append(msg_id)
                 self._insert_pending(msg_id, _PendingOp([]))  # -> flush op
@@ -1729,6 +1782,7 @@ class DistributedMatrixTable(DistributedTableBase):
                     self.flush()
                 self._stage_opt = option
                 self._stage_buf.add_rows(rows, deltas)
+                self._g_stage_depth.set(self._stage_buf.pending)
                 msg_id = self._next_msg_id()
                 self._staged_ids.append(msg_id)
                 self._insert_pending(msg_id, _PendingOp([]))  # -> flush op

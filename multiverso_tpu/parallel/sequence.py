@@ -28,15 +28,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from multiverso_tpu.parallel.mesh import shard_map
+
 SEQ_AXIS = "seq"
 
 
 def _pvary(x, axis):
     """Mark ``x`` as varying over ``axis`` (jax>=0.9 renamed pvary to
-    pcast(..., to='varying'))."""
+    pcast(..., to='varying'); pre-VMA jax has neither and needs no mark —
+    the old check_rep system tracks replication without annotations)."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis, to="varying")
-    return jax.lax.pvary(x, axis)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis)
+    return x
 
 
 def _resolve_flash(use_flash, sq: int, sk: int, d: int) -> bool:
@@ -163,8 +168,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     # check_vma off on the flash path: jax's interpret/lowering of a
     # pallas_call inside shard_map mixes varying and unvarying internals
     # (jax suggests exactly this workaround in the error it raises).
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=not use_flash)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=not use_flash)
     return fn(q, k, v)
 
 
@@ -214,8 +219,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         return head_to_seq(o)
 
     spec = P(None, None, axis, None)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=not use_flash)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=not use_flash)
     return fn(q, k, v)
 
 

@@ -1,0 +1,42 @@
+"""Telemetry subsystem: histograms, counters, gauges, spans, exporters.
+
+The metrics layer behind the Dashboard (``utils/dashboard.py`` monitors
+are histogram-backed through this package) plus cross-actor tracing:
+
+* :func:`histogram` / :func:`counter` / :func:`gauge` — named metrics in
+  the process-global registry (``metrics.py``);
+* :func:`span` — host-side begin/end regions exported as Chrome
+  trace-event JSON, nested under ``jax.profiler.TraceAnnotation``
+  (``spans.py``);
+* :func:`start_exporter` / ``-telemetry_dir`` — periodic JSON snapshot +
+  trace export, with a multi-worker merge tool (``export.py``,
+  ``scripts/telemetry_report.py``).
+
+See docs/OBSERVABILITY.md for the metric catalog and schemas.
+"""
+
+from multiverso_tpu.telemetry.export import (SNAPSHOT_SCHEMA,
+                                             TelemetryExporter,
+                                             build_chrome_trace,
+                                             export_chrome_trace,
+                                             maybe_start_exporter_from_flags,
+                                             merge_traces, metrics_snapshot,
+                                             reset_telemetry, start_exporter,
+                                             stop_exporter,
+                                             validate_chrome_trace,
+                                             validate_snapshot)
+from multiverso_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
+                                              MetricsRegistry, counter,
+                                              gauge, get_registry, histogram)
+from multiverso_tpu.telemetry.spans import (TraceBuffer, current_identity,
+                                            get_trace_buffer, span)
+
+__all__ = [
+    "SNAPSHOT_SCHEMA", "TelemetryExporter", "build_chrome_trace",
+    "export_chrome_trace", "maybe_start_exporter_from_flags",
+    "merge_traces", "metrics_snapshot", "reset_telemetry", "start_exporter",
+    "stop_exporter", "validate_chrome_trace", "validate_snapshot",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "counter", "gauge",
+    "get_registry", "histogram",
+    "TraceBuffer", "current_identity", "get_trace_buffer", "span",
+]

@@ -1,0 +1,172 @@
+"""Host-side span API + Chrome trace-event buffer.
+
+``span(name, **attrs)`` records a begin/end pair as one Chrome
+trace-event "complete" event (``ph: "X"``) with process/thread identity
+and the framework's worker/server identity in ``args`` — and nests the
+region under ``jax.profiler.TraceAnnotation`` so the same name shows up
+in the XLA device trace (TensorBoard/xprof) when a profiler capture is
+active. Timestamps are wall-clock microseconds (Unix epoch), so traces
+exported by different processes of one run merge on a common time axis
+(the multi-worker merge tool just concatenates events; see
+``export.merge_traces``).
+
+Every span also feeds the ``span.<name>`` histogram in the metrics
+registry, so trace-level detail and snapshot-level percentiles never
+disagree about what was measured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+from multiverso_tpu.telemetry.metrics import get_registry
+
+__all__ = ["span", "TraceBuffer", "get_trace_buffer", "current_identity"]
+
+
+class TraceBuffer:
+    """Bounded, thread-safe RING of Chrome trace events: when full, the
+    OLDEST events are evicted (and counted as dropped) so the exported
+    trace always covers the most recent window — the one an operator
+    opens after a stall or crash. A long run never OOMs its own
+    observability layer."""
+
+    # Small by default: with no exporter consuming the buffer, a span-heavy
+    # run must not pin hundreds of MB of event dicts. start_exporter widens
+    # it to EXPORT_CAPACITY (there IS a consumer then).
+    DEFAULT_CAPACITY = 10_000
+    EXPORT_CAPACITY = 200_000
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        import collections
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._events: "collections.deque[Dict]" = \
+            collections.deque(maxlen=capacity)
+        self.dropped = 0
+
+    def set_capacity(self, capacity: int) -> None:
+        import collections
+        with self._lock:
+            if capacity == self.capacity:
+                return
+            self.capacity = capacity
+            self._events = collections.deque(self._events, maxlen=capacity)
+
+    def record(self, event: Dict) -> None:
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1       # deque evicts the oldest
+            self._events.append(event)
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+
+_buffer: Optional[TraceBuffer] = None
+_buffer_lock = threading.Lock()
+
+
+def get_trace_buffer() -> TraceBuffer:
+    global _buffer
+    with _buffer_lock:
+        if _buffer is None:
+            _buffer = TraceBuffer()
+        return _buffer
+
+
+_identity_cache: Optional[Dict] = None
+
+
+def current_identity() -> Dict:
+    """Best-effort worker/server identity for span/snapshot attribution.
+    Never raises and never forces runtime bring-up — telemetry must work
+    in a bare process (unit tests, scripts) exactly as in a full rank.
+    Cached once the runtime has started (identity is fixed after init);
+    re-probed until then so early spans pick the rank up later."""
+    global _identity_cache
+    if _identity_cache is not None:
+        return _identity_cache
+    ident: Dict = {"pid": os.getpid()}
+    started = False
+    try:
+        from multiverso_tpu.core.zoo import Zoo
+        zoo = Zoo._instance
+        if zoo is not None and getattr(zoo, "started", False):
+            started = True
+            ident["rank"] = int(zoo.rank())
+            ident["worker_id"] = int(zoo.worker_id())
+            ident["server_id"] = int(zoo.server_id())
+    except Exception:  # noqa: BLE001 - identity is attribution, not control
+        started = False
+    if "rank" not in ident:
+        try:
+            from multiverso_tpu.utils.configure import get_flag
+            ident["rank"] = int(get_flag("rank"))
+        except Exception:  # noqa: BLE001
+            ident["rank"] = 0
+    if started:
+        _identity_cache = ident
+    return ident
+
+
+def _reset_identity_cache() -> None:
+    global _identity_cache
+    _identity_cache = None
+
+
+def _trace_annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` when jax is importable; identity
+    otherwise (telemetry stays usable without an accelerator runtime)."""
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 - profiling sugar must never break
+        return contextlib.nullcontext()
+
+
+def _clean_attrs(attrs: Dict) -> Dict:
+    return {k: (v if isinstance(v, (int, float, bool, str)) or v is None
+                else str(v))
+            for k, v in attrs.items()}
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs) -> Iterator[None]:
+    """Named host-side region: Chrome trace event + ``span.<name>``
+    latency histogram + nested device-trace annotation."""
+    ident = current_identity()
+    ts_us = time.time() * 1e6
+    t0 = time.perf_counter()
+    try:
+        with _trace_annotation(name):
+            yield
+    finally:
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        args = _clean_attrs(attrs)
+        args["rank"] = ident.get("rank", 0)
+        get_trace_buffer().record({
+            "name": name,
+            "ph": "X",
+            "ts": int(ts_us),
+            "dur": max(int(dur_ms * 1e3), 0),
+            "pid": ident["pid"],
+            "tid": threading.get_ident() % (1 << 31),
+            "cat": "multiverso_tpu",
+            "args": args,
+        })
+        get_registry().histogram(f"span.{name}").observe(dur_ms)

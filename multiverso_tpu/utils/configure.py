@@ -210,3 +210,10 @@ define_int("world_size", 1, "number of processes (ranks)")
 define_int("rank", 0, "this process's rank")
 define_string("platform", "", "force the jax platform (e.g. 'cpu') before "
               "first device use — lets CLIs run when the TPU is unreachable")
+# Telemetry export (multiverso_tpu/telemetry; docs/OBSERVABILITY.md).
+define_string("telemetry_dir", "", "write periodic metrics snapshots "
+              "(metrics-<pid>-<seq>.json) and a Chrome trace "
+              "(trace-<pid>.json) here; empty = telemetry export off")
+define_double("telemetry_interval", 10.0, "seconds between telemetry "
+              "snapshot exports (a final snapshot is always written at "
+              "shutdown)")

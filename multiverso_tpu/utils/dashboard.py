@@ -5,6 +5,15 @@ Parity with ``include/multiverso/dashboard.h:16-74``: each Monitor tracks
 registry that can display all monitors. The ``MONITOR_BEGIN/END(name)`` macro
 pair becomes the :func:`monitor` context manager / decorator.
 
+Beyond the reference: every Monitor is backed by a fixed log-bucket
+histogram in the telemetry registry (``multiverso_tpu/telemetry``), so
+``info_string`` reports p50/p95/p99/max alongside count/total/average and
+the same numbers ship in telemetry snapshots. ``begin``/``end`` keep a
+THREAD-LOCAL begin stack: concurrent use of one monitor from several
+threads (two PS service threads in the same region) and nested regions on
+one thread both time correctly — the reference's single shared begin
+timestamp would be clobbered.
+
 TPU note: wall-clock around dispatch measures host time only; jitted work is
 asynchronous. Callers that want device-inclusive timing should block on the
 result (``jax.block_until_ready``) inside the monitored region — the perf
@@ -19,43 +28,63 @@ import threading
 import time
 from typing import Callable, Dict, Iterator, TypeVar
 
+from multiverso_tpu.telemetry.metrics import Histogram, get_registry
+from multiverso_tpu.utils.log import log
+
 F = TypeVar("F", bound=Callable)
 
 
 class Monitor:
-    __slots__ = ("name", "count", "total_ms", "_begin", "_lock")
+    __slots__ = ("name", "_hist", "_local")
 
     def __init__(self, name: str):
         self.name = name
-        self.count = 0
-        self.total_ms = 0.0
-        self._begin = None
-        self._lock = threading.Lock()
+        # The histogram IS the storage: Monitor numbers and telemetry
+        # snapshots can never disagree about what was measured.
+        self._hist: Histogram = get_registry().histogram(name)
+        self._local = threading.local()
 
     def begin(self) -> None:
-        self._begin = time.perf_counter()
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(time.perf_counter())
 
     def end(self) -> None:
-        if self._begin is None:
+        stack = getattr(self._local, "stack", None)
+        if not stack:
             return
-        elapsed = (time.perf_counter() - self._begin) * 1000.0
-        self._begin = None
-        with self._lock:
-            self.count += 1
-            self.total_ms += elapsed
+        elapsed = (time.perf_counter() - stack.pop()) * 1000.0
+        self._hist.observe(elapsed)
 
     def add(self, elapsed_ms: float) -> None:
-        with self._lock:
-            self.count += 1
-            self.total_ms += elapsed_ms
+        self._hist.observe(elapsed_ms)
+
+    @property
+    def count(self) -> int:
+        return self._hist.count
+
+    @property
+    def total_ms(self) -> float:
+        return self._hist.sum
 
     @property
     def average_ms(self) -> float:
-        return self.total_ms / self.count if self.count else 0.0
+        snap = self._hist.snapshot()
+        return snap["mean_ms"]
+
+    def snapshot(self) -> Dict:
+        """Consistent structured view (count/total/percentiles read under
+        the histogram lock in one acquisition)."""
+        return self._hist.snapshot()
 
     def info_string(self) -> str:
-        return (f"[{self.name}] count = {self.count}, total = {self.total_ms:.2f}ms, "
-                f"average = {self.average_ms:.3f}ms")
+        s = self.snapshot()
+        return (f"[{self.name}] count = {s['count']}, "
+                f"total = {s['sum_ms']:.2f}ms, "
+                f"average = {s['mean_ms']:.3f}ms, "
+                f"p50 = {s['p50']:.3f}ms, p95 = {s['p95']:.3f}ms, "
+                f"p99 = {s['p99']:.3f}ms, max = {s['max_ms']:.3f}ms")
 
 
 class Dashboard:
@@ -75,18 +104,36 @@ class Dashboard:
         return cls.get(name).info_string()
 
     @classmethod
-    def display(cls) -> str:
+    def display(cls, echo: bool = False) -> str:
+        """All monitors, one line each. Returns the report; ``echo=True``
+        (the CLI path) additionally emits it via ``log.raw`` (stdout +
+        the -log_file sink, so a persisted run log keeps its own
+        performance summary)."""
         with cls._lock:
-            lines = [m.info_string() for m in cls._monitors.values()]
-        report = "\n".join(lines)
-        if report:
-            print(report)
+            monitors = list(cls._monitors.values())
+        report = "\n".join(m.info_string() for m in monitors)
+        if echo and report:
+            log.raw(report)
         return report
 
     @classmethod
-    def reset(cls) -> None:
+    def snapshot(cls) -> Dict[str, Dict]:
+        """Structured {name: histogram snapshot} for every monitor."""
         with cls._lock:
+            monitors = list(cls._monitors.values())
+        return {m.name: m.snapshot() for m in monitors}
+
+    @classmethod
+    def reset(cls) -> None:
+        """Clear every monitor AND its backing histogram — the pre-PR
+        zeroing contract: a re-created monitor of the same name must not
+        resume the old counts."""
+        with cls._lock:
+            names = list(cls._monitors)
             cls._monitors.clear()
+        registry = get_registry()
+        for name in names:
+            registry.drop(name)
 
 
 @contextlib.contextmanager

@@ -66,6 +66,18 @@ class Logger:
             if self._file is not None:
                 self._file.write(line + "\n")
 
+    def raw(self, msg: str, *args: Any) -> None:
+        """Un-leveled, un-stamped line to stdout (+ file sink): CLI result
+        output (topic lists, reports) whose format is the interface. The
+        sanctioned alternative to a bare ``print`` in framework code (the
+        no-bare-print lint allows only this module)."""
+        if args:
+            msg = msg % args
+        with self._lock:
+            sys.stdout.write(msg + "\n")
+            if self._file is not None:
+                self._file.write(msg + "\n")
+
     def debug(self, msg: str, *args: Any) -> None:
         self._emit(LogLevel.DEBUG, msg, *args)
 

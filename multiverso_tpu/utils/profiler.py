@@ -5,12 +5,14 @@ The reference's observability is Dashboard counters around hot spots
 is the XLA profiler: :func:`trace` wraps ``jax.profiler`` so a training span
 can be captured and inspected (TensorBoard / xprof), and
 :func:`annotate` marks named regions that show up both in the device trace
-and the host Dashboard.
+and the host Dashboard. Host-side span events with Chrome-trace export live
+in ``multiverso_tpu/telemetry`` (:func:`multiverso_tpu.telemetry.span`).
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Iterator
 
 from multiverso_tpu.utils.dashboard import monitor
@@ -21,11 +23,18 @@ def trace(log_dir: str) -> Iterator[None]:
     """Capture a device+host profile for the enclosed span."""
     import jax
 
-    jax.profiler.start_trace(log_dir)
+    os.makedirs(log_dir, exist_ok=True)
+    started = False
     try:
+        jax.profiler.start_trace(log_dir)
+        started = True
         yield
     finally:
-        jax.profiler.stop_trace()
+        # A failed start must not trigger a stop (stop_trace on a profiler
+        # that never started raises its own, misleading error and masks
+        # the original failure).
+        if started:
+            jax.profiler.stop_trace()
 
 
 @contextlib.contextmanager

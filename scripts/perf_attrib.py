@@ -65,6 +65,10 @@ def main() -> None:
     p.add_argument("--dry-run", action="store_true",
                    help="tiny shapes, 1 iter, Pallas interpreted: CI "
                         "smoke so the harness cannot bit-rot off-chip")
+    p.add_argument("--telemetry-dir", default="",
+                   help="write telemetry snapshots + Chrome trace here "
+                        "(each leg becomes a span; snapshots carry the "
+                        "span.perf_attrib.* latency histograms)")
     args = p.parse_args()
     if args.dry_run:
         args.vocab, args.dim, args.chunk = 512, 32, 64
@@ -74,6 +78,15 @@ def main() -> None:
     import jax.numpy as jnp
 
     from multiverso_tpu.models.word2vec.model import raw_sg_ns_step
+    from multiverso_tpu.telemetry import span, start_exporter, stop_exporter
+
+    if args.telemetry_dir:
+        start_exporter(args.telemetry_dir, interval=5.0)
+        # A leg that dies (TPU OOM, compile error) must still flush the
+        # partial spans — that run is exactly the one worth inspecting.
+        # stop_exporter is idempotent, so the explicit calls below remain.
+        import atexit
+        atexit.register(stop_exporter)
 
     V, D, C, K, N = (args.vocab, args.dim, args.chunk, args.negative,
                      args.chunks)
@@ -100,10 +113,11 @@ def main() -> None:
         best = float("inf")
         for _ in range(args.iters):
             ops = tables() + operands[4:]   # fresh tables (donation)
-            t0 = time.perf_counter()
-            out = fn(*ops)
-            jax.block_until_ready(out)
-            best = min(best, time.perf_counter() - t0)
+            with span(f"perf_attrib.{name}", leg=name):
+                t0 = time.perf_counter()
+                out = fn(*ops)
+                jax.block_until_ready(out)
+                best = min(best, time.perf_counter() - t0)
         ms = best * 1e3 / per_chunk
         print(f"{name:14s} {ms:8.3f} ms/chunk")
         return ms
@@ -116,11 +130,13 @@ def main() -> None:
     best = float("inf")
     for _ in range(args.iters):
         w = tables()
-        t0 = time.perf_counter()
-        for i in range(N):
-            w = step(*w, centers[i], contexts[i], negs[i], mask[i], lr)[:4]
-        jax.block_until_ready(w)
-        best = min(best, time.perf_counter() - t0)
+        with span("perf_attrib.A standalone", leg="A standalone"):
+            t0 = time.perf_counter()
+            for i in range(N):
+                w = step(*w, centers[i], contexts[i], negs[i], mask[i],
+                         lr)[:4]
+            jax.block_until_ready(w)
+            best = min(best, time.perf_counter() - t0)
     print(f"{'A standalone':14s} {best * 1e3 / N:8.3f} ms/chunk")
 
     # B: fori_loop full ------------------------------------------------------
@@ -243,6 +259,7 @@ def main() -> None:
     if Vg is None:
         print(f"{'G pallas-grid':14s}  skipped: no VMEM-eligible vocab "
               f"<= {V} at D={D} chunk={C}")
+        stop_exporter()     # final snapshot/trace even on the skip path
         return
     interp = jax.devices()[0].platform != "tpu"
     cs_g, os_g, ns_g = centers % Vg, contexts % Vg, negs % Vg
@@ -262,10 +279,11 @@ def main() -> None:
     best = float("inf")
     for _ in range(args.iters):
         w = g_tables()
-        t0 = time.perf_counter()
-        out = grid(*w, cs_g, os_g, ns_g, n_pairs, lr)
-        jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - t0)
+        with span("perf_attrib.G pallas-grid", leg="G pallas-grid"):
+            t0 = time.perf_counter()
+            out = grid(*w, cs_g, os_g, ns_g, n_pairs, lr)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
     tag = f" (V={Vg}" + (", interpret)" if interp else ")")
     print(f"{'G pallas-grid':14s} {best * 1e3 / N:8.3f} ms/chunk{tag}")
 
@@ -282,11 +300,13 @@ def main() -> None:
     best = float("inf")
     for _ in range(args.iters):
         w = g_tables()
-        t0 = time.perf_counter()
-        out = fn(*w)
-        jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - t0)
+        with span("perf_attrib.H fori @ Vg", leg="H fori @ Vg"):
+            t0 = time.perf_counter()
+            out = fn(*w)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
     print(f"{'H fori @ Vg':14s} {best * 1e3 / N:8.3f} ms/chunk (V={Vg})")
+    stop_exporter()     # writes the final snapshot + Chrome trace
 
 
 if __name__ == "__main__":

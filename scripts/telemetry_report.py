@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Telemetry report/diff/merge CLI over ``-telemetry_dir`` output.
+
+Reads the snapshot + trace files a run wrote into its telemetry directory
+(``metrics-<pid>-<seq>.json`` / ``trace-<pid>.json``, schema in
+docs/OBSERVABILITY.md) and renders a metric catalog per process:
+histogram percentiles, gauge extrema, counters.
+
+Usage:
+
+    # catalog of one run
+    python scripts/telemetry_report.py /tmp/t
+
+    # diff two runs (e.g. dispatch_mode=pipelined_host vs pallas_grid)
+    python scripts/telemetry_report.py /tmp/t_new --baseline /tmp/t_old
+
+    # merge per-rank Chrome traces into one Perfetto-loadable file
+    python scripts/telemetry_report.py /tmp/t --merge-trace /tmp/merged.json
+
+No jax import: usable on any host, including ones without the TPU tunnel.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# Final snapshots further apart than this are treated as belonging to
+# different runs of a reused -telemetry_dir (ranks of one run stop within
+# seconds of each other; separate runs are minutes-to-days apart).
+RUN_SPLIT_SECONDS = 300.0
+
+
+def latest_snapshots(telemetry_dir):
+    """Final (highest-seq) snapshot per pid of the NEWEST run.
+
+    Nothing cleans a reused ``-telemetry_dir``, so the directory may hold
+    snapshots from several runs (distinct pids). Blending them would
+    count-weight percentiles across unrelated runs with no warning;
+    instead keep only pids whose final snapshot time is within
+    ``RUN_SPLIT_SECONDS`` of the newest one, and say what was dropped."""
+    best = {}
+    for path in glob.glob(os.path.join(telemetry_dir, "metrics-*.json")):
+        base = os.path.basename(path)[len("metrics-"):-len(".json")]
+        try:
+            pid, seq = (int(x) for x in base.split("-"))
+        except ValueError:
+            continue
+        if pid not in best or seq > best[pid][0]:
+            best[pid] = (seq, path)
+    out = []
+    for pid, (_, path) in sorted(best.items()):
+        try:
+            with open(path) as f:
+                out.append(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"warning: unreadable snapshot {path}: {e}",
+                  file=sys.stderr)
+    times = [s.get("time_unix", 0.0) for s in out]
+    if times:
+        newest = max(times)
+        stale = [s for s, t in zip(out, times)
+                 if newest - t > RUN_SPLIT_SECONDS]
+        if stale:
+            print(f"warning: {telemetry_dir} holds snapshots from "
+                  f"{len(stale)} older process(es) (> {RUN_SPLIT_SECONDS:.0f}s "
+                  f"before the newest run); ignoring pids "
+                  f"{sorted(s.get('pid') for s in stale)}", file=sys.stderr)
+            out = [s for s, t in zip(out, times)
+                   if newest - t <= RUN_SPLIT_SECONDS]
+    return out
+
+
+def combine(snapshots):
+    """One name->summary view across processes: histogram counts sum and
+    percentiles combine count-weighted (approximation — documented as
+    such); gauges take the max over processes; counters sum."""
+    hists, gauges, counters = {}, {}, {}
+    for snap in snapshots:
+        for name, h in snap.get("histograms", {}).items():
+            agg = hists.setdefault(name, {"count": 0, "sum_ms": 0.0,
+                                          "max_ms": 0.0, "_wp": [0.0] * 3})
+            n = h.get("count", 0)
+            agg["count"] += n
+            agg["sum_ms"] += h.get("sum_ms", 0.0)
+            agg["max_ms"] = max(agg["max_ms"], h.get("max_ms", 0.0))
+            for i, q in enumerate(("p50", "p95", "p99")):
+                agg["_wp"][i] += h.get(q, 0.0) * n
+        for name, g in snap.get("gauges", {}).items():
+            agg = gauges.setdefault(name, {"last": 0.0, "max": 0.0,
+                                           "samples": 0})
+            agg["last"] = max(agg["last"], g.get("last", 0.0))
+            agg["max"] = max(agg["max"], g.get("max", 0.0))
+            agg["samples"] += g.get("samples", 0)
+        for name, c in snap.get("counters", {}).items():
+            counters[name] = counters.get(name, 0) + c.get("value", 0)
+    for agg in hists.values():
+        n = max(agg["count"], 1)
+        agg["p50"], agg["p95"], agg["p99"] = (w / n for w in agg.pop("_wp"))
+    return hists, gauges, counters
+
+
+def print_catalog(telemetry_dir, snapshots):
+    print(f"== {telemetry_dir}: {len(snapshots)} process(es)")
+    hists, gauges, counters = combine(snapshots)
+    if hists:
+        print(f"{'histogram':40s} {'count':>8s} {'p50ms':>10s} "
+              f"{'p95ms':>10s} {'p99ms':>10s} {'maxms':>10s}")
+        for name in sorted(hists):
+            h = hists[name]
+            print(f"{name:40s} {h['count']:8d} {h['p50']:10.3f} "
+                  f"{h['p95']:10.3f} {h['p99']:10.3f} {h['max_ms']:10.3f}")
+    if gauges:
+        print(f"\n{'gauge':40s} {'last':>10s} {'max':>10s} {'samples':>8s}")
+        for name in sorted(gauges):
+            g = gauges[name]
+            print(f"{name:40s} {g['last']:10.1f} {g['max']:10.1f} "
+                  f"{g['samples']:8d}")
+    if counters:
+        print(f"\n{'counter':40s} {'value':>10s}")
+        for name in sorted(counters):
+            print(f"{name:40s} {counters[name]:10d}")
+
+
+def print_diff(new_dir, base_dir):
+    new_h, _, _ = combine(latest_snapshots(new_dir))
+    old_h, _, _ = combine(latest_snapshots(base_dir))
+    names = sorted(set(new_h) | set(old_h))
+    print(f"== diff {new_dir} vs {base_dir} (histogram p95, ms)")
+    print(f"{'histogram':40s} {'base':>10s} {'new':>10s} {'delta%':>8s}")
+    for name in names:
+        old = old_h.get(name, {}).get("p95")
+        new = new_h.get(name, {}).get("p95")
+        if old is None or new is None:
+            tag = "new" if old is None else "gone"
+            print(f"{name:40s} {'-' if old is None else f'{old:.3f}':>10s} "
+                  f"{'-' if new is None else f'{new:.3f}':>10s} "
+                  f"{tag:>8s}")
+            continue
+        if not old:
+            # Zero baseline: any nonzero new value is an appearance, not
+            # a 0% change; mirror the "new"/"gone" tagging above.
+            tag = "new" if new else "="
+            print(f"{name:40s} {old:10.3f} {new:10.3f} {tag:>8s}")
+            continue
+        delta = (new - old) / old * 100.0
+        print(f"{name:40s} {old:10.3f} {new:10.3f} {delta:+7.1f}%")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("telemetry_dir", help="run's -telemetry_dir")
+    p.add_argument("--baseline", default="",
+                   help="another run's telemetry dir to diff against")
+    p.add_argument("--merge-trace", default="",
+                   help="write one merged Chrome trace for all ranks here")
+    args = p.parse_args()
+
+    if args.merge_trace:
+        from multiverso_tpu.telemetry import merge_traces
+        paths = glob.glob(os.path.join(args.telemetry_dir, "trace-*.json"))
+        if not paths:
+            print(f"no trace-*.json under {args.telemetry_dir}",
+                  file=sys.stderr)
+            return 1
+        merged = merge_traces(paths, out_path=args.merge_trace)
+        print(f"merged {len(paths)} trace(s), "
+              f"{len(merged['traceEvents'])} events -> {args.merge_trace}")
+
+    snapshots = latest_snapshots(args.telemetry_dir)
+    if not snapshots:
+        print(f"no metrics-*.json under {args.telemetry_dir}",
+              file=sys.stderr)
+        return 1
+    print_catalog(args.telemetry_dir, snapshots)
+    if args.baseline:
+        print()
+        print_diff(args.telemetry_dir, args.baseline)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

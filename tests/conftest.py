@@ -31,6 +31,7 @@ def clean_framework_state():
     MultiversoEnv fixture per suite, Test/unittests/multiverso_env.h:9-29)."""
     yield
     from multiverso_tpu.core.zoo import Zoo
+    from multiverso_tpu.telemetry import reset_telemetry
     from multiverso_tpu.utils.configure import reset_flags
     from multiverso_tpu.utils.dashboard import Dashboard
 
@@ -43,6 +44,8 @@ def clean_framework_state():
     Zoo._reset_for_tests()
     reset_flags()
     Dashboard.reset()
+    reset_telemetry()   # registry + span buffer + exporter (monitors'
+    # backing histograms live in the telemetry registry)
 
 
 @pytest.fixture

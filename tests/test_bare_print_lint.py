@@ -1,0 +1,61 @@
+"""Lint: no bare ``print(`` inside ``multiverso_tpu/``.
+
+Framework output routes through ``utils/log.py`` (leveled lines, optional
+file sink, ``log.raw`` for format-stable CLI results) or the Dashboard's
+explicit ``display(echo=True)`` path — a bare print bypasses the file
+sink, breaks log-level filtering, and interleaves across the PS service's
+threads. ``utils/log.py`` itself is the one sanctioned emitter."""
+
+import io
+import os
+import tokenize
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "multiverso_tpu")
+_ALLOWED = {os.path.join("multiverso_tpu", "utils", "log.py")}
+
+
+def _print_calls(path):
+    """(line, col) of every ``print(`` NAME token — tokenizer-based, so
+    strings, comments, and attributes like ``pprint.print`` don't trip."""
+    with open(path, "rb") as f:
+        source = f.read()
+    hits = []
+    tokens = list(tokenize.tokenize(io.BytesIO(source).readline))
+    for i, tok in enumerate(tokens):
+        if tok.type != tokenize.NAME or tok.string != "print":
+            continue
+        # attribute access (x.print) is not the builtin
+        prev = next((t for t in reversed(tokens[:i])
+                     if t.type not in (tokenize.NL, tokenize.NEWLINE,
+                                       tokenize.INDENT, tokenize.DEDENT,
+                                       tokenize.COMMENT)), None)
+        if prev is not None and prev.type == tokenize.OP \
+                and prev.string == ".":
+            continue
+        nxt = next((t for t in tokens[i + 1:]
+                    if t.type not in (tokenize.NL, tokenize.NEWLINE,
+                                      tokenize.COMMENT)), None)
+        if nxt is not None and nxt.type == tokenize.OP \
+                and nxt.string == "(":
+            hits.append((tok.start[0], tok.start[1]))
+    return hits
+
+
+def test_no_bare_print_in_framework():
+    offenders = []
+    for root, _, files in os.walk(_PKG):
+        if "__pycache__" in root:
+            continue
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, _REPO)
+            if rel in _ALLOWED:
+                continue
+            for line, col in _print_calls(path):
+                offenders.append(f"{rel}:{line}:{col}")
+    assert not offenders, (
+        "bare print( in framework code (route through utils/log.py or "
+        "Dashboard.display(echo=True)): " + ", ".join(offenders))

@@ -1,0 +1,358 @@
+"""Telemetry layer tests: histogram math, concurrent monitors, trace
+schema, exporter files, multi-worker merge, and the end-to-end CPU
+word2vec smoke (ISSUE 3 acceptance: a ``-telemetry_dir`` run emits a
+loadable Chrome trace and snapshots with PS latency percentiles,
+async-engine queue-depth samples, and per-worker staleness gauges)."""
+
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.telemetry import (Histogram, build_chrome_trace,
+                                      export_chrome_trace, gauge,
+                                      get_registry, get_trace_buffer,
+                                      merge_traces, metrics_snapshot,
+                                      span, start_exporter, stop_exporter,
+                                      validate_chrome_trace,
+                                      validate_snapshot)
+from multiverso_tpu.utils.dashboard import Dashboard, monitor
+
+
+# -- histogram math ---------------------------------------------------------
+def test_histogram_bucket_boundaries():
+    h = Histogram("b")
+    # Exact bucket edges are INCLUSIVE upper bounds: (lo*2^(i-1), lo*2^i].
+    assert Histogram.bucket_index(0.0) == 0
+    assert Histogram.bucket_index(0.0005) == 0
+    assert Histogram.bucket_index(Histogram.LO_MS) == 0
+    assert Histogram.bucket_index(Histogram.BOUNDS[1]) == 1
+    assert Histogram.bucket_index(Histogram.BOUNDS[1] * 1.01) == 2
+    for i, edge in enumerate(Histogram.BOUNDS):
+        assert Histogram.bucket_index(edge) == i, edge
+    # Beyond the last bound: the overflow bucket, never an IndexError.
+    assert Histogram.bucket_index(Histogram.BOUNDS[-1] * 100) == \
+        Histogram.N_BOUNDS
+    for v in (0.0004, 0.003, 1.7, 900.0, 1e9):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert sum(snap["bucket_counts"]) == 5
+    assert snap["bucket_counts"][-1] == 1          # the 1e9 overflow
+    assert snap["max_ms"] == 1e9
+
+
+def test_histogram_percentiles_against_numpy():
+    rng = np.random.default_rng(42)
+    samples = rng.lognormal(mean=1.0, sigma=1.2, size=5000)   # ms
+    h = Histogram("p")
+    for v in samples:
+        h.observe(float(v))
+    for q in (0.50, 0.95, 0.99):
+        ours = h.percentile(q)
+        ref = float(np.quantile(samples, q))
+        # Log-2 buckets with geometric interpolation: within one bucket
+        # ratio of the exact quantile.
+        assert ref / 2 <= ours <= ref * 2, (q, ours, ref)
+    assert h.percentile(1.0) == pytest.approx(float(samples.max()))
+    snap = h.snapshot()
+    assert snap["p50"] <= snap["p95"] <= snap["p99"] <= snap["max_ms"]
+
+
+def test_histogram_empty_and_single():
+    h = Histogram("e")
+    assert h.percentile(0.99) == 0.0
+    assert h.snapshot()["count"] == 0
+    h.observe(3.5)
+    # One sample: every percentile is that sample (min/max clamping).
+    assert h.percentile(0.5) == pytest.approx(3.5)
+    assert h.percentile(0.99) == pytest.approx(3.5)
+
+
+# -- monitors under concurrency --------------------------------------------
+def test_concurrent_monitor_stress():
+    n_threads, n_iter = 8, 300
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(n_iter):
+                with monitor("stress_op"):
+                    pass
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    m = Dashboard.get("stress_op")
+    assert m.count == n_threads * n_iter
+    snap = m.snapshot()
+    assert snap["count"] == n_threads * n_iter
+    assert snap["min_ms"] >= 0.0
+    assert snap["p50"] <= snap["max_ms"]
+
+
+def test_monitor_begin_not_clobbered_across_threads():
+    """Two threads in the same monitored region: each end() must pair with
+    ITS OWN begin (the old shared ``_begin`` was clobbered, yielding one
+    tiny duration and one dropped)."""
+    m = Dashboard.get("clobber_op")
+    a_begun = threading.Event()
+    b_done = threading.Event()
+
+    def slow():
+        m.begin()
+        a_begun.set()
+        b_done.wait(5)
+        time.sleep(0.02)
+        m.end()
+
+    def fast():
+        a_begun.wait(5)
+        m.begin()
+        time.sleep(0.01)
+        m.end()
+        b_done.set()
+
+    ta, tb = threading.Thread(target=slow), threading.Thread(target=fast)
+    ta.start()
+    tb.start()
+    ta.join()
+    tb.join()
+    snap = m.snapshot()
+    assert snap["count"] == 2
+    # The slow thread's span covers the fast thread's whole window (>=30ms);
+    # under the clobbered shared-begin it would measure ~20ms from B's begin.
+    assert snap["max_ms"] >= 25.0, snap
+
+
+def test_monitor_nested_same_thread():
+    m = Dashboard.get("nested_op")
+    m.begin()
+    m.begin()
+    time.sleep(0.005)
+    m.end()            # inner
+    time.sleep(0.005)
+    m.end()            # outer: must use the OUTER begin (stack, not slot)
+    snap = m.snapshot()
+    assert snap["count"] == 2
+    assert snap["max_ms"] >= 9.0, snap          # outer ~10ms
+    assert snap["min_ms"] >= 4.0, snap          # inner ~5ms
+
+
+def test_dashboard_display_returns_without_echo(capsys):
+    Dashboard.get("quiet_op").add(1.0)
+    report = Dashboard.display()
+    assert "quiet_op" in report and "p95" in report
+    assert capsys.readouterr().out == ""        # echo=False: no stdout
+    Dashboard.display(echo=True)
+    assert "quiet_op" in capsys.readouterr().out
+
+
+# -- spans + chrome trace ---------------------------------------------------
+def test_span_records_trace_event_and_histogram():
+    with span("unit.test_span", mode="x", idx=3):
+        time.sleep(0.002)
+    events = [e for e in get_trace_buffer().events()
+              if e["name"] == "unit.test_span"]
+    assert events, "span did not reach the trace buffer"
+    ev = events[-1]
+    assert ev["ph"] == "X" and ev["dur"] >= 1000      # us
+    assert ev["args"]["mode"] == "x" and ev["args"]["idx"] == 3
+    assert "rank" in ev["args"]
+    h = get_registry().histogram("span.unit.test_span")
+    assert h.count >= 1
+
+
+def test_chrome_trace_schema(tmp_path):
+    for i in range(3):
+        with span("unit.trace_schema", i=i):
+            pass
+    trace = build_chrome_trace()
+    validate_chrome_trace(trace)
+    # JSON round-trip (what chrome://tracing actually loads)
+    path = tmp_path / "trace.json"
+    export_chrome_trace(str(path))
+    loaded = json.loads(path.read_text())
+    validate_chrome_trace(loaded)
+    xs = [e for e in loaded["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) >= 3
+    assert any(e["ph"] == "M" for e in loaded["traceEvents"])
+
+
+def test_validate_chrome_trace_rejects_garbage():
+    with pytest.raises(ValueError):
+        validate_chrome_trace([])
+    with pytest.raises(ValueError):
+        validate_chrome_trace({"traceEvents": [{"ph": "X", "pid": 1}]})
+    with pytest.raises(ValueError):
+        validate_chrome_trace({"traceEvents": [
+            {"ph": "X", "pid": 1, "tid": 1, "name": "a",
+             "ts": -5, "dur": 1}]})
+
+
+def test_merge_traces_multi_worker(tmp_path):
+    """Two processes' trace files merge into one multi-track trace."""
+    def fake_trace(pid, t0):
+        return {"traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+             "args": {"name": f"rank {pid}"}},
+            {"ph": "X", "name": "op", "pid": pid, "tid": 1,
+             "ts": t0, "dur": 10, "args": {}}],
+            "displayTimeUnit": "ms"}
+
+    p1, p2 = tmp_path / "trace-100.json", tmp_path / "trace-200.json"
+    p1.write_text(json.dumps(fake_trace(100, 2000)))
+    p2.write_text(json.dumps(fake_trace(200, 1000)))
+    out = tmp_path / "merged.json"
+    merged = merge_traces([str(p1), str(p2)], out_path=str(out))
+    validate_chrome_trace(merged)
+    validate_chrome_trace(json.loads(out.read_text()))
+    xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert [e["ts"] for e in xs] == [1000, 2000]      # time-sorted
+    metas = [e for e in merged["traceEvents"] if e["ph"] == "M"]
+    assert {e["pid"] for e in metas} == {100, 200}
+
+
+# -- snapshots + exporter ---------------------------------------------------
+def test_snapshot_schema_and_contents():
+    gauge("unit.depth").set(7)
+    get_registry().counter("unit.events").inc(3)
+    with monitor("unit.snap_op"):
+        pass
+    snap = metrics_snapshot()
+    validate_snapshot(snap)
+    assert snap["gauges"]["unit.depth"]["last"] == 7
+    assert snap["counters"]["unit.events"]["value"] == 3
+    hist = snap["histograms"]["unit.snap_op"]
+    assert hist["count"] == 1
+    for q in ("p50", "p95", "p99"):
+        assert hist[q] >= 0.0
+    # compact form for bench embeds
+    compact = metrics_snapshot(buckets=False)
+    assert "bucket_counts" not in compact["histograms"]["unit.snap_op"]
+
+
+def test_exporter_writes_snapshots_and_trace(tmp_path):
+    gauge("unit.exp").set(1)
+    with span("unit.exporter_span"):
+        pass
+    start_exporter(str(tmp_path), interval=0.05)
+    time.sleep(0.25)
+    stop_exporter()
+    snaps = sorted(tmp_path.glob("metrics-*.json"))
+    assert len(snaps) >= 2          # periodic + final
+    seqs = []
+    for path in snaps:
+        snap = json.loads(path.read_text())
+        validate_snapshot(snap)
+        seqs.append(snap["seq"])
+    assert seqs == sorted(seqs)
+    traces = list(tmp_path.glob("trace-*.json"))
+    assert len(traces) == 1
+    validate_chrome_trace(json.loads(traces[0].read_text()))
+
+
+def test_sync_coordinator_emits_staleness_and_gate_wait():
+    from multiverso_tpu.core.sync_coordinator import SyncCoordinator
+
+    sc = SyncCoordinator(2)
+    sc.acquire_add(0)
+    sc.commit_add(0)
+    # worker 0 is one committed add ahead: the STRAGGLER (worker 1) reads
+    # positive, the leader reads 0 (ps_service.staleness polarity).
+    g0 = get_registry().gauge("sync.staleness.add.worker_0")
+    g1 = get_registry().gauge("sync.staleness.add.worker_1")
+    assert g0.last == 0.0 and g0.samples >= 1
+    assert g1.last == 1.0
+    assert get_registry().histogram("sync.gate_wait.add").count >= 1
+    # the get clock has its OWN gauge family: worker 1 (the add straggler)
+    # may still get — and its get-commit must not overwrite (mask) the
+    # add-side straggler signal
+    sc.acquire_get(1)
+    sc.commit_get(1)
+    assert get_registry().gauge("sync.staleness.add.worker_1").last == 1.0
+    assert get_registry().gauge("sync.staleness.get.worker_1").last == 0.0
+    assert get_registry().gauge("sync.staleness.get.worker_0").last == 1.0
+    # a retired worker must not poison the gauges with INF
+    sc.finish_train(1)
+    sc.acquire_add(0)
+    sc.commit_add(0)
+    snap = metrics_snapshot()
+    assert snap["gauges"]["sync.staleness.add.worker_0"]["last"] == 0.0
+    assert snap["gauges"]["sync.staleness.add.worker_1"]["last"] == 1.0
+
+
+# -- end-to-end: CPU word2vec run with -telemetry_dir -----------------------
+def _write_corpus(path, n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(n):
+            topic = "a" if i % 2 == 0 else "b"
+            words = [f"{topic}{rng.integers(0, 5)}" for _ in range(15)]
+            f.write(" ".join(words) + "\n")
+
+
+def test_word2vec_cli_telemetry_e2e(tmp_path):
+    """ISSUE 3 acceptance: a 2-rank CPU word2vec run with -telemetry_dir
+    emits (a) Chrome traces that pass the schema validator + merge and
+    (b) snapshots with PS_SERVICE_ADD/GET p50/p95/p99, async-engine
+    queue-depth gauge samples, and per-worker staleness gauges."""
+    import subprocess
+    import sys
+
+    corpus = tmp_path / "corpus.txt"
+    tdir = tmp_path / "telemetry"
+    _write_corpus(str(corpus))
+    rc = subprocess.run(
+        [sys.executable, "-m", "multiverso_tpu.apps.word2vec_main",
+         f"-train_file={corpus}", f"-output_file={tmp_path / 'vec.txt'}",
+         "-world_size=2", "-size=16", "-window=3", "-negative=3",
+         "-min_count=1", "-epoch=1", "-batch_size=256", "-sample=0",
+         f"-rendezvous_dir={tmp_path}",
+         f"-telemetry_dir={tdir}", "-telemetry_interval=0.5"],
+        timeout=420).returncode
+    assert rc == 0
+
+    # (a) one trace per rank, schema-valid, mergeable, with real spans
+    traces = sorted(tdir.glob("trace-*.json"))
+    assert len(traces) == 2, list(tdir.iterdir())
+    for path in traces:
+        validate_chrome_trace(json.loads(path.read_text()))
+    merged = merge_traces([str(p) for p in traces])
+    validate_chrome_trace(merged)
+    xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) >= 2
+    assert {e["pid"] for e in xs} == \
+        {e["pid"] for e in merged["traceEvents"] if e["ph"] == "M"}
+    assert any(e["name"] == "w2v.dist_block" for e in xs)
+
+    # (b) snapshots: merge the final snapshot of each rank
+    snaps = sorted(tdir.glob("metrics-*.json"))
+    assert snaps, list(tdir.iterdir())
+    hists, gauges_all = {}, {}
+    for path in snaps:
+        snap = json.loads(path.read_text())
+        validate_snapshot(snap)
+        hists.update({k: v for k, v in snap["histograms"].items()
+                      if v["count"]})
+        gauges_all.update({k: v for k, v in snap["gauges"].items()
+                           if v["samples"]})
+    for name in ("PS_SERVICE_ADD", "PS_SERVICE_GET"):
+        assert name in hists, sorted(hists)
+        for q in ("p50", "p95", "p99"):
+            assert hists[name][q] >= 0.0
+        assert hists[name]["count"] >= 1
+    q_depth = [n for n in gauges_all
+               if n.startswith("async_engine.queue_depth")]
+    assert q_depth, sorted(gauges_all)
+    staleness = [n for n in gauges_all
+                 if re.match(r".*staleness\.worker_\d+$", n)]
+    assert len(staleness) >= 2, sorted(gauges_all)

@@ -7,6 +7,8 @@ between windows (and HAD never executed before one). ``--dry-run``
 shrinks every leg to seconds on CPU, including the Pallas grid leg in
 interpret mode."""
 
+import glob
+import json
 import os
 import subprocess
 import sys
@@ -15,9 +17,11 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SCRIPT = os.path.join(_REPO, "scripts", "perf_attrib.py")
 
 
-def test_perf_attrib_dry_run_cpu():
+def test_perf_attrib_dry_run_cpu(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.run([sys.executable, _SCRIPT, "--dry-run"],
+    tdir = tmp_path / "telemetry"
+    proc = subprocess.run([sys.executable, _SCRIPT, "--dry-run",
+                           f"--telemetry-dir={tdir}"],
                           cwd=_REPO, env=env, capture_output=True,
                           text=True, timeout=240)
     assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -29,3 +33,20 @@ def test_perf_attrib_dry_run_cpu():
                 "H fori @ Vg"):
         assert leg in out, f"missing leg {leg!r}:\n{out}"
     assert out.count("ms/chunk") >= 7
+    # telemetry snapshots + Chrome trace are emitted alongside the numbers
+    from multiverso_tpu.telemetry import (validate_chrome_trace,
+                                          validate_snapshot)
+    snaps = sorted(glob.glob(str(tdir / "metrics-*.json")))
+    assert snaps, f"no telemetry snapshots in {tdir}"
+    with open(snaps[-1]) as f:
+        snap = json.load(f)
+    validate_snapshot(snap)
+    spans = [n for n, h in snap["histograms"].items()
+             if n.startswith("span.perf_attrib.") and h["count"]]
+    assert spans, sorted(snap["histograms"])
+    traces = glob.glob(str(tdir / "trace-*.json"))
+    assert len(traces) == 1
+    with open(traces[0]) as f:
+        trace = json.load(f)
+    validate_chrome_trace(trace)
+    assert any(e.get("ph") == "X" for e in trace["traceEvents"])
