@@ -9,6 +9,20 @@ regression). See SURVEY.md for the structural map of the reference this
 framework re-implements TPU-first.
 """
 
+import jax as _jax
+
+# Sharding-invariant PRNG: the legacy (non-partitionable) threefry lowering
+# produces DIFFERENT random bits inside a GSPMD-partitioned program than in
+# the single-device program (observed on jax 0.4.37: the in-graph window
+# draws of the dp x tp word2vec block step diverged from the unsharded step,
+# changing pair counts). Partitionable threefry computes each element from
+# its global index, so draws are identical under any mesh layout — required
+# for the "same keys -> same pairs" contract of build_sharded_block_step.
+try:
+    _jax.config.update("jax_threefry_partitionable", True)
+except AttributeError:  # pragma: no cover - future jax removes the flag
+    pass
+
 from multiverso_tpu.api import (aggregate, barrier, create_table,
                                 create_distributed_array_table,
                                 create_distributed_kv_table,

@@ -1,0 +1,27 @@
+"""graftlint — AST-based static analysis for JAX hot-path and concurrency
+hazards.
+
+Public surface:
+
+* :func:`run_lint` / :class:`LintEngine` — run the full pass and get a
+  :class:`LintResult`;
+* :class:`Baseline` — checked-in grandfathered findings (every entry
+  carries a ``reason``);
+* :func:`all_rules` — the registered rule set (hotpath + concurrency +
+  style families);
+* ``scripts/graftlint.py`` — the CLI (``--format text|json``,
+  ``--baseline``, exit-code contract) and ``tests/test_graftlint_gate.py``
+  — the tier-1 gate that keeps ``multiverso_tpu/`` and ``scripts/`` clean.
+
+See docs/LINTS.md for the rule catalog and the adding-a-rule recipe.
+"""
+
+from multiverso_tpu.analysis.core import (Baseline, FileContext, Finding,
+                                          LintEngine, LintResult, Project,
+                                          Rule, all_rules, register,
+                                          rule_catalog, run_lint)
+
+__all__ = [
+    "Baseline", "FileContext", "Finding", "LintEngine", "LintResult",
+    "Project", "Rule", "all_rules", "register", "rule_catalog", "run_lint",
+]

@@ -1,0 +1,524 @@
+"""Concurrency rules: lock ordering, registry discipline, thread lifecycle.
+
+The runtime holds ~20 ``threading.Lock``s across the PS service, async
+flush engines, actor registry, telemetry and table stores.  The class of
+bug behind PR 3's ``_CPU_COLLECTIVE_LOCK`` deadlock — two lock holders
+waiting on each other through a rendezvous — is exactly what a *static*
+lock-acquisition graph catches before a 600-second wedge does:
+
+* ``lock-order-cycle``        — build the acquisition graph across
+  ``with <lock>`` nests and same/cross-module calls; any cycle (incl. a
+  non-reentrant lock re-acquired under itself through a call chain) is a
+  potential deadlock;
+* ``unlocked-registry-mutation`` — a module that defines a guarding lock
+  for its module-level dict/list registries must take it on every write;
+* ``bare-thread-no-join``     — a non-daemon Thread that nobody joins
+  outlives shutdown ordering and wedges interpreter exit.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from multiverso_tpu.analysis import astutil
+from multiverso_tpu.analysis.core import (FileContext, Finding, Project,
+                                          Rule, register)
+
+_LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+}
+_MUTATORS = {"append", "add", "update", "setdefault", "pop", "clear",
+             "extend", "remove", "insert", "discard", "popitem"}
+_REGISTRY_FACTORIES = {"dict", "list", "set", "collections.defaultdict",
+                       "collections.OrderedDict"}
+
+
+def _lock_defs(ctx: FileContext) -> Dict[str, str]:
+    """lock id -> kind.  Ids are module-qualified so the graph merges
+    across files: ``pkg.mod._LOCK`` / ``pkg.mod.Class._attr``."""
+    out: Dict[str, str] = {}
+    for node in ctx.walk():
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        kind = _LOCK_FACTORIES.get(
+            astutil.resolve_name(node.value.func, ctx.aliases) or "")
+        if kind is None:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                cls = astutil.enclosing_class(node)
+                fn = astutil.enclosing_function(node)
+                if fn is None and cls is None:          # module level
+                    out[f"{ctx.module}.{tgt.id}"] = kind
+                elif fn is None and cls is not None:    # class attribute
+                    out[f"{ctx.module}.{cls.name}.{tgt.id}"] = kind
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                cls = astutil.enclosing_class(node)
+                if cls is not None:
+                    out[f"{ctx.module}.{cls.name}.{tgt.attr}"] = kind
+    return out
+
+
+def _lock_ref(expr: ast.expr, ctx: FileContext) -> Optional[str]:
+    """Resolve a with-item / expression to a candidate lock id."""
+    if isinstance(expr, ast.Name):
+        resolved = ctx.aliases.get(expr.id)
+        if resolved and "." in resolved:        # from mod import _LOCK
+            return resolved
+        return f"{ctx.module}.{expr.id}"
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name):
+            if expr.value.id == "self":
+                cls = astutil.enclosing_class(expr)
+                if cls is not None:
+                    return f"{ctx.module}.{cls.name}.{expr.attr}"
+                return None
+            if expr.value.id == "cls":
+                cls = astutil.enclosing_class(expr)
+                if cls is not None:
+                    return f"{ctx.module}.{cls.name}.{expr.attr}"
+                return None
+        resolved = astutil.resolve_name(expr, ctx.aliases)
+        if resolved:
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id not in ctx.aliases:
+                # Local class attribute referenced as ClassName._lock:
+                # qualify with this module so it matches _lock_defs' key.
+                return f"{ctx.module}.{resolved}"
+            # Imported base (other_mod.Class._lock / other_mod._LOCK):
+            # already module-qualified through the alias map.
+            return resolved
+    return None
+
+
+@dataclasses.dataclass
+class _FuncInfo:
+    """Per-function facts for the cross-file closure."""
+    qual: str                     # module.Class.meth / module.fn
+    rel: str
+    acquires: List[Tuple[str, ast.With]]          # directly in body
+    # (held lock id or None, callee candidates) per call site
+    calls: List[Tuple[Optional[str], List[str], ast.Call]]
+
+
+def _held_lock(node: ast.AST, ctx: FileContext,
+               fn: ast.AST) -> Optional[str]:
+    """Innermost lock lexically held at ``node`` within ``fn``."""
+    prev: ast.AST = node
+    for anc in astutil.ancestors(node):
+        if anc is fn:
+            break
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            break
+        if isinstance(anc, ast.With) and prev in anc.body:
+            # ``with A, B:`` acquires left-to-right, so the innermost
+            # (last-acquired) resolvable item is the one held here.
+            for item in reversed(anc.items):
+                ref = _lock_ref(item.context_expr, ctx)
+                if ref is not None:
+                    return ref
+        prev = anc
+    return None
+
+
+def _callee_candidates(call: ast.Call, ctx: FileContext) -> List[str]:
+    """Qualified names a call site may target (same project)."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        resolved = ctx.aliases.get(fn.id)
+        if resolved and "." in resolved:
+            return [resolved]
+        return [f"{ctx.module}.{fn.id}"]
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if fn.value.id in ("self", "cls"):
+            cls = astutil.enclosing_class(call)
+            if cls is not None:
+                return [f"{ctx.module}.{cls.name}.{fn.attr}"]
+            return []
+        base = ctx.aliases.get(fn.value.id)
+        if base:
+            return [f"{base}.{fn.attr}"]
+        # ClassName.method() / helper_mod_level.attr() in this module
+        return [f"{ctx.module}.{fn.value.id}.{fn.attr}"]
+    return []
+
+
+def _function_infos(ctx: FileContext) -> List[_FuncInfo]:
+    infos: List[_FuncInfo] = []
+    for node in ctx.walk():
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        acquires: List[Tuple[str, ast.With]] = []
+        calls: List[Tuple[Optional[str], List[str], ast.Call]] = []
+        for sub in ast.walk(node):
+            owner = astutil.enclosing_function(sub)
+            if owner is not node and sub is not node:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    continue
+                if owner is not node:
+                    continue
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    ref = _lock_ref(item.context_expr, ctx)
+                    if ref is not None:
+                        acquires.append((ref, sub))
+            elif isinstance(sub, ast.Call):
+                cands = _callee_candidates(sub, ctx)
+                if cands:
+                    calls.append((_held_lock(sub, ctx, node), cands, sub))
+        cls = astutil.enclosing_class(node)
+        qual = (f"{ctx.module}.{cls.name}.{node.name}" if cls is not None
+                else f"{ctx.module}.{node.name}")
+        infos.append(_FuncInfo(qual=qual, rel=ctx.rel, acquires=acquires,
+                               calls=calls))
+    return infos
+
+
+@register
+class LockOrderCycle(Rule):
+    id = "lock-order-cycle"
+    severity = "error"
+    rationale = (
+        "If thread 1 takes A then B while thread 2 takes B then A, both "
+        "wedge forever — the bug class behind the _CPU_COLLECTIVE_LOCK "
+        "deadlock PR 3 had to unpick at runtime. The static acquisition "
+        "graph (with-nests + call chains, merged across modules) must "
+        "stay acyclic; a non-reentrant Lock reachable under itself "
+        "through a call chain is the 1-cycle special case.")
+
+    def finalize(self, project: Project) -> Iterator[Finding]:
+        locks: Dict[str, str] = {}
+        infos: Dict[str, List[_FuncInfo]] = {}
+        ctx_by_rel: Dict[str, FileContext] = {}
+        for ctx in project.files:
+            locks.update(_lock_defs(ctx))
+            ctx_by_rel[ctx.rel] = ctx
+            for info in _function_infos(ctx):
+                infos.setdefault(info.qual, []).append(info)
+
+        # transitive "locks this function may acquire while running,
+        # not already held by the caller" — fixpoint over the call graph
+        all_infos = [i for lst in infos.values() for i in lst]
+        may_acquire: Dict[str, Set[str]] = {
+            q: {ref for i in lst for (ref, _) in i.acquires
+                if ref in locks}
+            for q, lst in infos.items()}
+        changed = True
+        iters = 0
+        while changed and iters < 50:
+            changed = False
+            iters += 1
+            for q, lst in infos.items():
+                cur = may_acquire[q]
+                for i in lst:
+                    for _, cands, _ in i.calls:
+                        for c in cands:
+                            extra = may_acquire.get(c)
+                            if extra and not extra <= cur:
+                                cur |= extra
+                                changed = True
+
+        # edges: held -> acquired (lexical nesting + call chains), with
+        # provenance for reporting
+        edges: Dict[Tuple[str, str],
+                    Tuple[str, ast.AST, str]] = {}
+
+        def add_edge(src: str, dst: str, rel: str, node: ast.AST,
+                     via: str) -> None:
+            edges.setdefault((src, dst), (rel, node, via))
+
+        for info in all_infos:
+            ctx = ctx_by_rel[info.rel]
+            by_with: Dict[int, Tuple[ast.With, List[str]]] = {}
+            for ref, with_node in info.acquires:
+                if ref not in locks:
+                    continue
+                by_with.setdefault(
+                    id(with_node), (with_node, []))[1].append(ref)
+            for with_node, refs in by_with.values():
+                held = _held_lock(
+                    with_node, ctx,
+                    astutil.enclosing_function(with_node) or ctx.tree)
+                if held in locks and held is not None:
+                    add_edge(held, refs[0], info.rel, with_node,
+                             "nested with")
+                # ``with A, B:`` is A-then-B: chain the items so the
+                # AB/BA deadlock spelled as one statement still shows
+                # up in the acquisition graph.
+                for a, b in zip(refs, refs[1:]):
+                    add_edge(a, b, info.rel, with_node,
+                             "multi-item with")
+            for held, cands, call in info.calls:
+                if held not in locks:
+                    continue
+                for c in cands:
+                    for dst in sorted(may_acquire.get(c, ())):
+                        if dst in locks:
+                            add_edge(held, dst, info.rel, call,
+                                     f"call to {c}")
+
+        graph: Dict[str, Set[str]] = {}
+        for (src, dst) in edges:
+            graph.setdefault(src, set()).add(dst)
+            graph.setdefault(dst, set())
+
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        for cycle in self._cycles(graph):
+            canon = tuple(sorted(cycle))
+            if canon in seen_cycles:
+                continue
+            seen_cycles.add(canon)
+            if len(cycle) == 1:
+                lock_id = cycle[0]
+                if locks.get(lock_id) != "lock":
+                    continue        # RLock/Condition reacquire is legal
+                rel, node, via = edges[(lock_id, lock_id)]
+                ctx = ctx_by_rel[rel]
+                yield Finding(
+                    rule=self.id, path=rel,
+                    line=getattr(node, "lineno", 1),
+                    col=getattr(node, "col_offset", 0),
+                    message=(f"non-reentrant lock {lock_id} may be "
+                             f"re-acquired while held (via {via}) — "
+                             "self-deadlock"),
+                    symbol=astutil.qualname(node), severity=self.severity)
+                continue
+            first = (cycle[0], cycle[1 % len(cycle)])
+            rel, node, via = edges.get(first) or next(
+                v for k, v in edges.items() if k[0] in cycle
+                and k[1] in cycle)
+            yield Finding(
+                rule=self.id, path=rel,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0),
+                message=("lock-order cycle: "
+                         + " -> ".join(cycle + (cycle[0],))
+                         + f" (edge here via {via})"),
+                symbol=astutil.qualname(node), severity=self.severity)
+
+    @staticmethod
+    def _cycles(graph: Dict[str, Set[str]]
+                ) -> Iterator[Tuple[str, ...]]:
+        """Self-loops + one representative cycle per non-trivial SCC
+        (Tarjan)."""
+        for n, outs in graph.items():
+            if n in outs:
+                yield (n,)
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+        sccs: List[List[str]] = []
+
+        def strongconnect(v: str) -> None:
+            work = [(v, iter(sorted(graph.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(graph.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(scc)
+
+        for n in sorted(graph):
+            if n not in index:
+                strongconnect(n)
+        for scc in sccs:
+            yield tuple(sorted(scc))
+
+
+@register
+class UnlockedRegistryMutation(Rule):
+    id = "unlocked-registry-mutation"
+    severity = "error"
+    rationale = (
+        "Module-level dict/list registries (actors, metrics, exporters, "
+        "table directories) are shared across PS service threads; a "
+        "write outside the module's guarding lock races Get/Add "
+        "dispatch. Import-time initialization is exempt (the import "
+        "lock serializes it).")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        locks = _lock_defs(ctx)
+        if not locks:
+            return      # single-threaded module: nothing to guard with
+        registries: Set[str] = set()
+        for node in ctx.walk():
+            if isinstance(node, ast.Assign) and \
+                    astutil.enclosing_function(node) is None and \
+                    astutil.enclosing_class(node) is None:
+                is_reg = isinstance(node.value, (ast.Dict, ast.List,
+                                                 ast.Set)) or (
+                    isinstance(node.value, ast.Call) and
+                    astutil.resolve_name(node.value.func, ctx.aliases)
+                    in _REGISTRY_FACTORIES)
+                if is_reg:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            registries.add(tgt.id)
+        if not registries:
+            return
+
+        def guarded(node: ast.AST, fn: ast.AST) -> bool:
+            return _held_lock(node, ctx, fn) is not None
+
+        for node in ctx.walk():
+            fn = astutil.enclosing_function(node)
+            if fn is None:
+                continue        # import-time mutation: serialized
+            name: Optional[str] = None
+            site: Optional[ast.AST] = None
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                name, site = node.value.id, node
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS and \
+                    isinstance(node.func.value, ast.Name):
+                name, site = node.func.value.id, node
+            if name not in registries or site is None:
+                continue
+            if name in {a.arg for anc in astutil.ancestors(site)
+                        if isinstance(anc, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))
+                        for a in anc.args.args}:
+                continue        # shadowed by a parameter: not the global
+            if not guarded(site, fn):
+                yield self.finding(
+                    ctx, site,
+                    f"module registry '{name}' mutated outside its "
+                    "guarding lock (module defines "
+                    f"{sorted(locks)[0].rsplit('.', 1)[-1]}); wrap the "
+                    "write in the lock")
+
+
+@register
+class BareThreadNoJoin(Rule):
+    id = "bare-thread-no-join"
+    severity = "warning"
+    rationale = (
+        "A non-daemon Thread nobody joins blocks interpreter exit until "
+        "its target returns — under the PS service that means a wedged "
+        "shutdown when a queue never drains. Either mark lifecycle "
+        "ownership (daemon=True for loops killed with the process) or "
+        "join on the shutdown path.")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            if astutil.resolve_name(node.func, ctx.aliases) != \
+                    "threading.Thread":
+                continue
+            daemon = next((k.value for k in node.keywords
+                           if k.arg == "daemon"), None)
+            if isinstance(daemon, ast.Constant) and daemon.value is True:
+                continue
+            if daemon is not None and \
+                    not isinstance(daemon, ast.Constant):
+                continue        # computed daemon-ness: owner decided
+            target = self._binding(node)
+            if target is not None and self._joined(node, target, ctx):
+                continue
+            yield self.finding(
+                ctx, node,
+                "non-daemon Thread without a reachable .join(): wedges "
+                "interpreter exit if its loop never returns (set "
+                "daemon=True or join on the shutdown path)")
+
+    @staticmethod
+    def _binding(call: ast.Call) -> Optional[str]:
+        parent = getattr(call, "parent", None)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            tgt = parent.targets[0]
+            if isinstance(tgt, ast.Name):
+                return tgt.id
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                return f"self.{tgt.attr}"
+        if isinstance(parent, (ast.List, ast.Tuple, ast.ListComp,
+                               ast.GeneratorExp)):
+            # literal list AND the `[Thread(...) for f in fns]` pool
+            # idiom both bind through the collecting Assign target
+            grand = getattr(parent, "parent", None)
+            if isinstance(grand, ast.Assign) and \
+                    isinstance(grand.targets[0], ast.Name):
+                return grand.targets[0].id
+        return None
+
+    @staticmethod
+    def _joined(call: ast.Call, target: str, ctx: FileContext) -> bool:
+        scope: Optional[ast.AST]
+        if target.startswith("self."):
+            scope = astutil.enclosing_class(call)
+            attr = target[len("self."):]
+            if scope is None:
+                return False
+            for sub in ast.walk(scope):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr == "join" and \
+                        isinstance(sub.func.value, ast.Attribute) and \
+                        sub.func.value.attr == attr and \
+                        isinstance(sub.func.value.value, ast.Name) and \
+                        sub.func.value.value.id == "self":
+                    return True
+            return False
+        scope = astutil.enclosing_function(call) or ctx.tree
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "join":
+                base = sub.func.value
+                if isinstance(base, ast.Name) and base.id == target:
+                    return True
+                # joined through iteration over the collecting list:
+                # ``for t in threads: t.join()``
+                if isinstance(base, ast.Name):
+                    for anc in astutil.ancestors(sub):
+                        if isinstance(anc, ast.For) and \
+                                isinstance(anc.target, ast.Name) and \
+                                anc.target.id == base.id and \
+                                isinstance(anc.iter, ast.Name) and \
+                                anc.iter.id == target:
+                            return True
+        return False

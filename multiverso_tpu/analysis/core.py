@@ -1,0 +1,359 @@
+"""graftlint engine: rule registry, file contexts, suppressions, baseline.
+
+The runtime is a multi-threaded parameter server driving jit/pjit/Pallas
+hot paths — the two bug classes the reference C++ core policed by hand
+(actor message discipline, lock ownership) and that JAX makes easy to
+silently regress (implicit device->host syncs, retraces, lock-order
+races).  Telemetry (PR 3) can *observe* those pathologies after the fact;
+this engine *rejects* them at test time: a tier-1 gate runs the full pass
+over ``multiverso_tpu/`` and ``scripts/`` and fails on any non-baselined
+finding.
+
+Design:
+
+* rules are small classes registered via :func:`register`; each gets a
+  parsed :class:`FileContext` (AST with parent links, import aliases,
+  traced-function set) and yields :class:`Finding`\\ s; cross-file rules
+  (the lock graph) additionally implement ``finalize(project)``;
+* ``# graftlint: disable=<rule>[,<rule>...]`` on (or immediately above) a
+  line suppresses it; ``disable-file=`` at any column suppresses for the
+  whole file; ``disable=all`` wildcards;
+* grandfathered findings live in a checked-in JSON baseline keyed by
+  ``(rule, path, symbol)`` — line-drift-proof — and every entry must carry
+  a human ``reason``.  Stale entries (baselined findings that no longer
+  fire) are reported so the baseline only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from multiverso_tpu.analysis import astutil
+
+SEVERITIES = ("warning", "error")
+
+_DISABLE_RE = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\-\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str            # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    symbol: str          # enclosing qualname — the baseline key
+    severity: str = "error"
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.severity}: [{self.rule}] {self.message} "
+                f"(in {self.symbol})")
+
+
+class FileContext:
+    """Parsed view of one file, shared by every rule."""
+
+    def __init__(self, path: str, rel: str) -> None:
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.tree, self.source = astutil.parse_file(path)
+        self.aliases = astutil.collect_aliases(self.tree)
+        self.traced = astutil.traced_functions(self.tree, self.aliases)
+        self.module = self._module_name()
+        parts = self.rel.split("/")
+        #: 'script' files own stdout and drive timing loops from the host;
+        #: a couple of rules scope themselves down for that role.
+        self.role = "script" if "scripts" in parts else (
+            "package" if parts[0] == "multiverso_tpu" else "other")
+        (self._line_disables, self._standalone_disables,
+         self._file_disables) = self._suppressions()
+
+    def _module_name(self) -> str:
+        mod = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        mod = mod.replace("/", ".")
+        return mod[:-9] if mod.endswith(".__init__") else mod
+
+    def _suppressions(self
+                      ) -> Tuple[Dict[int, Set[str]], Set[int], Set[str]]:
+        line_dis: Dict[int, Set[str]] = {}
+        standalone: Set[int] = set()
+        file_dis: Set[str] = set()
+        src_lines = self.source.splitlines()
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _DISABLE_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group(2).split(",")
+                         if r.strip()}
+                if m.group(1) == "disable-file":
+                    file_dis |= rules
+                else:
+                    row, col = tok.start
+                    line_dis.setdefault(row, set()).update(rules)
+                    # A comment alone on its line governs the NEXT line;
+                    # a trailing comment governs only its own line —
+                    # otherwise one disable would silently mute the
+                    # adjacent statement too.
+                    if row <= len(src_lines) and \
+                            not src_lines[row - 1][:col].strip():
+                        standalone.add(row)
+        except tokenize.TokenError:
+            pass
+        return line_dis, standalone, file_dis
+
+    def suppressed(self, finding: Finding) -> bool:
+        if {"all", finding.rule} & self._file_disables:
+            return True
+        wanted = {"all", finding.rule}
+        rules = self._line_disables.get(finding.line)
+        if rules and wanted & rules:
+            return True
+        above = finding.line - 1
+        if above in self._standalone_disables:
+            rules = self._line_disables.get(above)
+            if rules and wanted & rules:
+                return True
+        return False
+
+    def walk(self) -> Iterator[ast.AST]:
+        return ast.walk(self.tree)
+
+
+class Project:
+    def __init__(self, root: str, files: List[FileContext]) -> None:
+        self.root = root
+        self.files = files
+
+
+class Rule:
+    """Base rule.  Subclasses set ``id``/``severity``/``rationale`` and
+    implement :meth:`check` (per file) and/or :meth:`finalize` (cross-file,
+    runs once after every file was checked)."""
+
+    id: str = ""
+    severity: str = "error"
+    rationale: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize(self, project: Project) -> Iterator[Finding]:
+        return iter(())
+
+    def finding(self, ctx: FileContext, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(rule=self.id, path=ctx.rel,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       message=message,
+                       symbol=astutil.qualname(node),
+                       severity=self.severity)
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    assert cls.id and cls.id not in _REGISTRY, cls
+    assert cls.severity in SEVERITIES, cls
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    # rule modules self-register on import
+    from multiverso_tpu.analysis import (concurrency, hotpath,  # noqa: F401
+                                         style)
+    return [cls() for _, cls in sorted(_REGISTRY.items())]
+
+
+def rule_catalog() -> List[Rule]:
+    """Instantiated rules, for docs / --list-rules."""
+    return all_rules()
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+class Baseline:
+    """Checked-in allowance for grandfathered findings.
+
+    JSON: ``{"version": 1, "entries": [{"rule", "path", "symbol",
+    "count", "reason"}]}``.  A finding is absorbed while its key has
+    remaining count.  ``reason`` is mandatory — the baseline is a list of
+    deliberate exceptions, not a dumping ground.
+    """
+
+    def __init__(self, entries: Optional[List[Dict]] = None) -> None:
+        self.entries = entries or []
+        for e in self.entries:
+            missing = {"rule", "path", "symbol", "reason"} - set(e)
+            if missing:
+                raise ValueError(
+                    f"baseline entry {e!r} missing {sorted(missing)}")
+            e.setdefault("count", 1)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != 1:
+            raise ValueError(f"unsupported baseline version in {path}")
+        return cls(data.get("entries", []))
+
+    def dump(self) -> Dict:
+        return {"version": 1, "entries": self.entries}
+
+    def apply(self, findings: List[Finding],
+              in_scope=None) -> Tuple[List[Finding], List[Dict]]:
+        """-> (non-baselined findings, stale entries).
+
+        ``in_scope(path)`` limits stale reporting to entries the run
+        could actually have re-confirmed: a scoped invocation (one
+        subtree) must not flag entries for files it never scanned.
+        """
+        budget: Dict[Tuple[str, str, str], int] = {}
+        for e in self.entries:
+            key = (e["rule"], e["path"], e["symbol"])
+            budget[key] = budget.get(key, 0) + int(e["count"])
+        remaining = dict(budget)
+        out: List[Finding] = []
+        for f in findings:
+            if remaining.get(f.key(), 0) > 0:
+                remaining[f.key()] -= 1
+            else:
+                out.append(f)
+        stale = [
+            {"rule": r, "path": p, "symbol": s, "unused": n}
+            for (r, p, s), n in sorted(remaining.items())
+            if n > 0 and (in_scope is None or in_scope(p))
+        ]
+        return out, stale
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]          # post-suppression, post-baseline
+    suppressed: int
+    baselined: int
+    stale_baseline: List[Dict]
+    files: int
+    parse_errors: List[str]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d != "__pycache__" and
+                           not d.startswith(".")]
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+class LintEngine:
+    def __init__(self, root: str,
+                 rules: Optional[List[Rule]] = None,
+                 baseline: Optional[Baseline] = None) -> None:
+        self.root = os.path.abspath(root)
+        self.rules = rules if rules is not None else all_rules()
+        self.baseline = baseline or Baseline()
+
+    def run(self, paths: Iterable[str]) -> LintResult:
+        contexts: List[FileContext] = []
+        parse_errors: List[str] = []
+        for path in iter_python_files(paths):
+            rel = os.path.relpath(os.path.abspath(path), self.root)
+            try:
+                contexts.append(FileContext(path, rel))
+            except (SyntaxError, UnicodeDecodeError) as exc:
+                parse_errors.append(f"{rel}: {exc}")
+        project = Project(self.root, contexts)
+
+        raw: List[Finding] = []
+        suppressed = 0
+        by_rel = {c.rel: c for c in contexts}
+        for rule in self.rules:
+            for ctx in contexts:
+                for f in rule.check(ctx):
+                    if ctx.suppressed(f):
+                        suppressed += 1
+                    else:
+                        raw.append(f)
+            for f in rule.finalize(project):
+                ctx = by_rel.get(f.path)
+                if ctx is not None and ctx.suppressed(f):
+                    suppressed += 1
+                else:
+                    raw.append(f)
+
+        raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        scanned = set(by_rel)
+
+        def in_scope(path: str) -> bool:
+            # An entry is re-checkable when its file was scanned; an
+            # entry for a file that no longer exists is stale regardless
+            # of scan scope (the baseline only ever shrinks).
+            return path in scanned or not os.path.exists(
+                os.path.join(self.root, path))
+
+        findings, stale = self.baseline.apply(raw, in_scope)
+        self._export_gauges(len(raw) - len(findings))
+        return LintResult(findings=findings, suppressed=suppressed,
+                          baselined=len(raw) - len(findings),
+                          stale_baseline=stale, files=len(contexts),
+                          parse_errors=parse_errors)
+
+    def _export_gauges(self, absorbed: int) -> None:
+        # Baseline growth must be visible in telemetry_report.py diffs —
+        # a creeping baseline is the lint equivalent of rising staleness.
+        try:
+            from multiverso_tpu.telemetry import gauge
+            gauge("lint.baseline_size").set(
+                sum(int(e.get("count", 1))
+                    for e in self.baseline.entries))
+            gauge("lint.baseline_absorbed").set(absorbed)
+        except Exception:   # telemetry optional in stripped-down installs
+            pass
+
+
+def run_lint(paths: Iterable[str], root: Optional[str] = None,
+             baseline_path: Optional[str] = None) -> LintResult:
+    """One-call API used by the tier-1 gate test and the CLI."""
+    paths = list(paths)
+    root = root or (os.path.dirname(paths[0]) if paths else os.getcwd())
+    baseline = (Baseline.load(baseline_path)
+                if baseline_path and os.path.exists(baseline_path)
+                else Baseline())
+    return LintEngine(root, baseline=baseline).run(paths)

@@ -1,0 +1,46 @@
+"""Output-discipline rules.
+
+``bare-print`` replaces the bespoke tokenizer walker that used to live in
+``tests/test_bare_print_lint.py`` — same coverage (framework code must
+route output through ``utils/log.py`` or ``Dashboard.display(echo=True)``),
+now enforced through the shared engine so it gains suppressions, the
+baseline, and the JSON report for free.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from multiverso_tpu.analysis import astutil
+from multiverso_tpu.analysis.core import FileContext, Finding, Rule, register
+
+
+@register
+class BarePrint(Rule):
+    id = "bare-print"
+    severity = "error"
+    rationale = (
+        "A bare print() in framework code bypasses the log file sink, "
+        "breaks log-level filtering, and interleaves across the PS "
+        "service's threads. Route through utils/log.py (log.raw for "
+        "format-stable CLI results) or Dashboard.display(echo=True). "
+        "CLI scripts own their stdout and are exempt.")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.role == "script":
+            return      # scripts' stdout IS their interface
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "print" \
+                    and fn.id not in ctx.aliases:
+                owner = astutil.enclosing_function(node)
+                if owner is not None and \
+                        astutil._assigns_name(owner, "print"):
+                    continue        # locally shadowed: not the builtin
+                yield self.finding(
+                    ctx, node,
+                    "bare print() in framework code — route through "
+                    "utils/log.py or Dashboard.display(echo=True)")

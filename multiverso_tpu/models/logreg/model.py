@@ -115,7 +115,12 @@ def _make_step(cfg: LogRegConfig):
             grad = grad + coef * jnp.sign(weights)
         return loss, grad
 
-    return jax.jit(step)
+    # grad has exactly the weights' shape/dtype: donating lets XLA write
+    # it into the uploaded weights buffer instead of allocating a second
+    # [width, num_class] array per minibatch (PSModel uploads fresh
+    # weights every call; LocalModel traces through this jit inside its
+    # own donating sgd jit, where the inner annotation is a no-op).
+    return jax.jit(step, donate_argnums=(0,))
 
 
 class LocalModel:

@@ -355,7 +355,7 @@ def raw_cbow_hs_step(adagrad: bool):
 
 def _make_block_fn(window: int, negative: int, chunk: int,
                    adagrad: bool, compact: bool, sg: bool = True,
-                   hs: bool = False, huffman=None):
+                   hs: bool = False, huffman=None, constrain=None):
     """Unjitted whole-block step — factored out so the sharded builder can
     apply dp x tp shardings. ALL FOUR variants (sg/cbow x ns/hs).
 
@@ -431,6 +431,21 @@ def _make_block_fn(window: int, negative: int, chunk: int,
             centers, contexts, cmask, pmask = _cbow_arrays(
                 sents, lengths, keep_prob, k_keep, k_win, window)
             arrays1d, arrays2d = [centers], [contexts, cmask]
+        if constrain is not None:
+            # Under dp x tp GSPMD, XLA reshards the concatenated pair
+            # streams (slices of the data-sharded sentence block) with a
+            # partial-sum representation that double-counts every element
+            # across the model axis (observed on jax 0.4.37 CPU: the
+            # resharded stream comes back exactly 2x the true token ids).
+            # Pinning the streams to an explicit layout right after
+            # construction keeps the partitioner out of that path.
+            arrays1d = [constrain(a) for a in arrays1d]
+            arrays2d = [constrain(a) for a in arrays2d]
+            pmask = constrain(pmask)
+            if sg:
+                centers, contexts = arrays1d
+            else:
+                (centers,), (contexts, cmask) = arrays1d, arrays2d
         P = pmask.shape[0]
         pad = (-P) % chunk
         n = (P + pad) // chunk
@@ -483,12 +498,18 @@ def _make_block_fn(window: int, negative: int, chunk: int,
                 neg = None
             else:
                 *slices, m, neg = xs_i
-            out = run_chunk(carry, tuple(slices), m, neg, lr)
-            return out[:4], out[4]
+            *tables, acc = carry
+            out = run_chunk(tuple(tables), tuple(slices), m, neg, lr)
+            # Accumulate the loss IN the carry (sequential adds in chunk
+            # order) exactly like the compact fori_loop path — a post-hoc
+            # losses.sum() reduces in a different association order and
+            # drifts from the compact path by an ulp, breaking the
+            # bitwise compact/uncompact contract.
+            return (*out[:4], acc + out[4]), None
 
-        carry, losses = jax.lax.scan(
-            body, (w_in, w_out, g_in, g_out), xs)
-        return (*carry, losses.sum(), n_pairs)
+        carry, _ = jax.lax.scan(
+            body, (w_in, w_out, g_in, g_out, jnp.float32(0.0)), xs)
+        return (*carry, n_pairs)
 
     return block_step
 
@@ -533,8 +554,12 @@ def build_sharded_block_step(mesh, window: int, negative: int, chunk: int,
     data2 = NamedSharding(mesh, P("data", None))
     data1 = NamedSharding(mesh, P("data"))
     repl = NamedSharding(mesh, P())
+
+    def _repl(x):
+        return jax.lax.with_sharding_constraint(x, repl)
+
     fn = _make_block_fn(window, negative, chunk, adagrad, compact,
-                        sg=sg, hs=hs, huffman=huffman)
+                        sg=sg, hs=hs, huffman=huffman, constrain=_repl)
     return jax.jit(
         fn,
         in_shardings=(table, table, table, table, repl, repl, data2, data1,
@@ -560,7 +585,9 @@ def measured_dispatch_latency_ms(n: int = 7) -> float:
     times = []
     for _ in range(n):
         t0 = time.perf_counter()
-        f(x).block_until_ready()
+        # The probe MEASURES the dispatch+sync round trip; the per-
+        # iteration wait is the quantity being sampled.
+        f(x).block_until_ready()  # graftlint: disable=block-until-ready-in-loop
         times.append((time.perf_counter() - t0) * 1e3)
     return float(np.median(times))
 
@@ -645,12 +672,18 @@ class _DispatchQueue:
     def push(self, marker) -> None:
         self._fifo.append(marker)
         while len(self._fifo) > self._depth:
+            # The bounded backpressure wait IS the mechanism here: block
+            # on the oldest marker only once >depth launches are in
+            # flight, overlapped by the younger queued chunks.
+            # graftlint: disable=block-until-ready-in-loop
             jax.block_until_ready(self._fifo.popleft())
         self._g_inflight.set(len(self._fifo))
 
     def drain(self) -> None:
-        while self._fifo:
-            jax.block_until_ready(self._fifo.popleft())
+        # One batched wait for everything still in flight — a per-marker
+        # wait loop would re-sync serially once per queued chunk.
+        jax.block_until_ready(list(self._fifo))
+        self._fifo.clear()
         self._g_inflight.set(0)
 
 
@@ -1139,7 +1172,7 @@ class Word2Vec:
                                 inflight.push(out[4])
                             out = self._tail_step(
                                 *tables, centers2d, contexts2d, negs,
-                                n_pairs, lr_dev, jnp.int32(est))
+                                n_pairs, lr_dev, np.int32(est))
                             (st_in.data, st_out.data, st_gin.data,
                              st_gout.data) = out[:4]
                             block_loss.append(out[4])

@@ -50,9 +50,10 @@ def _resolve_flash(use_flash, sq: int, sk: int, d: int) -> bool:
     only relaxed when the Pallas kernel genuinely runs."""
     if use_flash is None:
         from multiverso_tpu.utils.configure import get_flag
-        use_flash = bool(get_flag("flash_attention"))
-    return bool(use_flash) and sq % 128 == 0 and sk % 128 == 0 \
-        and d % 8 == 0
+        # Host config flag read once at trace time — never a traced value.
+        use_flash = bool(get_flag("flash_attention"))  # graftlint: disable=implicit-host-sync
+    flash = bool(use_flash)  # graftlint: disable=implicit-host-sync
+    return flash and sq % 128 == 0 and sk % 128 == 0 and d % 8 == 0
 
 
 def _block_attn(q, k, v, scale, mask=None):

@@ -1,0 +1,4 @@
+"""Operational scripts (benchmarks, perf attribution, telemetry reports,
+graftlint). A package only so pyproject console scripts can address
+``scripts.graftlint:main``; nothing here imports at framework import
+time."""

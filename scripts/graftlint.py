@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""graftlint CLI — run the AST lint pass over the repo.
+
+    python scripts/graftlint.py multiverso_tpu scripts
+    python scripts/graftlint.py --format json multiverso_tpu
+    python scripts/graftlint.py --baseline graftlint-baseline.json ...
+    python scripts/graftlint.py --write-baseline out.json ...
+    python scripts/graftlint.py --list-rules
+
+Exit codes: 0 clean (every finding suppressed or baselined, no stale
+baseline entries), 1 findings (or stale baseline entries — the baseline
+only ever shrinks), 2 usage/parse errors.
+
+The tier-1 gate (tests/test_graftlint_gate.py) runs the same pass through
+the library API; this CLI exists for editors, pre-commit, and the
+``--write-baseline`` bootstrap.  JSON schema::
+
+    {"version": 1, "files": N, "findings": [{rule, path, line, col,
+     message, symbol, severity}], "suppressed": N, "baselined": N,
+     "stale_baseline": [...], "parse_errors": [...]}
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(_REPO, "graftlint-baseline.json")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST lint for JAX hot-path and concurrency hazards")
+    p.add_argument("paths", nargs="*",
+                   help="files or directories (default: multiverso_tpu "
+                        "scripts, relative to the repo root)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON (default: graftlint-baseline.json "
+                        "at the repo root when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline: report every finding")
+    p.add_argument("--write-baseline", metavar="PATH", default=None,
+                   help="write all current findings as a fresh baseline "
+                        "(entries get a FIXME reason to fill in) and "
+                        "exit 0")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--root", default=_REPO,
+                   help="repo root for relative finding paths")
+    args = p.parse_args(argv)
+
+    from multiverso_tpu.analysis import (Baseline, LintEngine, all_rules,
+                                         run_lint)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id:28s} {rule.severity:8s} "
+                  f"{' '.join(rule.rationale.split())}")
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, "multiverso_tpu"),
+                           os.path.join(_REPO, "scripts")]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"graftlint: no such path: {path}", file=sys.stderr)
+            return 2
+
+    baseline_path = None
+    if not args.no_baseline and args.write_baseline is None:
+        baseline_path = args.baseline or (
+            DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None)
+        if args.baseline and not os.path.exists(args.baseline):
+            print(f"graftlint: baseline not found: {args.baseline}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        result = run_lint(paths, root=args.root,
+                          baseline_path=baseline_path)
+    except ValueError as exc:       # malformed baseline
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline is not None:
+        entries = [dict(rule=f.rule, path=f.path, symbol=f.symbol,
+                        count=1, reason="FIXME: justify or fix")
+                   for f in result.findings]
+        merged = {}
+        for e in entries:
+            key = (e["rule"], e["path"], e["symbol"])
+            if key in merged:
+                merged[key]["count"] += 1
+            else:
+                merged[key] = e
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump(Baseline(list(merged.values())).dump(), f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
+        print(f"wrote {len(merged)} baseline entries "
+              f"({len(result.findings)} findings) to "
+              f"{args.write_baseline}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": 1,
+            "files": result.files,
+            "findings": [f.to_json() for f in result.findings],
+            "suppressed": result.suppressed,
+            "baselined": result.baselined,
+            "stale_baseline": result.stale_baseline,
+            "parse_errors": result.parse_errors,
+        }, indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for entry in result.stale_baseline:
+            print(f"stale baseline entry (no longer fires — delete it): "
+                  f"{entry}")
+        for err in result.parse_errors:
+            print(f"parse error: {err}", file=sys.stderr)
+        ok = "clean" if result.clean else \
+            f"{len(result.findings)} finding(s)"
+        print(f"graftlint: {result.files} files, {ok}, "
+              f"{result.suppressed} suppressed, "
+              f"{result.baselined} baselined")
+
+    if result.parse_errors:
+        return 2
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""Fixture: sanctioned output paths — log module, attribute prints,
+strings mentioning print, and a local redefinition."""
+from multiverso_tpu.utils.log import log
+
+
+def report(stats, console):
+    log.info("loss: %s", stats["loss"])
+    log.raw("%s", stats)
+    console.print(stats)            # attribute access: not the builtin
+    return "do not print(this)"
+
+
+def shadowed(print):
+    print("shadowed builtin is the caller's problem, not a bare print")

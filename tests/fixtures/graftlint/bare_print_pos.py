@@ -1,0 +1,7 @@
+"""Fixture: bare print in framework code."""
+
+
+def report(stats):
+    print("loss:", stats["loss"])  # expect: bare-print
+    for k, v in stats.items():
+        print(f"{k}={v}")  # expect: bare-print

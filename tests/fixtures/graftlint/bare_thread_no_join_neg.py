@@ -1,0 +1,41 @@
+"""Fixture: lifecycle-owned threads — daemonized, joined locally, joined
+on the class shutdown path, or joined through the collecting list."""
+import threading
+
+
+def daemonized(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def scoped(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+class Engine:
+    def start(self, loop):
+        self._worker = threading.Thread(target=loop)
+        self._worker.start()
+
+    def stop(self):
+        self._worker.join()
+
+
+def fan_out(fns):
+    threads = [threading.Thread(target=f, daemon=True) for f in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def pool(fns):
+    # non-daemon comprehension pool, joined through the collecting list
+    workers = [threading.Thread(target=f) for f in fns]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()

@@ -1,0 +1,18 @@
+"""Fixture: non-daemon threads nobody joins wedge interpreter exit."""
+import threading
+
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn)  # expect: bare-thread-no-join
+    t.start()
+    return t
+
+
+class Engine:
+    def start(self, loop):
+        self._worker = threading.Thread(target=loop)  # expect: bare-thread-no-join
+        self._worker.start()
+
+
+def anonymous(fn):
+    threading.Thread(target=fn).start()  # expect: bare-thread-no-join

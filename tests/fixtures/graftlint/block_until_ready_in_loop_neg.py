@@ -1,0 +1,12 @@
+"""Fixture: syncing once per block (outside the loop) is the pattern."""
+import jax
+
+
+def train(step, tables, blocks):
+    outs = []
+    for blk in blocks:
+        out = step(*tables, blk)
+        tables = out[:4]
+        outs.append(out[4])
+    jax.block_until_ready(outs)     # one batched wait
+    return tables

@@ -1,0 +1,15 @@
+"""Fixture: per-iteration syncs that serialize a dispatch pipeline."""
+import jax
+
+
+def train(step, tables, blocks):
+    for blk in blocks:
+        out = step(*tables, blk)
+        tables = out[:4]
+        jax.block_until_ready(out)  # expect: block-until-ready-in-loop
+    return tables
+
+
+def drain(queue):
+    while queue:
+        queue.pop(0).block_until_ready()  # expect: block-until-ready-in-loop

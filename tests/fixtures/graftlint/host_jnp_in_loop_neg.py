@@ -1,0 +1,28 @@
+"""Fixture: numpy on the host path, per-batch uploads stay legal, and
+jnp constructors inside traced code are the device path working as
+intended."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def accumulate(losses):
+    total = np.float32(0)
+    for l in losses:
+        total = total + np.float32(l)       # numpy: no device round trip
+    return total
+
+
+def upload_batches(step, batches):
+    outs = []
+    for b in batches:
+        outs.append(step(jnp.asarray(b)))   # per-batch upload is the API
+    return outs
+
+
+@jax.jit
+def traced(x):
+    acc = jnp.float32(0)
+    for i in range(4):                      # unrolled AT TRACE TIME
+        acc = acc + jnp.float32(i) * x.sum()
+    return acc

@@ -1,0 +1,16 @@
+"""Fixture: per-iteration device boxing of host scalars/constants."""
+import jax.numpy as jnp
+
+
+def accumulate(losses):
+    total = jnp.float32(0)
+    for l in losses:
+        total = total + jnp.float32(1e-6)  # expect: host-jnp-in-loop
+    return total
+
+
+def pad_all(rows, width):
+    out = []
+    for r in rows:
+        out.append(jnp.zeros((width,)))  # expect: host-jnp-in-loop
+    return out

@@ -1,0 +1,21 @@
+"""Fixture: the idiomatic counterparts — static casts and host-side
+conversions OUTSIDE traced code carry no finding."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_step(x):
+    d = float(x.shape[-1])          # shape access is trace-time static
+    n = int(len(x.shape))           # len() likewise
+    scale = 1.0 / np.sqrt(x.shape[-1])
+    return x * jnp.float32(scale) * d * n
+
+
+def host_driver(step, batches):
+    total = 0.0
+    for b in batches:
+        loss = step(jnp.asarray(b))
+        total += float(loss)        # host code may sync freely
+    return np.asarray(total)

@@ -1,0 +1,31 @@
+"""Fixture: host syncs inside traced functions (never imported, only
+parsed by the lint engine tests)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_step(x):
+    scale = float(x)  # expect: implicit-host-sync
+    mean = float(x.sum() / x.shape[0])  # expect: implicit-host-sync
+    return x * scale * mean
+
+
+def make_step():
+    def step(w, g):
+        lr = w.sum()
+        w = w - float(lr) * g  # expect: implicit-host-sync
+        host = np.asarray(g)  # expect: implicit-host-sync
+        return w + host.sum()
+    return jax.jit(step)
+
+
+def loop_body(i, carry):
+    stop = bool(carry[0])  # expect: implicit-host-sync
+    val = carry[1].item()  # expect: implicit-host-sync
+    return (stop, val)
+
+
+def run(carry):
+    return jax.lax.fori_loop(0, 4, loop_body, carry)

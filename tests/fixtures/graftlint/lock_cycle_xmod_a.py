@@ -1,0 +1,17 @@
+"""Fixture (cross-module cycle, half A): service holds its lock and
+calls into the registry, which takes the registry lock."""
+import threading
+
+from lock_cycle_xmod_b import registry_put
+
+_SERVICE_LOCK = threading.Lock()
+
+
+def dispatch(key, value):
+    with _SERVICE_LOCK:
+        registry_put(key, value)  # acquires lock_cycle_xmod_b._REG_LOCK
+
+
+def service_apply(fn):
+    with _SERVICE_LOCK:
+        return fn()

@@ -1,0 +1,18 @@
+"""Fixture (cross-module cycle, half B): the registry holds its lock and
+calls back into the service — B-then-A against A-then-B in half A."""
+import threading
+
+from lock_cycle_xmod_a import service_apply
+
+_REG_LOCK = threading.Lock()
+_REG = {}
+
+
+def registry_put(key, value):
+    with _REG_LOCK:
+        _REG[key] = value
+
+
+def registry_sync():
+    with _REG_LOCK:
+        service_apply(lambda: None)  # acquires lock_cycle_xmod_a._SERVICE_LOCK

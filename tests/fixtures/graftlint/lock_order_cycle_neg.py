@@ -1,0 +1,29 @@
+"""Fixture: consistent A-before-B ordering everywhere — acyclic."""
+import threading
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+
+
+def flush(buf):
+    with _LOCK_A:
+        with _LOCK_B:
+            buf.clear()
+
+
+def publish(buf, item):
+    with _LOCK_A:
+        with _LOCK_B:
+            buf.append(item)
+
+
+def compact(buf):
+    # multi-item with in the SAME A-before-B order — still acyclic
+    with _LOCK_A, _LOCK_B:
+        buf.clear()
+
+
+def reenter_rlock():
+    # RLock self-nesting is legal, not a 1-cycle
+    lock = threading.RLock()
+    return lock

@@ -1,0 +1,35 @@
+"""Fixture: the synthetic two-lock cycle — thread 1 runs ``flush``
+(A then B), thread 2 runs ``publish`` (B then A)."""
+import threading
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+
+
+def flush(buf):
+    with _LOCK_A:
+        with _LOCK_B:  # expect: lock-order-cycle
+            buf.clear()
+
+
+def publish(buf, item):
+    with _LOCK_B:
+        with _LOCK_A:
+            buf.append(item)
+
+
+_LOCK_C = threading.Lock()
+_LOCK_D = threading.Lock()
+
+
+def compact(buf):
+    # the same deadlock spelled as one statement: C-then-D here ...
+    with _LOCK_C, _LOCK_D:  # expect: lock-order-cycle
+        buf.clear()
+
+
+def rotate(buf):
+    # ... against D-then-C here
+    with _LOCK_D:
+        with _LOCK_C:
+            buf.append(None)

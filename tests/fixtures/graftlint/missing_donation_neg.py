@@ -1,0 +1,10 @@
+"""Fixture: donated update steps, and non-step jits that owe nothing."""
+import jax
+
+
+def make_update(raw_update):
+    return jax.jit(raw_update, donate_argnums=(0, 1, 2, 3))
+
+
+def make_predict(predict_fn):
+    return jax.jit(predict_fn)      # not a step/update: no donation due

@@ -1,0 +1,11 @@
+"""Fixture: update-step jits that copy their table buffers."""
+import jax
+
+
+def make_update(raw_update):
+    return jax.jit(raw_update)  # expect: missing-donation
+
+
+def build(table_step):
+    step = jax.jit(table_step, static_argnums=(4,))  # expect: missing-donation
+    return step

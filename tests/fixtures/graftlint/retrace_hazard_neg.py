@@ -1,0 +1,10 @@
+"""Fixture: build the transform once, loop over dispatches."""
+import jax
+
+
+def sweep(fn, lrs, x):
+    step = jax.jit(fn)              # hoisted: one trace, many calls
+    outs = []
+    for lr in lrs:
+        outs.append(step(x, lr))
+    return outs

@@ -1,0 +1,31 @@
+"""Fixture: transform construction inside loops recompiles per
+iteration."""
+import functools
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+
+def sweep(fn, lrs, x):
+    outs = []
+    for lr in lrs:
+        step = jax.jit(lambda a: fn(a) * lr)  # expect: retrace-hazard
+        outs.append(step(x))
+    return outs
+
+
+def sweep_partial(fn, lrs, x):
+    outs = []
+    for lr in lrs:
+        step = functools.partial(jax.jit, static_argnums=0)(fn)  # expect: retrace-hazard
+        outs.append(step(lr, x))
+    return outs
+
+
+def shard_sweep(mesh, fn, specs, x):
+    outs = []
+    while specs:
+        spec = specs.pop()
+        f = shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec)  # expect: retrace-hazard
+        outs.append(f(x))
+    return outs

@@ -1,0 +1,34 @@
+"""Fixture: non-reentrant lock re-acquired through a call chain — the
+shape of the _CPU_COLLECTIVE_LOCK wedge."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data = {}
+
+    def put(self, key, value):
+        with self._lock:
+            self._data[key] = value
+            self._notify(key)  # expect: lock-order-cycle
+
+    def _notify(self, key):
+        with self._lock:        # called with _lock already held: wedge
+            return self._data.get(key)
+
+
+class ClassLocked:
+    _lock = threading.Lock()
+    _cache = {}
+
+    @classmethod
+    def put(cls, key, value):
+        with ClassLocked._lock:
+            ClassLocked._cache[key] = value
+            ClassLocked.flush()  # expect: lock-order-cycle
+
+    @classmethod
+    def flush(cls):
+        with ClassLocked._lock:     # re-acquired via the call above
+            ClassLocked._cache.clear()

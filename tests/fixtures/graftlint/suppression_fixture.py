@@ -1,0 +1,23 @@
+"""Fixture: every violation here carries a graftlint disable —
+same-line, line-above, and file-scoped forms must all hold."""
+# graftlint: disable-file=host-jnp-in-loop
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    return x * float(x.sum())  # graftlint: disable=implicit-host-sync
+
+
+def drain(markers):
+    for m in markers:
+        # graftlint: disable=block-until-ready-in-loop
+        jax.block_until_ready(m)
+
+
+def boxed(losses):
+    total = jnp.float32(0)
+    for l in losses:
+        total = total + jnp.float32(l)      # file-scoped disable above
+    return total

@@ -1,0 +1,23 @@
+"""Fixture: every registry write under the lock; import-time init and
+parameter-shadowed names stay exempt."""
+import threading
+
+_TABLES = {}
+_TABLES_LOCK = threading.Lock()
+
+_TABLES["bootstrap"] = None     # import time: serialized by the import lock
+
+
+def register(name, table):
+    with _TABLES_LOCK:
+        _TABLES[name] = table
+
+
+def drain(_TABLES):
+    # parameter shadows the module registry: a local, not the global
+    _TABLES.clear()
+
+
+def snapshot():
+    with _TABLES_LOCK:
+        return dict(_TABLES)

@@ -1,0 +1,23 @@
+"""Fixture: module registry written outside its guarding lock."""
+import threading
+
+_TABLES = {}
+_WAITERS = []
+_TABLES_LOCK = threading.Lock()
+
+
+def register(name, table):
+    with _TABLES_LOCK:
+        _TABLES[name] = table
+
+
+def unregister(name):
+    _TABLES.pop(name, None)  # expect: unlocked-registry-mutation
+
+
+def enqueue(waiter):
+    _WAITERS.append(waiter)  # expect: unlocked-registry-mutation
+
+
+def rebind(name, table):
+    _TABLES[name] = table  # expect: unlocked-registry-mutation

@@ -1,0 +1,229 @@
+"""Fixture-based unit tests for the graftlint engine.
+
+Each rule has a positive fixture (offending lines marked with
+``# expect: <rule-id>``) and a negative fixture (idiomatic counterparts,
+zero findings for that rule) under ``tests/fixtures/graftlint/``.  The
+tests assert rule id AND line numbers, plus suppression behavior and the
+baseline/stale-entry mechanics — so a rule that silently stops firing
+breaks here, not in production triage.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from multiverso_tpu.analysis import (Baseline, LintEngine, all_rules,
+                                     run_lint)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURES = os.path.join(_REPO, "tests", "fixtures", "graftlint")
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([a-z0-9\-]+)")
+
+RULES = ("implicit-host-sync", "block-until-ready-in-loop",
+         "retrace-hazard", "missing-donation", "host-jnp-in-loop",
+         "lock-order-cycle", "unlocked-registry-mutation",
+         "bare-thread-no-join", "bare-print")
+
+
+def _expected_lines(path, rule):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            m = _EXPECT_RE.search(line)
+            if m and m.group(1) == rule:
+                out.append(i)
+    return out
+
+
+def _findings(paths, rule=None):
+    result = LintEngine(_FIXTURES).run(
+        [os.path.join(_FIXTURES, p) for p in paths])
+    fs = result.findings
+    return [f for f in fs if rule is None or f.rule == rule]
+
+
+def _fixture_name(rule):
+    return rule.replace("-", "_")
+
+
+def test_registry_has_all_rules():
+    ids = {r.id for r in all_rules()}
+    assert set(RULES) <= ids
+    for r in all_rules():
+        assert r.severity in ("warning", "error"), r.id
+        assert r.rationale, f"rule {r.id} must document its rationale"
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_positive_fixture_fires_at_marked_lines(rule):
+    name = f"{_fixture_name(rule)}_pos.py"
+    path = os.path.join(_FIXTURES, name)
+    expected = _expected_lines(path, rule)
+    assert expected, f"fixture {name} has no '# expect: {rule}' markers"
+    got = sorted(f.line for f in _findings([name], rule))
+    assert got == expected, (
+        f"{rule}: expected findings at lines {expected}, got {got}")
+
+
+@pytest.mark.parametrize("rule", [r for r in RULES
+                                  if r != "lock-order-cycle"])
+def test_negative_fixture_is_clean(rule):
+    name = f"{_fixture_name(rule)}_neg.py"
+    got = _findings([name], rule)
+    assert not got, [f.render() for f in got]
+
+
+def test_lock_order_cycle_negative_and_rlock():
+    got = _findings(["lock_order_cycle_neg.py"], "lock-order-cycle")
+    assert not got, [f.render() for f in got]
+
+
+def test_lock_order_cycle_cross_module():
+    """A-then-B in one module against B-then-A in another, linked by
+    imported-function call edges, must still form a detected cycle."""
+    got = _findings(["lock_cycle_xmod_a.py", "lock_cycle_xmod_b.py"],
+                    "lock-order-cycle")
+    assert got, "cross-module lock cycle not detected"
+    msg = got[0].message
+    assert "_SERVICE_LOCK" in msg and "_REG_LOCK" in msg, msg
+
+
+def test_self_deadlock_through_call_chain():
+    name = "self_deadlock_pos.py"
+    expected = _expected_lines(os.path.join(_FIXTURES, name),
+                               "lock-order-cycle")
+    got = _findings([name], "lock-order-cycle")
+    assert [f.line for f in got] == expected, \
+        [f.render() for f in got]
+    assert "self-deadlock" in got[0].message
+
+
+def test_suppressions_all_forms():
+    """Same-line, line-above, and file-scoped disables each hold; the
+    engine still counts what it swallowed."""
+    result = LintEngine(_FIXTURES).run(
+        [os.path.join(_FIXTURES, "suppression_fixture.py")])
+    assert not result.findings, [f.render() for f in result.findings]
+    assert result.suppressed >= 3
+
+
+def test_baseline_absorbs_and_reports_stale(tmp_path):
+    """Baselined findings don't fail the run; a stale entry (finding
+    gone) is reported so the baseline only shrinks; counts bound how
+    many findings one entry may absorb."""
+    name = "bare_print_pos.py"
+    raw = _findings([name], "bare-print")
+    assert len(raw) == 2
+    entries = [dict(rule="bare-print", path=name,
+                    symbol=raw[0].symbol, count=2,
+                    reason="fixture: grandfathered for the unit test")]
+    engine = LintEngine(_FIXTURES, baseline=Baseline(entries))
+    result = engine.run([os.path.join(_FIXTURES, name)])
+    assert not [f for f in result.findings if f.rule == "bare-print"]
+    assert result.baselined >= 2
+    # same entry against a clean file -> stale
+    engine2 = LintEngine(_FIXTURES, baseline=Baseline(
+        [dict(entries[0], path="bare_print_neg.py")]))
+    result2 = engine2.run([os.path.join(_FIXTURES, "bare_print_neg.py")])
+    assert result2.stale_baseline and not result2.clean
+    # count=1 absorbs only one of the two findings
+    engine3 = LintEngine(_FIXTURES, baseline=Baseline(
+        [dict(entries[0], count=1)]))
+    result3 = engine3.run([os.path.join(_FIXTURES, name)])
+    assert len([f for f in result3.findings
+                if f.rule == "bare-print"]) == 1
+
+
+def test_trailing_disable_does_not_leak_to_next_line(tmp_path):
+    """A trailing same-line disable governs only its own line; only a
+    comment ALONE on a line also covers the line below."""
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""\
+        import jax
+
+
+        @jax.jit
+        def step(x, y):
+            a = float(x.sum())  # graftlint: disable=implicit-host-sync
+            b = float(y.sum())
+            return a + b
+    """), encoding="utf-8")
+    result = LintEngine(str(tmp_path)).run([str(mod)])
+    hits = [f for f in result.findings if f.rule == "implicit-host-sync"]
+    assert [f.line for f in hits] == [7], \
+        [f.render() for f in result.findings]
+    assert result.suppressed == 1
+
+
+def test_stale_reporting_scoped_to_scanned_paths():
+    """A scoped run must not flag baseline entries for files it never
+    scanned — but entries for files that no longer exist are stale
+    regardless."""
+    entry = dict(rule="bare-print", path="bare_print_pos.py",
+                 symbol="report", count=2, reason="scoped-run test")
+    target = [os.path.join(_FIXTURES, "bare_print_neg.py")]
+    result = LintEngine(_FIXTURES, baseline=Baseline([entry])).run(target)
+    assert not result.stale_baseline, result.stale_baseline
+    gone = dict(entry, path="deleted_long_ago.py")
+    result2 = LintEngine(_FIXTURES, baseline=Baseline([gone])).run(target)
+    assert result2.stale_baseline and not result2.clean
+
+
+def test_baseline_rejects_reasonless_entries():
+    with pytest.raises(ValueError):
+        Baseline([{"rule": "bare-print", "path": "x.py",
+                   "symbol": "f", "count": 1}])
+
+
+def test_baseline_size_gauge_exported():
+    from multiverso_tpu.telemetry import get_registry
+    entries = [dict(rule="bare-print", path="bare_print_pos.py",
+                    symbol="report", count=2, reason="gauge test")]
+    LintEngine(_FIXTURES, baseline=Baseline(entries)).run(
+        [os.path.join(_FIXTURES, "bare_print_pos.py")])
+    gauges = get_registry().snapshot()["gauges"]
+    assert gauges["lint.baseline_size"]["last"] == 2.0
+
+
+def test_cli_json_output_and_exit_codes(tmp_path):
+    """CLI contract: exit 1 + parseable JSON on findings, exit 0 on a
+    clean tree, exit 2 on bogus paths."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    script = os.path.join(_REPO, "scripts", "graftlint.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--format", "json", "--no-baseline",
+         "--root", _FIXTURES,
+         os.path.join(_FIXTURES, "bare_print_pos.py")],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == 1
+    rules = {f["rule"] for f in payload["findings"]}
+    assert "bare-print" in rules
+    for f in payload["findings"]:
+        assert {"rule", "path", "line", "col", "message", "symbol",
+                "severity"} <= set(f)
+
+    proc = subprocess.run(
+        [sys.executable, script, "--format", "json", "--no-baseline",
+         "--root", _FIXTURES,
+         os.path.join(_FIXTURES, "bare_print_neg.py")],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["findings"] == []
+
+    proc = subprocess.run(
+        [sys.executable, script, os.path.join(_FIXTURES, "nope.py")],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert proc.returncode == 2
+
+
+def test_run_lint_one_call_api():
+    result = run_lint([os.path.join(_FIXTURES, "bare_print_pos.py")],
+                      root=_FIXTURES)
+    assert any(f.rule == "bare-print" for f in result.findings)

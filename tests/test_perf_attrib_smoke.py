@@ -50,3 +50,22 @@ def test_perf_attrib_dry_run_cpu(tmp_path):
         trace = json.load(f)
     validate_chrome_trace(trace)
     assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+
+
+def test_graftlint_json_output_stays_parseable():
+    """Ride-along for the dry-run smoke: the graftlint ``--format json``
+    path is part of the CI tooling surface (editors / report diffing),
+    so its schema must stay machine-parseable even when the tree is
+    clean and the findings list is empty."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    script = os.path.join(_REPO, "scripts", "graftlint.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--format", "json",
+         os.path.join(_REPO, "multiverso_tpu", "analysis")],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == 1
+    assert isinstance(payload["findings"], list)
+    assert {"files", "suppressed", "baselined", "stale_baseline",
+            "parse_errors"} <= set(payload)
