@@ -334,6 +334,64 @@ def bench_matrix_table() -> float:
     return result
 
 
+def bench_serving() -> float:
+    """Serving-plane micro-bench (docs/SERVING.md): batched row lookups
+    through the full request plane — client socket, batcher coalescing,
+    device gather, framed reply — against the perf_matrix-sized table.
+    The full closed-loop harness (QPS pacing, deadline distributions,
+    overload shed curves) is ``scripts/serve_bench.py``; this leg keeps a
+    single steady-state lookup QPS riding along with every chip bench."""
+    import threading
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.serving import ServingClient, ServingService
+
+    # Deliberately small: tables registered in the Zoo live until
+    # shutdown, and the word2vec/roofline legs run after this one — a
+    # 1M-row serving table would pin ~200MB of HBM under them. 100K rows
+    # still exercises the full plane (socket, batcher, device gather).
+    NROW, NCOL, KEYS = 100_000, 32, 16
+    table = mv.create_table(mv.MatrixTableOption(NROW, NCOL,
+                                                 name="serve_bench_matrix"))
+    service = ServingService()
+    service.register_runner(table.serving_runner(), buckets=(16,),
+                            max_batch=8, max_wait_ms=1.0)
+    rng = np.random.default_rng(2)
+    n_threads, n_per = 4, 200
+    done = []
+    lock = threading.Lock()
+
+    def worker(seed):
+        cli = ServingClient(*service.address)
+        r = np.random.default_rng(seed)
+        try:
+            for _ in range(n_per):
+                cli.lookup(r.integers(0, NROW, KEYS).astype(np.int32),
+                           deadline_ms=10_000, timeout=120)
+            with lock:
+                done.append(n_per)
+        finally:
+            cli.close()
+
+    warm = ServingClient(*service.address)   # compile outside the window
+    warm.lookup(rng.integers(0, NROW, KEYS).astype(np.int32),
+                deadline_ms=10_000, timeout=120)
+    warm.close()
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    dt = time.perf_counter() - t0
+    service.close()
+    qps = sum(done) / dt if dt > 0 else 0.0
+    _log(f"serving: {sum(done)} x {KEYS}-row lookups over "
+         f"{n_threads} clients in {dt:.2f}s -> {qps:.0f} lookups/sec")
+    return qps
+
+
 def _probe_backend(timeout_s: int = 90) -> bool:
     """The tunneled TPU backend can be down OR wedged; probe in a
     subprocess so a dead tunnel yields a recorded result instead of a hung
@@ -506,12 +564,17 @@ def main() -> None:
     import multiverso_tpu as mv
 
     mv.init([])
+    serve_qps = 0.0
     try:
         updates_per_sec = bench_matrix_table()
         try:
             bench_pallas_rows()
         except Exception as e:  # noqa: BLE001 - comparison is best-effort
             _log(f"pallas comparison skipped: {e}")
+        try:
+            serve_qps = bench_serving()
+        except Exception as e:  # noqa: BLE001 - serving leg is best-effort
+            _log(f"serving leg skipped: {e}")
         words_per_sec, roofline = bench_word2vec()
         try:
             bench_big_vocab()
@@ -560,6 +623,7 @@ def main() -> None:
         "achieved_bytes_per_sec": roofline.get("achieved_bytes_per_sec"),
         "pct_hbm_roofline": roofline.get("pct_hbm_roofline"),
         "secondary": {"matrix_param_updates_per_sec": round(updates_per_sec),
+                      "serve_lookup_qps": round(serve_qps, 1),
                       **roofline, **_virtual_trend(here),
                       "telemetry": metrics_snapshot(buckets=False)},
     }))

@@ -431,6 +431,156 @@ class UnlockedRegistryMutation(Rule):
 
 
 @register
+class UnboundedQueueAppend(Rule):
+    id = "unbounded-queue-append"
+    severity = "error"
+    rationale = (
+        "A queue/deque/list grown inside a `while` loop with no visible "
+        "bound — no maxlen/maxsize at construction, no len() check, no "
+        "drain or shed path — is how a reader loop turns a slow consumer "
+        "into an OOM. The serving plane's whole admission story is that "
+        "every queue sheds instead of growing; this rule keeps new code "
+        "on that contract. Scoped to the request planes "
+        "(multiverso_tpu/serving/ + parallel/ps_service) where unbounded "
+        "growth is reachable from the network.")
+
+    _GROWERS = {"append", "appendleft", "put", "put_nowait"}
+    _DRAINERS = {"popleft", "pop", "get", "get_nowait", "clear",
+                 "popitem", "remove"}
+    _BOUND_KWARGS = {"maxlen", "maxsize"}
+    _CONTAINER_FACTORIES = {
+        "list", "collections.deque", "queue.Queue", "queue.LifoQueue",
+        "queue.PriorityQueue", "queue.SimpleQueue",
+    }
+    _SCOPED = ("multiverso_tpu/serving/", "multiverso_tpu/parallel/"
+               "ps_service")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.role == "script":
+            return      # CLI/bench scripts collect results by design
+        if ctx.role == "package" and \
+                not any(s in ctx.rel for s in self._SCOPED):
+            return      # package scope: the network-reachable planes only
+        for loop in ctx.walk():
+            if not isinstance(loop, ast.While):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute) or \
+                        node.func.attr not in self._GROWERS:
+                    continue
+                base = self._base_key(node.func.value)
+                if base is None:
+                    continue
+                scope = self._evidence_scope(node, base)
+                if scope is None:
+                    continue
+                ctor = self._construction(scope, base, ctx)
+                if ctor is None:
+                    continue        # origin unknown: cannot prove growth
+                if ctor == "bounded":
+                    continue
+                if self._has_drain_evidence(scope, base):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"'{self._render(base)}.{node.func.attr}(...)' grows "
+                    "inside a while loop with no visible bound (no "
+                    "maxlen/maxsize, no len() check, no drain/shed path "
+                    "in scope) — bound it or shed under pressure")
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _base_key(expr: ast.expr):
+        """('name', id) for locals/globals, ('self', attr) for instance
+        attrs; None for anything we can't track."""
+        if isinstance(expr, ast.Name):
+            return ("name", expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            return ("self", expr.attr)
+        return None
+
+    @staticmethod
+    def _render(base) -> str:
+        return f"self.{base[1]}" if base[0] == "self" else base[1]
+
+    @staticmethod
+    def _evidence_scope(node: ast.AST, base):
+        """Where construction/drain evidence may live: the enclosing class
+        for self attrs, the enclosing function (or module body is not
+        tracked) for plain names."""
+        if base[0] == "self":
+            return astutil.enclosing_class(node)
+        return astutil.enclosing_function(node)
+
+    @staticmethod
+    def _bound_arg(arg: Optional[ast.expr]) -> Optional[str]:
+        """Classify a maxlen/maxsize expression. ``Queue(0)`` and
+        ``deque(maxlen=None)`` mean INFINITE in their own semantics, so a
+        falsy constant is no bound at all; a non-constant bound is the
+        owner's decision and counts as bounded."""
+        if arg is None:
+            return None
+        if isinstance(arg, ast.Constant):
+            return "bounded" if arg.value else "unbounded"
+        return "bounded"
+
+    def _construction(self, scope: ast.AST, base, ctx: FileContext):
+        """'bounded' / 'unbounded' when the container's construction is
+        visible in scope, else None."""
+        for sub in ast.walk(scope):
+            # AnnAssign too: `self._q: Deque[T] = deque()` is exactly the
+            # typed-queue style the rule targets.
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                targets = [sub.target]
+            else:
+                continue
+            if not any(self._base_key(t) == base for t in targets):
+                continue
+            v = sub.value
+            if isinstance(v, (ast.List, ast.Dict, ast.Set)):
+                return "unbounded"
+            if isinstance(v, ast.Call):
+                name = astutil.resolve_name(v.func, ctx.aliases) or ""
+                if name in self._CONTAINER_FACTORIES or \
+                        name.endswith((".deque", ".Queue")):
+                    bound = next((k.value for k in v.keywords
+                                  if k.arg in self._BOUND_KWARGS), None)
+                    if bound is None and name.endswith("Queue") and v.args:
+                        bound = v.args[0]        # Queue(maxsize) positional
+                    if bound is None and name.endswith("deque") and \
+                            len(v.args) >= 2:
+                        bound = v.args[1]        # deque(iterable, maxlen)
+                    return self._bound_arg(bound) or "unbounded"
+        return None
+
+    def _has_drain_evidence(self, scope: ast.AST, base) -> bool:
+        """len(x) anywhere (a length check implies a bound/shed branch),
+        a drain call, or a `del x[...]` on the container in scope."""
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                if isinstance(fn, ast.Name) and fn.id == "len" and \
+                        len(sub.args) == 1 and \
+                        self._base_key(sub.args[0]) == base:
+                    return True
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr in self._DRAINERS and \
+                        self._base_key(fn.value) == base:
+                    return True
+            elif isinstance(sub, ast.Delete):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Subscript) and \
+                            self._base_key(tgt.value) == base:
+                        return True
+        return False
+
+
+@register
 class BareThreadNoJoin(Rule):
     id = "bare-thread-no-join"
     severity = "warning"

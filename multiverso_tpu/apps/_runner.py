@@ -50,6 +50,34 @@ def run_app(body: Callable[[List[str]], int],
 
 
 # ---------------------------------------------------------------------------
+# Serving-flag surface shared by serve_main and scripts/serve_bench.py.
+# ---------------------------------------------------------------------------
+def serve_config() -> dict:
+    """Resolve the ``-serve_*`` flags (utils/configure.py) into the kwargs
+    :meth:`ServingService.register_runner` takes, plus the listener port.
+    Centralized here so the CLI table in README documents ONE parse."""
+    from multiverso_tpu.utils.configure import get_flag
+    from multiverso_tpu.utils.log import FatalError
+
+    raw = str(get_flag("serve_buckets"))
+    try:
+        buckets = tuple(int(b) for b in raw.split(",") if b.strip())
+    except ValueError:
+        raise FatalError(f"bad -serve_buckets value '{raw}' "
+                         "(want e.g. '8,16,32,64')") from None
+    if not buckets:
+        raise FatalError("-serve_buckets must name at least one bucket")
+    return {
+        "host": str(get_flag("serve_host")),
+        "port": int(get_flag("serve_port")),
+        "buckets": buckets,
+        "max_batch": int(get_flag("serve_max_batch")),
+        "max_wait_ms": float(get_flag("serve_max_wait_ms")),
+        "max_queue": int(get_flag("serve_admission")),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Distributed-launch helpers shared by the app CLIs (-world_size=N): the
 # single-host `mpirun -np N` analog of the reference's deployment
 # (deploy/docker/Dockerfile:103-109 there).

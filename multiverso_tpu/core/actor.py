@@ -39,6 +39,10 @@ class MsgType(enum.IntEnum):
     Control_Lookup = 35
     Reply_Register = -34
     Reply_Lookup = -35
+    # Serving plane (multiverso_tpu/serving): request-level inference reads
+    # over the same framing. In the server range so to_server routing holds.
+    Serve_Request = 21
+    Serve_Reply = -21
     Heartbeat = 40
     Heartbeat_Reply = -40
     Reply_Error = -99   # server-side rejection (e.g. unknown table); wakes

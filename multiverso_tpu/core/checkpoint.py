@@ -55,8 +55,11 @@ def save_table(table: Any, uri: str) -> None:
         s.write(buf.getvalue())
 
 
-def load_table(table: Any, uri: str) -> None:
-    """``ServerTable::Load`` analog."""
+def read_table_payload(uri: str) -> Dict[str, np.ndarray]:
+    """Read one table's checkpoint payload (data + updater state + any
+    shard metadata) WITHOUT a live table to load it into — the
+    checkpoint-to-serving handoff (``serving/replica.py``) consumes raw
+    payloads so a read-only replica never has to construct device tables."""
     with open_stream(uri, "r") as s:
         data = np.load(io.BytesIO(s.read()))
         payload = {k: data[k] for k in data.files if k != _DTYPE_TAG_KEY}
@@ -64,6 +67,12 @@ def load_table(table: Any, uri: str) -> None:
         for tag in data[_DTYPE_TAG_KEY].tolist():
             key, _, dtype_name = tag.partition("=")
             payload[key] = payload[key].view(np.dtype(dtype_name))
+    return payload
+
+
+def load_table(table: Any, uri: str) -> None:
+    """``ServerTable::Load`` analog."""
+    payload = read_table_payload(uri)
     if hasattr(table, "load_state"):
         table.load_state(payload)
     else:
@@ -136,6 +145,25 @@ def load_all(checkpoint_dir: str) -> int:
         fname = files.get(name, f"{name}.npz")
         load_table(table, os.path.join(checkpoint_dir, fname))
     return int(meta["step"])
+
+
+def checkpoint_manifests(checkpoint_dir: str) -> List[Dict]:
+    """Every rank's manifest in one ``ckpt_*`` directory (``meta.json`` +
+    ``meta.r<rank>.json``), rank-ordered. A multi-rank save writes one
+    manifest per PS rank; a replica reassembling the full table must read
+    all of them (each names only its own shard files)."""
+    out: List[Dict] = []
+    if not os.path.isdir(checkpoint_dir):
+        return out
+    names = sorted(
+        (n for n in os.listdir(checkpoint_dir)
+         if re.fullmatch(r"meta(\.r\d+)?\.json", n)),
+        key=lambda n: 0 if n == "meta.json"
+        else int(n.split(".")[1][1:]))
+    for name in names:
+        with open_stream(os.path.join(checkpoint_dir, name), "r") as s:
+            out.append(json.loads(s.read().decode()))
+    return out
 
 
 def latest_checkpoint(directory: str, prefix: str = "ckpt",

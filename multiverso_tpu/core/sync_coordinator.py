@@ -43,7 +43,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List
+from typing import List, Tuple
 
 from multiverso_tpu.telemetry import gauge, histogram
 from multiverso_tpu.utils.log import check
@@ -177,3 +177,14 @@ class SyncCoordinator:
             self._adds.finish(worker_id)
             self._gets.finish(worker_id)
             self._cv.notify_all()
+
+    def clock(self) -> Tuple[float, float]:
+        """Snapshot version for read-only consumers: the globally committed
+        ``(add_min, get_min)`` clocks. The serving plane stamps replies
+        with the add clock — two lookups stamped with the same value were
+        served from views containing the same committed update rounds
+        (the SyncServer identical-i-th-view guarantee restated as a
+        version number). Retired (INF) workers are masked out, so the
+        stamp stays finite until every worker finishes."""
+        with self._cv:
+            return (self._adds.min(), self._gets.min())

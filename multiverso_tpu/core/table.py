@@ -236,6 +236,17 @@ class ServerStore:
         with self._dispatch_scope():
             return self._finish(self._access_rows(self.data, row_ids))
 
+    def read_rows_with(self, gather_fn: Callable, row_ids) -> jax.Array:
+        """Dispatch a CALLER-OWNED jitted gather against the live buffer
+        under the store's dispatch guard. The serving plane uses this for
+        bucket-shaped batched lookups: the caller keeps its own jit (so
+        its executable-per-bucket accounting is exact and isolated from
+        training-path shapes) while the store lock guarantees the gather
+        never captures a parameter buffer an updater is about to donate
+        away — the same snapshot contract as :meth:`read_rows`."""
+        with self._dispatch_scope():
+            return self._finish(gather_fn(self.data, row_ids))
+
     def block(self) -> None:
         """Wait until all previously dispatched updates have executed."""
         jax.block_until_ready(self.read())

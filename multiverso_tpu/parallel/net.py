@@ -122,6 +122,43 @@ def parse_frame(buf) -> Tuple[Optional[Message], int]:
                    data=data), off
 
 
+# ---------------------------------------------------------------------------
+# Serving-plane payload codec (multiverso_tpu/serving). SERVE_REPLY values
+# ride the same length-prefixed blob framing; the marker blob carries the
+# wire dtype + logical shape so the reply leg can opt into bf16 truncation
+# (-serve_wire_dtype=bf16: half the reply bytes at bfloat16 read precision)
+# without the client guessing. Non-float payloads (token ids) always go raw.
+# ---------------------------------------------------------------------------
+SERVE_WIRE_RAW = 0
+SERVE_WIRE_BF16 = 1
+
+
+def pack_serve_payload(arr: np.ndarray, wire_dtype: str = "f32"
+                       ) -> List[np.ndarray]:
+    """Value array -> [marker, blob]. ``wire_dtype`` in {"f32", "bf16"};
+    bf16 applies only to float32 payloads (ids/counts must not truncate)."""
+    arr = np.ascontiguousarray(arr)
+    marker = np.asarray([SERVE_WIRE_RAW, arr.ndim, *arr.shape],
+                        dtype=np.int64)
+    if wire_dtype == "bf16" and arr.dtype == np.float32:
+        from multiverso_tpu.utils.quantization import f32_to_bf16_bits
+        marker[0] = SERVE_WIRE_BF16
+        return [marker, f32_to_bf16_bits(arr)]
+    return [marker, arr]
+
+
+def unpack_serve_payload(blobs: List[np.ndarray]) -> np.ndarray:
+    marker = blobs[0]
+    mode, ndim = int(marker[0]), int(marker[1])
+    shape = tuple(int(d) for d in marker[2:2 + ndim])
+    if mode == SERVE_WIRE_RAW:
+        return blobs[1].reshape(shape)
+    if mode == SERVE_WIRE_BF16:
+        from multiverso_tpu.utils.quantization import bf16_bits_to_f32
+        return bf16_bits_to_f32(blobs[1]).reshape(shape)
+    raise IOError(f"unknown serve payload mode {mode}")
+
+
 def recv_message(sock: socket.socket) -> Optional[Message]:
     """Blocking read of one framed message; None on clean EOF."""
     magic = _recv_exact(sock, _MAGIC.size)

@@ -1,0 +1,36 @@
+"""Serving plane: dynamic-batching inference over PS tables.
+
+The first subsystem on the inference half of the north star. Layers:
+
+* ``batcher``  — deadline-aware admission + pad-to-bucket micro-batching
+  (one compiled executable per bucket, by construction);
+* ``runners``  — model runners behind one protocol: live-table row lookup
+  (bitwise-equal to ``table.get``), frozen-replica lookup, and
+  KV-cached greedy decode for ``attention_lm``;
+* ``replica``  — checkpoint-to-serving handoff with atomic hot-swap;
+* ``service``/``client`` — the DCN-framed request plane with concurrent
+  in-flight requests and shard routing.
+
+See docs/SERVING.md for architecture and tuning.
+"""
+
+from multiverso_tpu.serving.batcher import (BucketLadder, DynamicBatcher,
+                                            ServeRequest, ShedError)
+from multiverso_tpu.serving.client import (RoutedLookupClient, ServeResult,
+                                           ServingClient)
+from multiverso_tpu.serving.replica import (CheckpointReplica,
+                                            ReplicaSnapshot,
+                                            load_checkpoint_tables)
+from multiverso_tpu.serving.runners import (AttentionLMRunner,
+                                            ReplicaLookupRunner,
+                                            ServingRunner,
+                                            SparseLookupRunner)
+from multiverso_tpu.serving.service import ServingService
+
+__all__ = [
+    "AttentionLMRunner", "BucketLadder", "CheckpointReplica",
+    "DynamicBatcher", "ReplicaLookupRunner", "ReplicaSnapshot",
+    "RoutedLookupClient", "ServeRequest", "ServeResult", "ServingClient",
+    "ServingRunner", "ServingService", "ShedError", "SparseLookupRunner",
+    "load_checkpoint_tables",
+]

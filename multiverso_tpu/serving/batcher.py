@@ -1,0 +1,309 @@
+"""Dynamic micro-batcher: the admission + coalescing stage of the serving
+plane.
+
+Concurrent point requests (a handful of embedding rows, one decode prompt)
+are individually far too small to feed a TPU; the batcher coalesces them
+into pad-to-bucket shapes so the runner underneath compiles EXACTLY one
+executable per ladder bucket and never retraces (Kumar et al., 2020 — TPU
+concurrency lives or dies on shape stability). The batch dimension is
+always padded to ``max_batch`` for the same reason: a (batch, bucket) shape
+pair, not just the bucket, keys the jit cache.
+
+Admission control is deadline-aware: the queue is bounded, and when it
+overflows the request that dies is the one whose deadline is nearest —
+it was the least likely to make it anyway, and shedding it preserves the
+most aggregate slack. Requests that expire while queued are shed at batch
+formation instead of wasting device time. Overload therefore degrades to
+a bounded queue + rising shed counters, never an unbounded backlog
+(``serve.shed.*`` counters + ``serve.queue_depth`` gauge tell the story).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from multiverso_tpu.telemetry import counter, gauge, histogram, span
+from multiverso_tpu.utils.log import check, log
+
+
+class ShedError(RuntimeError):
+    """Request rejected: admission control shed it or its deadline passed
+    before service. Carries ``reason`` in {"queue_full", "deadline",
+    "oversize", "malformed", "closed"} ("server" client-side, when the
+    reason string arrived over the wire)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"request shed ({reason})"
+                         + (f": {detail}" if detail else ""))
+        self.reason = reason
+
+
+class BucketLadder:
+    """Fixed, sorted ladder of padded payload lengths."""
+
+    def __init__(self, buckets: Sequence[int]):
+        check(len(buckets) > 0, "bucket ladder must not be empty")
+        self.buckets: Tuple[int, ...] = tuple(sorted({int(b)
+                                                      for b in buckets}))
+        check(self.buckets[0] >= 1, "buckets must be >= 1")
+
+    def pick(self, n: int) -> Optional[int]:
+        """Smallest bucket >= n, or None when n exceeds the ladder."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return None
+
+    @property
+    def max(self) -> int:
+        return self.buckets[-1]
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One queued request. ``on_done`` receives either the result row
+    (runner-sliced) or a :class:`ShedError`; it runs on the batcher worker
+    thread and must be cheap (hand the bytes to an IO layer, set an
+    event)."""
+    payload: np.ndarray
+    deadline: float                      # absolute time.monotonic()
+    t_submit: float
+    on_done: Callable[[object], None]
+
+
+class _Future:
+    """Event + slot future for the synchronous submit surface."""
+
+    __slots__ = ("event", "slot")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.slot: List[object] = []
+
+    def deliver(self, result: object) -> None:
+        self.slot.append(result)
+        self.event.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        check(self.event.wait(timeout), "serve request timed out")
+        result = self.slot[0]
+        if isinstance(result, BaseException):
+            raise result
+        return result
+
+
+class DynamicBatcher:
+    """Coalesces requests for ONE runner into padded bucket-shaped batches.
+
+    Knobs: ``max_batch`` (coalescing width — also the padded batch dim),
+    ``max_wait_ms`` (how long the head request may wait for company),
+    ``max_queue`` (admission bound: queued-but-unbatched requests)."""
+
+    def __init__(self, runner, buckets: Sequence[int],
+                 max_batch: int = 8, max_wait_ms: float = 2.0,
+                 max_queue: int = 64):
+        self.runner = runner
+        self.ladder = BucketLadder(buckets)
+        self.max_batch = max(1, int(max_batch))
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self.max_queue = max(1, int(max_queue))
+        self._cv = threading.Condition()
+        self._queue: "collections.deque[ServeRequest]" = collections.deque()
+        self._running = True
+        # Telemetry (docs/OBSERVABILITY.md catalog, serve.* family).
+        self._g_depth = gauge("serve.queue_depth")
+        self._g_inflight = gauge("serve.inflight")
+        self._c_requests = counter("serve.requests")
+        self._c_batches = counter("serve.batches")
+        self._c_shed_full = counter("serve.shed.queue_full")
+        self._c_shed_deadline = counter("serve.shed.deadline")
+        self._c_shed_oversize = counter("serve.shed.oversize")
+        self._h_admit = histogram("serve.latency.admit")
+        self._h_batch = histogram("serve.latency.batch")
+        self._h_device = histogram("serve.latency.device")
+        self._worker = threading.Thread(target=self._loop,
+                                        name="serve-batcher", daemon=True)
+        self._worker.start()
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, payload: np.ndarray,
+               deadline_ms: float = 100.0) -> _Future:
+        """Synchronous-friendly submit: returns a future; ``wait()`` raises
+        :class:`ShedError` if the request was shed."""
+        fut = _Future()
+        self.submit_callback(payload, deadline_ms, fut.deliver)
+        return fut
+
+    def submit_callback(self, payload: np.ndarray, deadline_ms: float,
+                        on_done: Callable[[object], None]) -> None:
+        """Admission-controlled enqueue; sheds synchronously (via
+        ``on_done``) when the request cannot be admitted."""
+        now = time.monotonic()
+        payload = np.atleast_1d(np.asarray(payload))
+        if payload.ndim != 1:
+            # Reject at admission: a 2-D/ragged payload would blow up in
+            # batch formation and take innocent batch-mates with it (a
+            # remote client controls this value).
+            on_done(ShedError("malformed",
+                              f"payload must be 1-D, got shape "
+                              f"{payload.shape}"))
+            return
+        if self.ladder.pick(payload.shape[0]) is None:
+            self._c_shed_oversize.inc()
+            on_done(ShedError("oversize",
+                              f"payload length {payload.shape[0]} exceeds "
+                              f"largest bucket {self.ladder.max}"))
+            return
+        req = ServeRequest(payload=payload,
+                           deadline=now + max(deadline_ms, 0.0) / 1e3,
+                           t_submit=now, on_done=on_done)
+        shed: List[Tuple[ServeRequest, ShedError]] = []
+        with self._cv:
+            if not self._running:
+                shed.append((req, ShedError("closed", "batcher is closed")))
+            else:
+                self._admit_locked(req, now, shed)
+                self._g_depth.set(len(self._queue))
+                self._cv.notify()
+        for victim, err in shed:
+            victim.on_done(err)
+
+    def _admit_locked(self, req: ServeRequest, now: float,
+                      shed: List[Tuple[ServeRequest, ShedError]]) -> None:
+        """Deadline-aware admission: expired entries are purged first;
+        if the queue is still at the bound, the earliest-deadline request
+        (queued OR incoming) is the one shed."""
+        if len(self._queue) >= self.max_queue:
+            live = []
+            for r in self._queue:
+                if r.deadline < now:
+                    self._c_shed_deadline.inc()
+                    shed.append((r, ShedError("deadline",
+                                              "expired while queued")))
+                else:
+                    live.append(r)
+            self._queue = collections.deque(live)
+        if len(self._queue) >= self.max_queue:
+            victim = min(self._queue, key=lambda r: r.deadline)
+            self._c_shed_full.inc()
+            if victim.deadline <= req.deadline:
+                self._queue.remove(victim)
+                shed.append((victim, ShedError("queue_full",
+                                               "admission bound exceeded")))
+                self._queue.append(req)
+            else:
+                shed.append((req, ShedError("queue_full",
+                                            "admission bound exceeded")))
+            return
+        self._queue.append(req)
+
+    # -- batch formation + dispatch -----------------------------------------
+    def _loop(self) -> None:
+        while True:
+            batch = self._gather_batch()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            self._c_requests.inc(len(batch))
+            self._g_inflight.set(len(batch))
+            self._run_batch(batch)
+            self._g_inflight.set(0)
+
+    def _gather_batch(self) -> Optional[List[ServeRequest]]:
+        """Blocks for the head request, then waits up to ``max_wait_ms``
+        (from the head's submit) for company; sheds expired entries.
+        Returns None on shutdown with an empty queue."""
+        with self._cv:
+            while self._running and not self._queue:
+                self._cv.wait(0.2)
+            if not self._queue:
+                return None         # shutdown
+            head = self._queue[0]
+            flush_at = head.t_submit + self.max_wait_s
+            while (self._running and len(self._queue) < self.max_batch
+                   and time.monotonic() < flush_at):
+                self._cv.wait(max(flush_at - time.monotonic(), 1e-4))
+            batch = [self._queue.popleft()
+                     for _ in range(min(self.max_batch, len(self._queue)))]
+            self._g_depth.set(len(self._queue))
+        now = time.monotonic()
+        live: List[ServeRequest] = []
+        for r in batch:
+            if r.deadline < now:
+                self._c_shed_deadline.inc()
+                self._safe_done(r, ShedError("deadline",
+                                             "expired while queued"))
+            else:
+                self._h_admit.observe((now - r.t_submit) * 1e3)
+                live.append(r)
+        return live
+
+    def _run_batch(self, batch: List[ServeRequest]) -> None:
+        """Exactly-once delivery: each request's ``on_done`` runs once no
+        matter where a failure lands — a runner error sheds the whole
+        batch (none delivered yet), and a per-request delivery/slice
+        error is contained to that request (already-answered siblings
+        must never see a second, contradictory callback)."""
+        t0 = time.monotonic()
+        try:
+            # Formation is inside the guard too: admission validates
+            # payload rank, but a dtype a runner can't cast must shed the
+            # batch, never kill the worker thread (one hostile client
+            # would otherwise wedge the service for everyone).
+            bucket = self.ladder.pick(max(r.payload.shape[0]
+                                          for r in batch))
+            dtype = getattr(self.runner, "payload_dtype", np.int32)
+            pad_id = getattr(self.runner, "pad_id", 0)
+            mat = np.full((self.max_batch, bucket), pad_id, dtype=dtype)
+            lengths = np.zeros(self.max_batch, dtype=np.int32)
+            for i, r in enumerate(batch):
+                n = r.payload.shape[0]
+                mat[i, :n] = r.payload
+                lengths[i] = n
+            self._h_batch.observe((time.monotonic() - t0) * 1e3)
+            t1 = time.monotonic()
+            with span("serve.batch",
+                      runner=getattr(self.runner, "name", "?"),
+                      bucket=bucket, size=len(batch)):
+                out = self.runner.run(mat, lengths)
+        except Exception as e:  # noqa: BLE001 - a poisoned batch must not
+            log.error("serve batcher: batch failed: %s", e)   # kill the
+            for r in batch:                                   # worker
+                self._safe_done(r, ShedError("closed",
+                                             f"runner error: {e}"))
+            return
+        self._c_batches.inc()
+        self._h_device.observe((time.monotonic() - t1) * 1e3)
+        for i, r in enumerate(batch):
+            try:
+                result = self.runner.slice_result(out, i, int(lengths[i]))
+            except Exception as e:  # noqa: BLE001 - contain to request i
+                log.error("serve batcher: result slice failed: %s", e)
+                result = ShedError("closed", f"runner error: {e}")
+            self._safe_done(r, result)
+
+    @staticmethod
+    def _safe_done(req: ServeRequest, result: object) -> None:
+        try:
+            req.on_done(result)
+        except Exception as e:  # noqa: BLE001 - a callback raise must not
+            log.error("serve batcher: on_done callback failed: %s", e)
+            # poison sibling deliveries or re-enter delivery for this req
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        with self._cv:
+            self._running = False
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+        for r in pending:
+            self._safe_done(r, ShedError("closed", "batcher is closed"))
+        self._worker.join(timeout=10)

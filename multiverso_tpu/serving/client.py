@@ -1,0 +1,197 @@
+"""Serving clients: threaded, concurrent in-flight requests.
+
+:class:`ServingClient` multiplexes any number of concurrent requests over
+ONE persistent connection — a reader thread routes replies to waiters by
+msg_id (the Worker-side Communicator contract, reused for the read path).
+Replies legitimately arrive out of order; a shed request completes its
+waiter with a :class:`ShedError` instead of a timeout.
+
+:class:`RoutedLookupClient` is the multi-shard composition: global row
+ids route to the shard service that owns them by the same contiguous
+offset arithmetic the DCN tables partition with, sub-lookups fly
+concurrently, and the reply rows reassemble in request order.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from multiverso_tpu.core.actor import Message, MsgType
+from multiverso_tpu.parallel.net import (recv_message, send_message,
+                                         unpack_serve_payload)
+from multiverso_tpu.serving.batcher import ShedError
+from multiverso_tpu.utils.log import check
+
+
+class ServeResult:
+    """Waiter for one in-flight request."""
+
+    __slots__ = ("event", "slot")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.slot: List[object] = []
+
+    def wait(self, timeout: Optional[float] = 60.0):
+        """Returns ``(values, clock)``; raises :class:`ShedError` when the
+        server shed the request, ``OSError`` on a lost connection."""
+        check(self.event.wait(timeout), "serve request timed out")
+        if not self.slot:
+            raise OSError("connection to serving service lost")
+        msg = self.slot[0]
+        if msg.type == MsgType.Reply_Error:
+            reason = msg.data[0].tobytes().decode() if msg.data else "?"
+            raise ShedError("server", reason)
+        clock = int(msg.data[0][0])
+        values = unpack_serve_payload(msg.data[1:])
+        return values, clock
+
+
+class ServingClient:
+    """One persistent connection; thread-safe concurrent requests."""
+
+    # Random 48-bit start: a restarted client can't collide with its
+    # previous incarnation's in-flight ids on a long-lived server conn.
+    _msg_counter = int.from_bytes(os.urandom(6), "little")
+    _counter_lock = threading.Lock()
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port), timeout=30)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._waiters: Dict[int, ServeResult] = {}
+        self._waiters_lock = threading.Lock()
+        self._dead = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="serve-client", daemon=True)
+        self._reader.start()
+
+    @classmethod
+    def _next_msg_id(cls) -> int:
+        with cls._counter_lock:
+            cls._msg_counter += 1
+            return cls._msg_counter
+
+    def request_async(self, payload: np.ndarray,
+                      deadline_ms: float = 100.0,
+                      runner_id: int = 0) -> ServeResult:
+        if self._dead:
+            raise OSError("connection to serving service is closed")
+        msg = Message(type=MsgType.Serve_Request, table_id=runner_id,
+                      msg_id=self._next_msg_id(),
+                      data=[np.ascontiguousarray(payload),
+                            np.asarray([deadline_ms], dtype=np.float64)])
+        result = ServeResult()
+        with self._waiters_lock:
+            self._waiters[msg.msg_id] = result
+        try:
+            with self._send_lock:
+                send_message(self._sock, msg)
+        except OSError:
+            with self._waiters_lock:
+                self._waiters.pop(msg.msg_id, None)
+            raise
+        return result
+
+    def lookup(self, keys, deadline_ms: float = 100.0,
+               runner_id: int = 0,
+               timeout: Optional[float] = 60.0) -> np.ndarray:
+        """Synchronous row lookup; returns the value rows."""
+        values, _ = self.request_async(
+            np.asarray(keys, dtype=np.int32), deadline_ms,
+            runner_id).wait(timeout)
+        return values
+
+    def generate(self, tokens, deadline_ms: float = 1000.0,
+                 runner_id: int = 0,
+                 timeout: Optional[float] = 60.0) -> np.ndarray:
+        """Synchronous greedy decode; returns the generated token ids."""
+        values, _ = self.request_async(
+            np.asarray(tokens, dtype=np.int32), deadline_ms,
+            runner_id).wait(timeout)
+        return values
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = recv_message(self._sock)
+                if msg is None:
+                    break
+                with self._waiters_lock:
+                    waiter = self._waiters.pop(msg.msg_id, None)
+                if waiter is not None:
+                    waiter.slot.append(msg)
+                    waiter.event.set()
+        except OSError:
+            pass
+        self._dead = True
+        with self._waiters_lock:
+            pending = list(self._waiters.values())
+            self._waiters.clear()
+        for waiter in pending:
+            waiter.event.set()      # empty slot -> OSError in wait()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RoutedLookupClient:
+    """Client-side shard routing over per-shard serving services.
+
+    ``offsets`` is the ``reference_server_offsets`` vector (length
+    world+1): global row r belongs to the shard whose
+    ``offsets[s] <= r < offsets[s+1]``."""
+
+    def __init__(self, addrs: Sequence[Tuple[str, int]],
+                 offsets: Sequence[int], runner_id: int = 0):
+        check(len(offsets) == len(addrs) + 1,
+              "offsets must have one more entry than shard addresses")
+        self.offsets = np.asarray(offsets, dtype=np.int64)
+        self.runner_id = runner_id
+        self._clients = [ServingClient(h, p) for h, p in addrs]
+
+    def lookup(self, rows, deadline_ms: float = 100.0,
+               timeout: Optional[float] = 60.0) -> np.ndarray:
+        """Gather global rows across shards; reply rows in request order.
+        Sub-lookups are issued concurrently (one async request per touched
+        shard) and stitched back by position."""
+        rows = np.asarray(rows, dtype=np.int64)
+        if rows.size == 0:
+            # A zero-row lookup still round-trips (the serving codec
+            # carries empty payloads) so the reply has the real column
+            # shape instead of a made-up one.
+            values, _ = self._clients[0].request_async(
+                rows.astype(np.int32), deadline_ms,
+                self.runner_id).wait(timeout)
+            return values
+        shard = np.searchsorted(self.offsets, rows, side="right") - 1
+        check(bool((shard >= 0).all()
+                   and (shard < len(self._clients)).all()),
+              "row id outside the sharded range")
+        parts = []
+        for s in np.unique(shard):
+            pos = np.flatnonzero(shard == s)
+            res = self._clients[int(s)].request_async(
+                rows[pos].astype(np.int32), deadline_ms, self.runner_id)
+            parts.append((pos, res))
+        out: Optional[np.ndarray] = None
+        for pos, res in parts:
+            values, _ = res.wait(timeout)
+            if out is None:
+                out = np.empty((len(rows),) + values.shape[1:],
+                               dtype=values.dtype)
+            out[pos] = values
+        return out
+
+    def close(self) -> None:
+        for c in self._clients:
+            c.close()

@@ -1,0 +1,308 @@
+"""Model runners behind the serving batcher.
+
+Two workloads, one contract (:class:`ServingRunner`): the batcher hands a
+bucket-padded ``(max_batch, bucket)`` payload matrix + per-row lengths, the
+runner returns a batch-leading result array and slices per-request rows
+out of it. Every runner compiles EXACTLY one executable per bucket — the
+batch dimension is fixed, the bucket ladder fixes the payload dimension,
+and parameters travel as jit ARGUMENTS (never closures) so a replica
+hot-swap can rebind weights without retracing.
+
+* :class:`SparseLookupRunner` — embedding/parameter row lookup straight
+  from a LIVE :class:`~multiverso_tpu.core.table.ServerStore` shard. Reads
+  dispatch under the store's donation guard, so a batch is one consistent
+  snapshot of the table and the values are bitwise-equal to a direct
+  ``table.get`` of the same rows at the same clock (the serving plane
+  never sees a torn update).
+* :class:`ReplicaLookupRunner` — the same lookup against a FROZEN
+  checkpoint replica (``serving/replica.py``): zero contention with
+  training, hot-swapped between batches.
+* :class:`AttentionLMRunner` — greedy decode for ``models/attention_lm``
+  checkpoints with a PREALLOCATED per-bucket KV-cache: prefill writes the
+  prompt's K/V once, the decode loop runs as one ``lax.scan`` attending
+  into the cache, and the cache buffers are donated back to themselves
+  call-over-call (no per-request allocation).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.core.table import ServerStore
+from multiverso_tpu.utils.log import check
+
+try:                     # 3.8+ typing.Protocol
+    from typing import Protocol
+except ImportError:      # pragma: no cover - ancient interpreter
+    Protocol = object
+
+
+class ServingRunner(Protocol):
+    """What the batcher needs from a model runner."""
+
+    name: str
+    payload_dtype: np.dtype
+    pad_id: int
+
+    def run(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        """``batch`` is ``(max_batch, bucket)`` padded payloads, ``lengths``
+        the real payload length per row (0 = padding row). Returns an
+        array whose leading dim is ``max_batch``."""
+        ...
+
+    def slice_result(self, out: np.ndarray, i: int, length: int):
+        """Extract request ``i``'s reply from the batch result."""
+        ...
+
+    def jit_cache_size(self) -> int:
+        """Compiled-executable count — the no-retrace contract's witness
+        (== number of distinct buckets exercised)."""
+        ...
+
+
+def _make_gather():
+    """A fresh jitted gather per runner. The closure matters: jax's jit
+    cache is keyed by the underlying function object, so a shared
+    module-level fn would pool every runner's executables into one cache
+    and break the per-runner one-executable-per-bucket accounting."""
+    def gather(data, ids):
+        # mode="clip" mirrors ServerStore's access_rows kernel exactly: a
+        # pad id of 0 gathers row 0, which the per-request slice discards.
+        return jnp.take(data, ids, axis=0, mode="clip")
+    return jax.jit(gather)
+
+
+class SparseLookupRunner:
+    """Row lookup served from a live ServerStore shard.
+
+    ``row_offset`` maps GLOBAL row ids to this shard's local rows (the
+    same offset arithmetic the DCN tables route by); ``clock_fn`` (e.g.
+    ``sync_coordinator.clock``) stamps each batch with the snapshot
+    version it was served at."""
+
+    name = "lookup"
+    payload_dtype = np.int32
+    pad_id = 0
+
+    def __init__(self, store: ServerStore, row_offset: int = 0,
+                 clock_fn: Optional[Callable[[], Tuple[float, float]]]
+                 = None):
+        check(len(store.padded_shape) == 2,
+              "SparseLookupRunner serves 2-D row tables")
+        self.store = store
+        self.row_offset = int(row_offset)
+        self._clock_fn = clock_fn
+        self._gather = _make_gather()
+        self.last_clock: float = -1.0
+
+    def run(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        del lengths
+        flat = (batch.astype(np.int64) - self.row_offset).reshape(-1)
+        # Negative ids (pad rows under a nonzero offset) clip to row 0.
+        flat = np.maximum(flat, 0).astype(np.int32)
+        values = np.asarray(
+            self.store.read_rows_with(self._gather, flat))
+        if self._clock_fn is not None:
+            self.last_clock = float(self._clock_fn()[0])
+        return values.reshape(batch.shape[0], batch.shape[1], -1)
+
+    def slice_result(self, out: np.ndarray, i: int, length: int):
+        return out[i, :length]
+
+    def clock(self) -> float:
+        return self.last_clock
+
+    def jit_cache_size(self) -> int:
+        return int(self._gather._cache_size())
+
+
+class ReplicaLookupRunner:
+    """Row lookup from a frozen checkpoint replica (``replica.py``).
+
+    Captures one replica snapshot per batch, so a hot-swap between
+    batches is atomic from the client's point of view and NEVER blocks:
+    readers of the old snapshot finish against the old arrays."""
+
+    name = "replica_lookup"
+    payload_dtype = np.int32
+    pad_id = 0
+
+    def __init__(self, replica, table: str):
+        self.replica = replica
+        self.table = table
+        self._gather = _make_gather()
+        self.last_clock: float = -1.0
+
+    def run(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        del lengths
+        snap = self.replica.snapshot()
+        data = snap.table(self.table)
+        self.last_clock = float(snap.step)
+        flat = np.clip(batch.reshape(-1), 0, data.shape[0] - 1)
+        values = np.asarray(self._gather(data, flat.astype(np.int32)))
+        return values.reshape(batch.shape[0], batch.shape[1], -1)
+
+    def slice_result(self, out: np.ndarray, i: int, length: int):
+        return out[i, :length]
+
+    def clock(self) -> float:
+        return self.last_clock
+
+    def jit_cache_size(self) -> int:
+        return int(self._gather._cache_size())
+
+
+# ---------------------------------------------------------------------------
+# Greedy decode with a preallocated KV-cache.
+# ---------------------------------------------------------------------------
+class AttentionLMRunner:
+    """Greedy decode for an ``attention_lm`` checkpoint.
+
+    One jitted ``decode`` per prompt bucket: prefill the prompt (plain
+    causal attention — the serving replica is single-host, ring attention
+    is a training concern), write K/V into the preallocated cache, then a
+    ``lax.scan`` of single-token steps attending into the cache. The
+    cache buffers are jit-donated and threaded back into ``self._caches``
+    after every call, so steady-state serving allocates nothing."""
+
+    name = "attention_lm"
+    payload_dtype = np.int32
+    pad_id = 0
+
+    def __init__(self, params: Dict[str, np.ndarray], cfg,
+                 max_new: int = 16, max_batch: int = 8):
+        check(cfg.moe_experts == 0 and cfg.pipeline_stages == 0,
+              "serving decode supports the flat dense attention_lm layout")
+        self.cfg = cfg
+        self.max_new = int(max_new)
+        self.max_batch = int(max_batch)
+        self._params = jax.tree.map(jnp.asarray, params)
+        self._params_lock = threading.Lock()
+        # bucket -> preallocated (ck, cv): [L, B, H, bucket+max_new, dh]
+        self._caches: Dict[int, Tuple[jax.Array, jax.Array]] = {}
+        self._decode = jax.jit(self._decode_fn, donate_argnums=(3, 4))
+
+    def swap_params(self, params: Dict[str, np.ndarray]) -> None:
+        """Hot-swap weights (replica handoff). Same pytree structure and
+        shapes -> no retrace; the next batch serves the new checkpoint."""
+        new = jax.tree.map(jnp.asarray, params)
+        with self._params_lock:
+            self._params = new
+
+    def _cache_for(self, bucket: int) -> Tuple[jax.Array, jax.Array]:
+        cached = self._caches.get(bucket)
+        if cached is not None:
+            return cached
+        cfg = self.cfg
+        shape = (cfg.layers, self.max_batch, cfg.heads,
+                 bucket + self.max_new, cfg.dim // cfg.heads)
+        return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+    def _decode_fn(self, params, tokens, lengths, ck, cv):
+        """tokens [B, S] right-padded, lengths [B] -> ([B, max_new] greedy
+        tokens, ck, cv). Positions: prompt occupies 0..len-1; generated
+        token t sits at len+t."""
+        from multiverso_tpu.models.attention_lm import _ln, _posenc
+
+        cfg = self.cfg
+        B, S = tokens.shape
+        H, D = cfg.heads, cfg.dim
+        dh = D // H
+        N = self.max_new
+        scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(dh))
+        lengths = jnp.maximum(lengths, 1)        # pad rows: harmless row 0
+        pe = _posenc(S + N, D)
+
+        def heads_of(t, s):
+            return t.reshape(B, s, H, dh).transpose(0, 2, 1, 3)
+
+        # -- prefill: full causal pass over the padded prompt --------------
+        x = jnp.take(params["embed"], tokens, axis=0) + pe[None, :S]
+        causal = jnp.tril(jnp.ones((S, S), bool))[None, None]
+        for i in range(cfg.layers):
+            h = _ln(x)
+            q, k, v = jnp.split(h @ params[f"qkv_{i}"], 3, axis=-1)
+            q, k, v = heads_of(q, S), heads_of(k, S), heads_of(v, S)
+            ck = jax.lax.dynamic_update_slice(ck, k[None], (i, 0, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v[None], (i, 0, 0, 0, 0))
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            probs = jax.nn.softmax(
+                jnp.where(causal, scores, -jnp.inf), axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            x = x + o.transpose(0, 2, 1, 3).reshape(B, S, D) \
+                @ params[f"attn_out_{i}"]
+            h = _ln(x)
+            x = x + jax.nn.gelu(h @ params[f"mlp_in_{i}"]) \
+                @ params[f"mlp_out_{i}"]
+        logits = _ln(x) @ params["out"]                        # [B, S, V]
+        barange = jnp.arange(B)
+        first = jnp.argmax(logits[barange, lengths - 1], axis=-1)
+        first = first.astype(jnp.int32)                        # [B]
+
+        # -- decode: one cached-attention step per new token ----------------
+        # Cache SLOT for generated token t is S+t (past the prompt region,
+        # same slot for every row); its POSITION (rotary-free posenc index)
+        # is lengths+t per row. Keeping slot and position decoupled means a
+        # short prompt's pad slots (len..S) — which prefill filled with
+        # pad-token K/V — are never attended: valid keys are exactly
+        # ``slot < len`` (the real prompt) or ``S <= slot <= S+t``.
+        key_slot = jnp.arange(S + N)[None, :]                  # [1, S+N]
+
+        def step(carry, t):
+            tok, ck, cv = carry
+            pos = lengths + t                                  # [B]
+            x = jnp.take(params["embed"], tok, axis=0) + pe[pos]
+            mask = (key_slot < lengths[:, None]) | \
+                ((key_slot >= S) & (key_slot <= S + t))        # [B, S+N]
+            for i in range(cfg.layers):
+                h = _ln(x)
+                q, k, v = jnp.split(h @ params[f"qkv_{i}"], 3, axis=-1)
+                q = q.reshape(B, H, dh)
+                k = k.reshape(B, H, dh)
+                v = v.reshape(B, H, dh)
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k[None, :, :, None], (i, 0, 0, S + t, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v[None, :, :, None], (i, 0, 0, S + t, 0))
+                scores = jnp.einsum("bhd,bhkd->bhk", q, ck[i]) * scale
+                probs = jax.nn.softmax(
+                    jnp.where(mask[:, None], scores, -jnp.inf), axis=-1)
+                o = jnp.einsum("bhk,bhkd->bhd", probs, cv[i])
+                x = x + o.reshape(B, D) @ params[f"attn_out_{i}"]
+                h = _ln(x)
+                x = x + jax.nn.gelu(h @ params[f"mlp_in_{i}"]) \
+                    @ params[f"mlp_out_{i}"]
+            logits = _ln(x) @ params["out"]                    # [B, V]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, ck, cv), nxt
+
+        (_, ck, cv), rest = jax.lax.scan(
+            step, (first, ck, cv), jnp.arange(N - 1)) if N > 1 else \
+            ((first, ck, cv), jnp.zeros((0, B), jnp.int32))
+        out = jnp.concatenate([first[None], rest], axis=0).T   # [B, N]
+        return out, ck, cv
+
+    def run(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        bucket = batch.shape[1]
+        ck, cv = self._cache_for(bucket)
+        with self._params_lock:
+            params = self._params
+        out, ck, cv = self._decode(params, jnp.asarray(batch),
+                                   jnp.asarray(lengths), ck, cv)
+        self._caches[bucket] = (ck, cv)
+        return np.asarray(out)
+
+    def slice_result(self, out: np.ndarray, i: int, length: int):
+        del length                     # every request gets max_new tokens
+        return out[i]
+
+    def clock(self) -> float:
+        return -1.0
+
+    def jit_cache_size(self) -> int:
+        return int(self._decode._cache_size())

@@ -1,0 +1,200 @@
+"""Request-level serving service over the DCN binary framing.
+
+Reuses ``parallel/net.py``'s message framing (the same single-buffer
+header + size-prefixed-blob layout the PS request path speaks) with the
+``Serve_Request``/``Serve_Reply`` message kinds: a request carries the
+payload array (row ids / prompt tokens) plus a float64 meta blob
+``[deadline_ms]``; the reply carries ``[meta(int64 [clock, shed]),
+marker, values]`` where the value payload may ride as bf16 halves behind
+``-serve_wire_dtype=bf16`` (``net.pack_serve_payload``). A shed request
+answers with ``Reply_Error`` + a reason string blob, so the client's
+waiter fails loudly instead of riding out its deadline.
+
+Threading: one accept thread + one reader thread per connection (serving
+connections are few and long-lived — a client multiplexes its concurrent
+requests over one socket by msg_id). Replies are written by the batcher's
+completion callback under a per-connection send lock, so in-flight
+requests complete OUT OF ORDER and a slow decode never convoys a cheap
+lookup behind it.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from multiverso_tpu.core.actor import Message, MsgType
+from multiverso_tpu.parallel.net import (pack_serve_payload, recv_message,
+                                         send_message)
+from multiverso_tpu.serving.batcher import DynamicBatcher, ShedError
+from multiverso_tpu.telemetry import counter, gauge, histogram, span
+from multiverso_tpu.utils.log import check, log
+
+
+def _wire_dtype() -> str:
+    from multiverso_tpu.utils.configure import get_flag
+    return get_flag("serve_wire_dtype")
+
+
+class ServingService:
+    """Owns runners + their batchers; serves framed requests over TCP."""
+
+    MAX_CONNS = 256
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._batchers: Dict[int, DynamicBatcher] = {}
+        self._runners: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self._running = True
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.address = self._listener.getsockname()
+        self._conns: Dict[socket.socket, threading.Lock] = {}
+        self._g_conns = gauge("serve.connections")
+        self._c_replies = counter("serve.replies")
+        self._h_reply = histogram("serve.latency.reply")
+        self._h_total = histogram("serve.latency.total")
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True)
+        self._accept_thread.start()
+
+    # -- runner registry ----------------------------------------------------
+    def register_runner(self, runner, runner_id: int = 0,
+                        buckets: Sequence[int] = (8, 16, 32, 64),
+                        max_batch: int = 8, max_wait_ms: float = 2.0,
+                        max_queue: int = 64) -> None:
+        with self._lock:
+            check(runner_id not in self._batchers,
+                  f"runner id {runner_id} already registered")
+            self._runners[runner_id] = runner
+            self._batchers[runner_id] = DynamicBatcher(
+                runner, buckets, max_batch=max_batch,
+                max_wait_ms=max_wait_ms, max_queue=max_queue)
+
+    def batcher(self, runner_id: int = 0) -> DynamicBatcher:
+        return self._batchers[runner_id]
+
+    # -- connection handling -------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                if len(self._conns) >= self.MAX_CONNS:
+                    conn.close()
+                    continue
+                self._conns[conn] = threading.Lock()
+                self._g_conns.set(len(self._conns))
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             name="serve-conn", daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        try:
+            while self._running:
+                try:
+                    msg = recv_message(conn)
+                except (IOError, OSError):
+                    break
+                if msg is None:
+                    break
+                if msg.type != MsgType.Serve_Request:
+                    self._reply_error(conn, msg, "unknown message type")
+                    continue
+                try:
+                    self._handle(conn, msg)
+                except Exception as e:  # noqa: BLE001 - a bad request
+                    # answers with an error; dropping the socket would
+                    # abandon every OTHER in-flight request multiplexed
+                    # on this connection.
+                    log.error("serving: request %d failed: %s",
+                              msg.msg_id, e)
+                    self._reply_error(conn, msg, f"bad request: {e}")
+        finally:
+            self._drop(conn)
+
+    def _handle(self, conn: socket.socket, msg: Message) -> None:
+        t0 = time.monotonic()
+        batcher = self._batchers.get(msg.table_id)
+        if batcher is None:
+            self._reply_error(conn, msg, f"no runner {msg.table_id}")
+            return
+        if not msg.data:
+            self._reply_error(conn, msg, "request carries no payload")
+            return
+        payload = msg.data[0]
+        deadline_ms = float(msg.data[1][0]) if len(msg.data) > 1 \
+            and msg.data[1].size else 100.0
+        runner = self._runners[msg.table_id]
+
+        def on_done(result, _conn=conn, _msg=msg, _t0=t0):
+            t1 = time.monotonic()
+            if isinstance(result, ShedError):
+                self._reply_error(_conn, _msg, str(result))
+            else:
+                reply = _msg.create_reply()
+                clock = float(getattr(runner, "clock", lambda: -1.0)())
+                # Retired BSP worlds report an INF clock (every worker
+                # finished); the wire meta is int64, so stamp the
+                # "no finite version" sentinel instead of overflowing.
+                clock_i = int(clock) if np.isfinite(clock) else -1
+                meta = np.asarray([clock_i, 0], dtype=np.int64)
+                reply.data = [meta, *pack_serve_payload(
+                    np.asarray(result), _wire_dtype())]
+                self._send(_conn, reply)
+                self._c_replies.inc()
+            now = time.monotonic()
+            self._h_reply.observe((now - t1) * 1e3)
+            self._h_total.observe((now - _t0) * 1e3)
+
+        with span("serve.request", runner=getattr(runner, "name", "?")):
+            batcher.submit_callback(payload, deadline_ms, on_done)
+
+    def _reply_error(self, conn: socket.socket, msg: Message,
+                     reason: str) -> None:
+        err = Message(src=msg.dst, dst=msg.src, type=MsgType.Reply_Error,
+                      table_id=msg.table_id, msg_id=msg.msg_id,
+                      data=[np.frombuffer(reason.encode(), dtype=np.uint8)])
+        self._send(conn, err)
+
+    def _send(self, conn: socket.socket, reply: Message) -> None:
+        send_lock = self._conns.get(conn)
+        if send_lock is None:
+            return          # connection already gone
+        try:
+            with send_lock:
+                send_message(conn, reply)
+        except OSError:
+            self._drop(conn)
+
+    def _drop(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.pop(conn, None)
+            self._g_conns.set(len(self._conns))
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            self._drop(conn)
+        with self._lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.close()

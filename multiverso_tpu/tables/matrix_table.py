@@ -115,6 +115,17 @@ class MatrixTable(WorkerTable):
                 option: Optional[AddOption] = None) -> None:
         self.add_rows([row_id], np.asarray(delta)[None, :], option)
 
+    # -- serving hook (multiverso_tpu/serving; docs/SERVING.md) ------------
+    def serving_runner(self):
+        """A :class:`~multiverso_tpu.serving.SparseLookupRunner` over this
+        table's LIVE store. Reads dispatch under the store's donation
+        guard, so served values are bitwise-equal to :meth:`get_rows` of
+        the same rows; in sync mode the batch is stamped with the BSP add
+        clock it was served at."""
+        from multiverso_tpu.serving.runners import SparseLookupRunner
+        clock_fn = self._sync.clock if self._sync is not None else None
+        return SparseLookupRunner(self.store, clock_fn=clock_fn)
+
     # -- parity helper (ref matrix_table.cpp:235-313) ----------------------
     def partition(self, row_ids: Sequence[int]
                   ) -> Dict[int, np.ndarray]:

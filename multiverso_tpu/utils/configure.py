@@ -210,6 +210,28 @@ define_int("world_size", 1, "number of processes (ranks)")
 define_int("rank", 0, "this process's rank")
 define_string("platform", "", "force the jax platform (e.g. 'cpu') before "
               "first device use — lets CLIs run when the TPU is unreachable")
+# Serving plane (multiverso_tpu/serving; docs/SERVING.md).
+define_string("serve_host", "127.0.0.1", "serving listener bind address "
+              "(0.0.0.0 to accept remote clients; the advertised address "
+              "in -serve_addr_file is the bound one)")
+define_int("serve_port", 0, "serving service TCP port (0 = ephemeral; "
+           "the bound address is logged and written to -serve_addr_file)")
+define_string("serve_buckets", "8,16,32,64", "comma-separated pad-to "
+              "bucket ladder for serve payload lengths; fixed ladder = "
+              "one compiled executable per bucket, no retraces")
+define_double("serve_max_wait_ms", 2.0, "how long the head request may "
+              "wait for batch company before the batcher flushes")
+define_int("serve_max_batch", 8, "dynamic batch width (also the padded "
+           "batch dimension — part of the compiled shape)")
+define_int("serve_admission", 64, "admission bound: queued-but-unbatched "
+           "requests; beyond it the nearest-deadline request is shed")
+define_string("serve_wire_dtype", "f32", "f32|bf16: SERVE_REPLY value "
+              "payload encoding (bf16 halves reply bytes at bfloat16 "
+              "read precision; ids/token payloads always ship raw)")
+define_string("serve_addr_file", "", "write 'host:port' here once the "
+              "serving listener is bound (rendezvous for clients/tests)")
+define_double("serve_duration", 0.0, "serve for N seconds then exit "
+              "(0 = until killed) — CI and smoke hooks")
 # Telemetry export (multiverso_tpu/telemetry; docs/OBSERVABILITY.md).
 define_string("telemetry_dir", "", "write periodic metrics snapshots "
               "(metrics-<pid>-<seq>.json) and a Chrome trace "

@@ -34,8 +34,14 @@ class SparseFilter:
     def filter_in(self, values: np.ndarray
                   ) -> Tuple[bool, np.ndarray, Optional[np.ndarray]]:
         """Returns (compressed, payload, indices). Compresses only when >50%
-        of entries are within the clip threshold (the reference's rule)."""
+        of entries are within the clip threshold (the reference's rule).
+        A zero-length buffer is raw by definition (nothing to sparsify):
+        the >50% rule degenerates to a 0 <= 0 tie there, and relying on
+        the tie-break direction made empty SERVE_REPLY / empty-shard
+        payloads one refactor away from a shape error."""
         flat = np.asarray(values).ravel()
+        if flat.size == 0:
+            return False, flat, None
         small = np.abs(flat) <= self.clip
         if small.sum() * 2 <= len(flat):
             return False, flat, None
@@ -48,6 +54,12 @@ class SparseFilter:
         if not compressed:
             return payload.astype(dtype, copy=False).reshape(size)
         out = np.zeros(size, dtype=dtype)
+        if indices is None or len(indices) == 0:
+            # All entries were clipped (or the buffer was empty): the
+            # decoded result is exactly zeros. Skipping the fancy-index
+            # assignment matters: ``out[None] = payload`` would broadcast
+            # the payload over the WHOLE buffer instead of writing no rows.
+            return out
         out[indices] = payload
         return out
 

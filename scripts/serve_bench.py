@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the serving plane.
+
+Spins up an in-process ServingService over a synthetic embedding table,
+drives it with N client threads at a target aggregate QPS (each thread
+paces itself; a slow reply eats into that thread's budget — closed loop),
+and writes ``BENCH_SERVE.json``: latency percentiles (p50/p95/p99),
+achieved vs offered QPS, and the shed rate. Driving QPS past the
+admission bound is the supported way to demo overload behavior: the
+queue stays bounded and the shed rate rises instead.
+
+    python scripts/serve_bench.py --qps 2000 --threads 8 --duration 10
+    python scripts/serve_bench.py --dry-run          # CPU smoke (tier-1)
+
+``--overload`` multiplies the offered rate and tightens deadlines so the
+shed path is exercised deliberately.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rows", type=int, default=100_000)
+    p.add_argument("--cols", type=int, default=64)
+    p.add_argument("--keys-per-req", type=int, default=8)
+    p.add_argument("--buckets", default="8,16,32,64")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--admission", type=int, default=64)
+    p.add_argument("--threads", type=int, default=4)
+    p.add_argument("--qps", type=float, default=500.0,
+                   help="target aggregate request rate")
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--deadline-ms", type=float, default=100.0)
+    p.add_argument("--wire-dtype", default="f32", choices=("f32", "bf16"))
+    p.add_argument("--overload", action="store_true",
+                   help="drive QPS past capacity with tight deadlines to "
+                   "exercise the shed path")
+    p.add_argument("--out", default=os.path.join(_REPO, "BENCH_SERVE.json"))
+    p.add_argument("--dry-run", action="store_true",
+                   help="seconds-on-CPU smoke: tiny table, short run")
+    args = p.parse_args()
+
+    if args.dry_run:
+        args.rows, args.cols = 2000, 16
+        args.threads, args.qps, args.duration = 2, 300.0, 1.5
+        args.deadline_ms = 200.0
+
+    from multiverso_tpu.serving import (ServingClient, ServingService,
+                                        ShedError, SparseLookupRunner)
+    from multiverso_tpu.core.table import ServerStore
+    from multiverso_tpu.core.updater import get_updater
+    from multiverso_tpu.telemetry import get_registry
+    from multiverso_tpu.utils.configure import set_flag
+    import jax
+    from jax.sharding import Mesh
+
+    set_flag("serve_wire_dtype", args.wire_dtype)
+    if args.overload:
+        args.qps *= 20.0
+        args.deadline_ms = min(args.deadline_ms, 20.0)
+
+    rng = np.random.default_rng(0)
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("server",))
+    store = ServerStore(
+        "serve_bench", (args.rows, args.cols), np.float32,
+        get_updater(np.float32, "default"), mesh, num_workers=1,
+        init_array=rng.normal(size=(args.rows, args.cols))
+        .astype(np.float32))
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+
+    service = ServingService()
+    service.register_runner(SparseLookupRunner(store), buckets=buckets,
+                            max_batch=args.max_batch,
+                            max_wait_ms=args.max_wait_ms,
+                            max_queue=args.admission)
+
+    # Warm the per-bucket executables so compile time doesn't pollute the
+    # measured window.
+    warm = ServingClient(*service.address)
+    warm.lookup(rng.integers(0, args.rows, args.keys_per_req)
+                .astype(np.int32), deadline_ms=10_000, timeout=120)
+    warm.close()
+
+    latencies: list = []
+    sheds = [0]
+    sent = [0]
+    lat_lock = threading.Lock()
+    stop_at = [0.0]
+    interval = args.threads / max(args.qps, 1e-6)
+
+    def client_loop(seed: int) -> None:
+        cli = ServingClient(*service.address)
+        r = np.random.default_rng(seed)
+        try:
+            while time.monotonic() < stop_at[0]:
+                keys = r.integers(0, args.rows, args.keys_per_req) \
+                    .astype(np.int32)
+                t0 = time.monotonic()
+                try:
+                    cli.lookup(keys, deadline_ms=args.deadline_ms,
+                               timeout=30)
+                    dt = time.monotonic() - t0
+                    with lat_lock:
+                        latencies.append(dt * 1e3)
+                except ShedError:
+                    with lat_lock:
+                        sheds[0] += 1
+                except OSError:
+                    break
+                with lat_lock:
+                    sent[0] += 1
+                # closed-loop pacing: sleep out the remainder of this
+                # request's slot (a slow reply means no sleep — the
+                # thread is already behind its rate)
+                slack = interval - (time.monotonic() - t0)
+                if slack > 0:
+                    time.sleep(slack)
+        finally:
+            cli.close()
+
+    t_start = time.monotonic()
+    stop_at[0] = t_start + args.duration
+    threads = [threading.Thread(target=client_loop, args=(i,), daemon=True)
+               for i in range(args.threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.duration + 60)
+    elapsed = time.monotonic() - t_start
+    service.close()
+
+    lat = np.asarray(latencies, dtype=np.float64)
+    n_ok = int(lat.size)
+    n_shed = int(sheds[0])
+    total = n_ok + n_shed
+    snap = get_registry().snapshot(buckets=False)
+    record = {
+        "schema": "multiverso_tpu.bench_serve/v1",
+        "time_unix": time.time(),
+        "config": {k: (v if not isinstance(v, tuple) else list(v))
+                   for k, v in vars(args).items()},
+        "offered_qps": args.qps,
+        "achieved_qps": n_ok / elapsed if elapsed > 0 else 0.0,
+        "latency_ms": {
+            "p50": float(np.percentile(lat, 50)) if n_ok else 0.0,
+            "p95": float(np.percentile(lat, 95)) if n_ok else 0.0,
+            "p99": float(np.percentile(lat, 99)) if n_ok else 0.0,
+            "mean": float(lat.mean()) if n_ok else 0.0,
+            "max": float(lat.max()) if n_ok else 0.0,
+        },
+        "n_ok": n_ok,
+        "n_shed": n_shed,
+        "shed_rate": n_shed / total if total else 0.0,
+        "serve_metrics": {
+            "counters": {k: v for k, v in snap["counters"].items()
+                         if k.startswith("serve.")},
+            "gauges": {k: v for k, v in snap["gauges"].items()
+                       if k.startswith("serve.")},
+            "histograms": {k: v for k, v in snap["histograms"].items()
+                           if k.startswith("serve.")},
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({
+        "benchmark": "serve_lookup",
+        "offered_qps": record["offered_qps"],
+        "achieved_qps": round(record["achieved_qps"], 1),
+        "p50_ms": round(record["latency_ms"]["p50"], 3),
+        "p95_ms": round(record["latency_ms"]["p95"], 3),
+        "p99_ms": round(record["latency_ms"]["p99"], 3),
+        "shed_rate": round(record["shed_rate"], 4),
+        "out": args.out,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

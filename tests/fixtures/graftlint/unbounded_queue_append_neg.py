@@ -1,0 +1,56 @@
+"""Fixture: the idiomatic bounded counterparts — construction-time bounds,
+length-checked shed paths, drain calls, and batch loops bounded by len()."""
+import collections
+import queue
+
+
+class Bounded:
+    MAX = 64
+
+    def __init__(self, depth):
+        self._pending = collections.deque()
+        self._ring = collections.deque(maxlen=128)      # bounded ctor
+        self._ring2 = collections.deque([], 256)        # positional maxlen
+        self._q = queue.Queue(maxsize=64)               # bounded ctor
+        self._sized = queue.Queue(64)                   # positional bound
+        self._dyn = queue.Queue(maxsize=depth)          # owner-chosen bound
+
+    def reader(self, sock):
+        while True:
+            item = sock.recv()
+            if item is None:
+                break
+            if len(self._pending) >= self.MAX:          # shed path
+                self._pending.popleft()
+            self._pending.append(item)
+            self._ring.append(item)
+            self._ring2.append(item)
+            self._q.put(item)
+            self._sized.put_nowait(item)
+            self._dyn.put(item)
+
+    def drainer(self, sock):
+        while True:
+            self._pending.append(sock.recv())
+            self.flush()
+
+    def flush(self):
+        while self._pending:
+            self._pending.popleft()                     # drain evidence
+
+
+def local_batch(sock):
+    out = []
+    while len(out) < 16:                                # len-bounded loop
+        out.append(sock.recv())
+    return out
+
+
+def unknown_origin(entry, sock):
+    # container from a tuple unpack: origin invisible, not flagged
+    _, slot = entry
+    while True:
+        msg = sock.recv()
+        if msg is None:
+            break
+        slot.append(msg)

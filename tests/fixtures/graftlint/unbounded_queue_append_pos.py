@@ -1,0 +1,58 @@
+"""Fixture: reader/dispatcher loops growing queues with no bound or shed
+path — the slow-consumer OOM the serving admission bound exists to kill."""
+import collections
+import queue
+
+
+class Service:
+    def __init__(self):
+        self._pending = collections.deque()
+        self._inbox = queue.Queue()
+
+    def reader_loop(self, sock):
+        while True:
+            item = sock.recv()
+            if item is None:
+                break
+            self._pending.append(item)  # expect: unbounded-queue-append
+
+    def pump(self, sock):
+        while True:
+            self._inbox.put(sock.recv())  # expect: unbounded-queue-append
+
+
+def drain_forever(sock):
+    backlog = []
+    while True:
+        msg = sock.recv()
+        if msg is None:
+            break
+        backlog.append(msg)  # expect: unbounded-queue-append
+    return backlog
+
+
+class Annotated:
+    """Typed construction (AnnAssign) must not hide the container."""
+
+    def __init__(self):
+        self._typed: "collections.deque" = collections.deque()
+
+    def reader(self, sock):
+        while True:
+            self._typed.append(sock.recv())  # expect: unbounded-queue-append
+
+
+class InfiniteBounds:
+    """Queue(0)/maxsize=0/maxlen=None mean INFINITE in their own
+    semantics — a zero 'bound' is no bound."""
+
+    def __init__(self):
+        self._q = queue.Queue(0)
+        self._q2 = queue.Queue(maxsize=0)
+        self._ring = collections.deque([], None)
+
+    def pump(self, sock):
+        while True:
+            self._q.put(sock.recv())  # expect: unbounded-queue-append
+            self._q2.put(sock.recv())  # expect: unbounded-queue-append
+            self._ring.append(sock.recv())  # expect: unbounded-queue-append

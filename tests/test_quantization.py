@@ -27,6 +27,57 @@ def test_sparse_filter_passes_dense():
                                v)
 
 
+def test_sparse_filter_zero_length_round_trip():
+    """Zero-length buffers must round-trip through every path: raw by
+    definition (no tie-break reliance in the >50% rule), and the
+    compressed decode path must tolerate empty/None indices without the
+    ``out[None] = payload`` broadcast-corruption footgun."""
+    for clip in (0.0, 0.5):
+        f = SparseFilter(clip=clip)
+        for empty in (np.zeros(0, np.float32), np.zeros((0, 4), np.float32),
+                      np.zeros((3, 0), np.float32)):
+            compressed, payload, idx = f.filter_in(empty)
+            assert not compressed and idx is None
+            out = f.filter_out(compressed, payload, idx, 0)
+            assert out.shape == (0,) and out.dtype == np.float32
+    # compressed decode with an all-clipped (empty) payload: exact zeros,
+    # never a broadcast over the whole buffer
+    f = SparseFilter(clip=0.5)
+    compressed, payload, idx = f.filter_in(np.zeros(6, np.float32))
+    assert compressed and len(payload) == 0
+    np.testing.assert_array_equal(
+        f.filter_out(True, payload, idx, 6), np.zeros(6, np.float32))
+    np.testing.assert_array_equal(
+        f.filter_out(True, np.zeros(0, np.float32), None, 4),
+        np.zeros(4, np.float32))
+
+
+def test_zero_length_wire_payload_round_trip():
+    """The PS wire codec and the serving codec both carry empty payloads
+    (empty shard reply, zero-row lookup) without dtype/shape loss."""
+    from multiverso_tpu.parallel.net import (pack_serve_payload,
+                                             unpack_serve_payload)
+    from multiverso_tpu.parallel.ps_service import (pack_payload,
+                                                    unpack_payload)
+
+    for shape in ((0,), (0, 16), (4, 0)):
+        empty = np.zeros(shape, np.float32)
+        for mode in ("none", "sparse", "bf16"):
+            out = unpack_payload(pack_payload(empty, mode))
+            assert out.shape == shape and out.dtype == np.float32
+        for wire in ("f32", "bf16"):
+            out = unpack_serve_payload(pack_serve_payload(empty, wire))
+            assert out.shape == shape and out.dtype == np.float32
+
+
+def test_bf16_wire_zero_length():
+    from multiverso_tpu.utils.quantization import (bf16_bits_to_f32,
+                                                   f32_to_bf16_bits)
+    bits = f32_to_bf16_bits(np.zeros(0, np.float32))
+    assert bits.shape == (0,) and bits.dtype == np.uint16
+    assert bf16_bits_to_f32(bits).shape == (0,)
+
+
 def test_one_bit_error_feedback_converges():
     """With error feedback, the running sum of decoded values tracks the
     running sum of true values."""

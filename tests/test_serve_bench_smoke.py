@@ -1,0 +1,99 @@
+"""Tier-1 smokes for the serving tooling surface.
+
+``scripts/serve_bench.py --dry-run`` must stay runnable on CPU (the full
+QPS numbers only mean something on a quiet box, but the harness itself —
+service bring-up, pacing loop, percentile record — must not bit-rot), and
+the ``serve_main`` CLI must stand up a replica-backed service end to end
+from a checkpoint directory."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BENCH = os.path.join(_REPO, "scripts", "serve_bench.py")
+
+
+def test_serve_bench_dry_run_cpu(tmp_path):
+    out = tmp_path / "BENCH_SERVE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--dry-run", f"--out={out}"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["benchmark"] == "serve_lookup"
+    record = json.loads(out.read_text())
+    assert record["schema"] == "multiverso_tpu.bench_serve/v1"
+    lat = record["latency_ms"]
+    assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
+    assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+    assert record["n_ok"] > 0
+    assert 0.0 <= record["shed_rate"] <= 1.0
+    assert record["achieved_qps"] > 0
+    # the serve.* metric family rides along with the record
+    assert any(k.startswith("serve.latency.")
+               for k in record["serve_metrics"]["histograms"])
+    assert "serve.queue_depth" in record["serve_metrics"]["gauges"]
+
+
+def test_serve_main_cli_end_to_end(tmp_path):
+    """serve_main: checkpoint dir in, bound address out, lookups served
+    from the frozen replica — the full handoff through the real CLI."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ckpt_dir = tmp_path / "ckpts"
+    # Write a checkpoint with a driver process (the CLI reads, not shares,
+    # the runtime).
+    prep = subprocess.run(
+        [sys.executable, "-c", f"""
+import numpy as np
+import multiverso_tpu as mv
+from multiverso_tpu.core.checkpoint import save_all
+mv.init([])
+t = mv.create_table(mv.MatrixTableOption(num_row=32, num_col=4,
+                                         name="served"))
+t.add_rows(np.arange(32, dtype=np.int32),
+           np.arange(128, dtype=np.float32).reshape(32, 4))
+save_all({str(ckpt_dir)!r}, step=3)
+mv.shutdown()
+"""],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert prep.returncode == 0, prep.stdout + prep.stderr
+
+    addr_file = tmp_path / "addr"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "multiverso_tpu.apps.serve_main",
+         f"-checkpoint_dir={ckpt_dir}", "-serve_table=served",
+         "-serve_buckets=4,8", "-serve_max_wait_ms=1",
+         f"-serve_addr_file={addr_file}", "-serve_duration=45",
+         "-serve_device=cpu"],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 120
+        while not addr_file.exists():
+            assert proc.poll() is None, proc.communicate()[0][-3000:]
+            assert time.time() < deadline, "serve_main never bound"
+            time.sleep(0.1)
+        host, port = addr_file.read_text().split(":")
+
+        from multiverso_tpu.serving import ServingClient
+        cli = ServingClient(host, int(port))
+        try:
+            q = np.asarray([0, 7, 31], np.int32)
+            got = cli.lookup(q, deadline_ms=10_000, timeout=60)
+            want = np.stack([np.arange(r * 4, r * 4 + 4) for r in q]) \
+                .astype(np.float32)
+            np.testing.assert_array_equal(got, want)
+        finally:
+            cli.close()
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()

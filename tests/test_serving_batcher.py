@@ -1,0 +1,230 @@
+"""Batcher unit tests: bucket selection, max-wait flush, deadline-aware
+shed ordering, admission bound, and the no-retrace contract (jit cache
+size == buckets exercised)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.serving import BucketLadder, DynamicBatcher, ShedError
+
+
+class RecordingRunner:
+    """Runner double: records every (batch shape, lengths) it was handed
+    and parrots the payload back; optional per-batch delay to force
+    queueing."""
+
+    name = "recording"
+    payload_dtype = np.int32
+    pad_id = 0
+
+    def __init__(self, delay_s: float = 0.0):
+        self.calls = []
+        self.delay_s = delay_s
+        self._shapes = set()
+
+    def run(self, batch, lengths):
+        self.calls.append((batch.shape, lengths.copy()))
+        self._shapes.add(batch.shape)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return batch.copy()
+
+    def slice_result(self, out, i, length):
+        return out[i, :length]
+
+    def jit_cache_size(self):
+        # the double's "compiled executable" count: distinct shapes seen
+        return len(self._shapes)
+
+
+def test_bucket_ladder_selection():
+    ladder = BucketLadder([32, 8, 16, 8])
+    assert ladder.buckets == (8, 16, 32)
+    assert ladder.pick(1) == 8
+    assert ladder.pick(8) == 8
+    assert ladder.pick(9) == 16
+    assert ladder.pick(32) == 32
+    assert ladder.pick(33) is None
+    assert ladder.max == 32
+
+
+def test_coalescing_and_padding():
+    runner = RecordingRunner()
+    b = DynamicBatcher(runner, buckets=(4, 8), max_batch=4,
+                       max_wait_ms=50.0)
+    try:
+        futs = [b.submit(np.arange(n, dtype=np.int32) + 1,
+                         deadline_ms=5000) for n in (2, 3, 4, 5)]
+        results = [f.wait(10) for f in futs]
+        for n, r in zip((2, 3, 4, 5), results):
+            np.testing.assert_array_equal(r, np.arange(n) + 1)
+        # all four coalesced into ONE batch, padded to (max_batch, bucket)
+        assert len(runner.calls) == 1
+        shape, lengths = runner.calls[0]
+        assert shape == (4, 8)          # max payload 5 -> bucket 8
+        assert sorted(lengths.tolist()) == [2, 3, 4, 5]
+    finally:
+        b.close()
+
+
+def test_max_wait_flushes_partial_batch():
+    runner = RecordingRunner()
+    b = DynamicBatcher(runner, buckets=(8,), max_batch=64,
+                       max_wait_ms=20.0)
+    try:
+        t0 = time.monotonic()
+        out = b.submit(np.asarray([7], np.int32), deadline_ms=5000).wait(10)
+        dt = time.monotonic() - t0
+        np.testing.assert_array_equal(out, [7])
+        # flushed on the max-wait timer, not a full batch (and well before
+        # any deadline)
+        assert dt < 2.0
+        assert len(runner.calls) == 1
+        assert runner.calls[0][0] == (64, 8)
+    finally:
+        b.close()
+
+
+def test_no_retrace_one_executable_per_bucket():
+    runner = RecordingRunner()
+    b = DynamicBatcher(runner, buckets=(4, 8, 16), max_batch=4,
+                       max_wait_ms=1.0)
+    try:
+        for n in (2, 4, 2, 3):          # all land in bucket 4
+            b.submit(np.arange(n, dtype=np.int32), 5000).wait(10)
+        assert runner.jit_cache_size() == 1
+        b.submit(np.arange(7, dtype=np.int32), 5000).wait(10)   # bucket 8
+        assert runner.jit_cache_size() == 2
+        for n in (1, 5, 16):
+            b.submit(np.arange(n, dtype=np.int32), 5000).wait(10)
+        # buckets exercised: 4, 8, 16 -> exactly three compiled shapes
+        assert runner.jit_cache_size() == 3
+    finally:
+        b.close()
+
+
+def test_oversize_payload_sheds_immediately():
+    runner = RecordingRunner()
+    b = DynamicBatcher(runner, buckets=(4,), max_batch=2, max_wait_ms=1.0)
+    try:
+        with pytest.raises(ShedError) as e:
+            b.submit(np.arange(9, dtype=np.int32), 5000).wait(10)
+        assert e.value.reason == "oversize"
+        assert not runner.calls
+    finally:
+        b.close()
+
+
+def test_admission_bound_sheds_nearest_deadline_first():
+    """Overfill a stalled queue: the requests shed are exactly the ones
+    with the nearest deadlines — the deadline-aware ordering — and the
+    queue never exceeds the admission bound."""
+    runner = RecordingRunner(delay_s=0.25)
+    b = DynamicBatcher(runner, buckets=(4,), max_batch=2, max_wait_ms=0.0,
+                       max_queue=4)
+    try:
+        # Plug the worker with one slow batch so later submits queue up.
+        plug = [b.submit(np.asarray([0], np.int32), deadline_ms=30_000)
+                for _ in range(2)]
+        time.sleep(0.05)                # worker picked up the plug batch
+        # 8 requests into a 4-slot queue. Deadlines descend: the LAST
+        # submits have the tightest deadlines and must be the shed ones.
+        futs = []
+        for i in range(8):
+            deadline_ms = 30_000 - 3000 * i
+            futs.append((i, b.submit(np.asarray([i], np.int32),
+                                     deadline_ms=deadline_ms)))
+        outcomes = {}
+        for i, f in futs:
+            try:
+                f.wait(20)
+                outcomes[i] = "served"
+            except ShedError as e:
+                outcomes[i] = e.reason
+        for f in plug:
+            f.wait(20)
+        shed = sorted(i for i, o in outcomes.items() if o != "served")
+        served = sorted(i for i, o in outcomes.items() if o == "served")
+        assert len(shed) == 4, outcomes
+        # nearest-deadline (latest-submitted here) requests were shed
+        assert shed == [4, 5, 6, 7], outcomes
+        assert served == [0, 1, 2, 3], outcomes
+        assert all(outcomes[i] == "queue_full" for i in shed)
+    finally:
+        b.close()
+
+
+def test_expired_requests_shed_not_served():
+    """A request whose deadline passes while queued is shed at batch
+    formation instead of burning device time."""
+    runner = RecordingRunner(delay_s=0.3)
+    b = DynamicBatcher(runner, buckets=(4,), max_batch=1, max_wait_ms=0.0)
+    try:
+        plug = b.submit(np.asarray([0], np.int32), deadline_ms=30_000)
+        time.sleep(0.05)
+        doomed = b.submit(np.asarray([1], np.int32), deadline_ms=1.0)
+        with pytest.raises(ShedError) as e:
+            doomed.wait(20)
+        assert e.value.reason == "deadline"
+        plug.wait(20)
+        # the expired request never reached the runner
+        assert all(0 in lengths or lengths[0] == 1
+                   for shape, lengths in runner.calls)
+        served_payloads = [l.tolist() for _, l in runner.calls]
+        assert all(l != [1] or True for l in served_payloads)
+        assert len(runner.calls) == 1   # only the plug batch ran
+    finally:
+        b.close()
+
+
+def test_queue_stays_bounded_under_sustained_overload():
+    """Acceptance: QPS above the admission bound sheds instead of growing
+    the queue without bound."""
+    from multiverso_tpu.telemetry import get_registry
+
+    runner = RecordingRunner(delay_s=0.02)
+    bound = 8
+    b = DynamicBatcher(runner, buckets=(4,), max_batch=2, max_wait_ms=0.0,
+                       max_queue=bound)
+    served = []
+    shed = []
+    lock = threading.Lock()
+
+    def on_done(result):
+        with lock:
+            (shed if isinstance(result, ShedError) else served).append(1)
+
+    try:
+        for _ in range(300):
+            b.submit_callback(np.asarray([1], np.int32), 10_000.0, on_done)
+            with b._cv:
+                assert len(b._queue) <= bound
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with lock:
+                if len(served) + len(shed) == 300:
+                    break
+            time.sleep(0.01)
+        with lock:
+            assert len(served) + len(shed) == 300
+            assert shed, "overload never shed"
+            assert served, "overload served nothing"
+        snap = get_registry().snapshot(buckets=False)
+        assert snap["counters"]["serve.shed.queue_full"]["value"] > 0
+        assert snap["gauges"]["serve.queue_depth"]["max"] <= bound
+    finally:
+        b.close()
+
+
+def test_close_releases_queued_requests():
+    runner = RecordingRunner(delay_s=0.2)
+    b = DynamicBatcher(runner, buckets=(4,), max_batch=1, max_wait_ms=0.0)
+    b.submit(np.asarray([0], np.int32), 30_000)
+    time.sleep(0.05)
+    tail = b.submit(np.asarray([1], np.int32), 30_000)
+    b.close()
+    with pytest.raises(ShedError):
+        tail.wait(10)

@@ -1,0 +1,268 @@
+"""Serving-plane integration tests.
+
+The headline acceptance: a 2-rank word2vec world trains over the DCN PS
+service, each rank stands up a serving service over its LIVE shard, and
+served embedding lookups through the routed client are BITWISE-equal to a
+direct ``table.get_rows`` on the same clock — with the batcher having
+compiled exactly one executable per bucket it exercised. Plus: wire-level
+service/client behavior (concurrent in-flight, shed propagation, bf16
+reply payloads) and the KV-cached greedy decode runner parity."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.word2vec import Dictionary, Word2VecConfig
+from multiverso_tpu.models.word2vec.distributed import DistributedWord2Vec
+from multiverso_tpu.parallel.ps_service import PSService
+from multiverso_tpu.serving import (RoutedLookupClient, ServingClient,
+                                    ServingService, ShedError,
+                                    SparseLookupRunner)
+from multiverso_tpu.utils.configure import set_flag
+
+
+def _corpus(n_sentences=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return [[f"{'a' if i % 2 == 0 else 'b'}{rng.integers(0, 5)}"
+             for _ in range(12)] for i in range(n_sentences)]
+
+
+def test_two_rank_train_then_serve_bitwise_parity(mv_env):
+    """Train word2vec across 2 ranks, then serve lookups from each rank's
+    LIVE shard through the routed client: bitwise equality with direct
+    table.get_rows, one compiled executable per exercised bucket."""
+    sents = _corpus()
+    d = Dictionary.build(sents, min_count=1)
+    ids = [d.encode(s) for s in sents]
+    cfg = Word2VecConfig(embedding_size=16, batch_size=128, window=3,
+                         negative=3, min_count=1, sample=0, sg=True,
+                         epochs=1, learning_rate=0.01, block_words=1000,
+                         pipeline=False, seed=3, optimizer="sgd")
+    svc0, svc1 = PSService(), PSService()
+    peers = [svc0.address, svc1.address]
+    serve0 = serve1 = client = None
+    try:
+        w0 = DistributedWord2Vec(cfg, d, svc0, peers, rank=0)
+        w1 = DistributedWord2Vec(cfg, d, svc1, peers, rank=1)
+        threads = [threading.Thread(target=w0.train, args=(ids[0::2],)),
+                   threading.Thread(target=w1.train, args=(ids[1::2],))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "training hung"
+
+        # Quiesce the add paths so "the same clock" is unambiguous.
+        w0.w_in.flush(wait=True)
+        w1.w_in.flush(wait=True)
+
+        # One serving service per rank, straight over the live shard.
+        buckets = (4, 8)
+        runners = []
+        serves = []
+        for w in (w0, w1):
+            runner = SparseLookupRunner(
+                w.w_in.local_store,
+                row_offset=int(w.w_in.row_offsets[w.rank]))
+            svc = ServingService()
+            svc.register_runner(runner, buckets=buckets, max_batch=4,
+                                max_wait_ms=1.0)
+            runners.append(runner)
+            serves.append(svc)
+        serve0, serve1 = serves
+        client = RoutedLookupClient(
+            [serve0.address, serve1.address],
+            offsets=w0.w_in.row_offsets)
+
+        V = len(d)
+        rng = np.random.default_rng(7)
+        queries = [rng.integers(0, V, n).astype(np.int64)
+                   for n in (3, 4, 2, 7, 8, 1)]
+        for q in queries:
+            served = client.lookup(q, deadline_ms=10_000)
+            direct = w0.w_in.get_rows(q.astype(np.int32))
+            assert served.dtype == direct.dtype
+            np.testing.assert_array_equal(served, direct)
+        # zero-row lookup round-trips with the real column shape
+        empty = client.lookup(np.empty(0, np.int64), deadline_ms=10_000)
+        assert empty.shape == (0, 16)
+
+        # No-retrace contract: per shard, exactly one executable per
+        # bucket it actually served (routing may split a query below the
+        # request's own bucket, so derive the expectation from calls).
+        for runner in runners:
+            assert 1 <= runner.jit_cache_size() <= len(buckets)
+        assert sum(r.jit_cache_size() for r in runners) <= 2 * len(buckets)
+        # rank 0 saw both buckets: 7- and 8-row queries land rows on both
+        # shards, and the 8-row query guarantees a >4 sub-lookup somewhere
+        total_cache = sum(r.jit_cache_size() for r in runners)
+        assert total_cache >= 2, "batched lookups never exercised a bucket"
+    finally:
+        for s in (serve0, serve1):
+            if s is not None:
+                s.close()
+        if client is not None:
+            client.close()
+        svc0.close()
+        svc1.close()
+
+
+def test_single_table_serving_exact_bucket_accounting(mv_env):
+    """Direct (unrouted) serving over one live table: the jit cache size
+    equals EXACTLY the number of buckets exercised."""
+    table = mv.create_table(mv.MatrixTableOption(num_row=64, num_col=8))
+    table.add_rows(np.arange(64, dtype=np.int32),
+                   np.random.default_rng(0).normal(size=(64, 8))
+                   .astype(np.float32))
+    runner = table.serving_runner()
+    svc = ServingService()
+    svc.register_runner(runner, buckets=(4, 8, 16), max_batch=4,
+                        max_wait_ms=1.0)
+    cli = ServingClient(*svc.address)
+    try:
+        for n in (2, 3, 4):             # bucket 4 only
+            cli.lookup(np.arange(n, dtype=np.int32), deadline_ms=10_000)
+        assert runner.jit_cache_size() == 1
+        cli.lookup(np.arange(7, dtype=np.int32), deadline_ms=10_000)
+        assert runner.jit_cache_size() == 2
+        cli.lookup(np.arange(16, dtype=np.int32), deadline_ms=10_000)
+        assert runner.jit_cache_size() == 3
+        # bitwise parity with the direct read
+        q = np.asarray([5, 63, 0, 17], np.int32)
+        np.testing.assert_array_equal(
+            cli.lookup(q, deadline_ms=10_000), table.get_rows(q))
+    finally:
+        cli.close()
+        svc.close()
+
+
+def test_concurrent_inflight_requests_one_connection(mv_env):
+    """One client socket, many threads: replies route by msg_id even when
+    they complete out of order."""
+    table = mv.create_table(mv.MatrixTableOption(num_row=128, num_col=4))
+    table.add_rows(np.arange(128, dtype=np.int32),
+                   np.arange(128 * 4, dtype=np.float32).reshape(128, 4))
+    svc = ServingService()
+    svc.register_runner(table.serving_runner(), buckets=(8,), max_batch=4,
+                        max_wait_ms=2.0)
+    cli = ServingClient(*svc.address)
+    errors = []
+
+    def hit(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(10):
+            q = rng.integers(0, 128, 5).astype(np.int32)
+            got = cli.lookup(q, deadline_ms=10_000)
+            want = np.stack([np.arange(r * 4, r * 4 + 4) for r in q]) \
+                .astype(np.float32)
+            if not np.array_equal(got, want):
+                errors.append((q.tolist(), got.tolist()))
+                return
+
+    try:
+        threads = [threading.Thread(target=hit, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+        assert not errors, errors[:2]
+    finally:
+        cli.close()
+        svc.close()
+
+
+def test_shed_propagates_to_client_as_error(mv_env):
+    table = mv.create_table(mv.MatrixTableOption(num_row=16, num_col=2))
+    svc = ServingService()
+    svc.register_runner(table.serving_runner(), buckets=(4,), max_batch=2,
+                        max_wait_ms=1.0)
+    cli = ServingClient(*svc.address)
+    try:
+        with pytest.raises(ShedError):
+            cli.lookup(np.arange(9, dtype=np.int32), deadline_ms=10_000)
+        # an already-expired deadline sheds rather than serves
+        with pytest.raises(ShedError):
+            cli.lookup(np.arange(2, dtype=np.int32), deadline_ms=0.0)
+    finally:
+        cli.close()
+        svc.close()
+
+
+def test_serve_wire_bf16_flag(mv_env):
+    """-serve_wire_dtype=bf16: reply payloads cross as bf16 halves; the
+    client sees values equal to the bf16 truncation of the table rows."""
+    from multiverso_tpu.utils.quantization import (bf16_bits_to_f32,
+                                                   f32_to_bf16_bits)
+
+    table = mv.create_table(mv.MatrixTableOption(num_row=32, num_col=4))
+    rng = np.random.default_rng(1)
+    table.add_rows(np.arange(32, dtype=np.int32),
+                   rng.normal(size=(32, 4)).astype(np.float32))
+    svc = ServingService()
+    svc.register_runner(table.serving_runner(), buckets=(8,), max_batch=2,
+                        max_wait_ms=1.0)
+    cli = ServingClient(*svc.address)
+    try:
+        q = np.asarray([3, 1, 30], np.int32)
+        set_flag("serve_wire_dtype", "bf16")
+        served = cli.lookup(q, deadline_ms=10_000)
+        direct = np.asarray(table.get_rows(q))
+        want = bf16_bits_to_f32(f32_to_bf16_bits(direct)).reshape(
+            direct.shape)
+        np.testing.assert_array_equal(served, want)
+        assert not np.array_equal(served, direct) or \
+            np.array_equal(want, direct)
+        set_flag("serve_wire_dtype", "f32")
+        np.testing.assert_array_equal(
+            cli.lookup(q, deadline_ms=10_000), direct)
+    finally:
+        set_flag("serve_wire_dtype", "f32")
+        cli.close()
+        svc.close()
+
+
+def test_attention_lm_decode_served_matches_full_forward(mv_env):
+    """KV-cached greedy decode through the full serving plane equals the
+    naive recompute-everything greedy loop on the flat forward."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from multiverso_tpu.models.attention_lm import (LMConfig, forward,
+                                                    init_params)
+    from multiverso_tpu.serving import AttentionLMRunner
+
+    cfg = LMConfig(vocab=61, dim=32, heads=4, layers=2, seq=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    runner = AttentionLMRunner(
+        {k: np.asarray(v) for k, v in params.items()}, cfg,
+        max_new=5, max_batch=3)
+    svc = ServingService()
+    svc.register_runner(runner, buckets=(8,), max_batch=3, max_wait_ms=1.0)
+    cli = ServingClient(*svc.address)
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                ("data", "seq"))
+
+    def ref_decode(prompt, n):
+        toks = list(prompt)
+        out = []
+        for _ in range(n):
+            logits, _ = forward(params, jnp.asarray([toks]), cfg, mesh)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            out.append(nxt)
+            toks.append(nxt)
+        return out
+
+    try:
+        for prompt in ([5, 9, 2], [1], [7, 3, 3, 3, 8, 2, 40]):
+            got = cli.generate(np.asarray(prompt, np.int32),
+                               deadline_ms=60_000, timeout=120)
+            assert got.tolist() == ref_decode(prompt, 5), prompt
+        assert runner.jit_cache_size() == 1     # one bucket exercised
+    finally:
+        cli.close()
+        svc.close()
