@@ -441,8 +441,9 @@ class UnboundedQueueAppend(Rule):
         "into an OOM. The serving plane's whole admission story is that "
         "every queue sheds instead of growing; this rule keeps new code "
         "on that contract. Scoped to the request planes "
-        "(multiverso_tpu/serving/ + parallel/ps_service) where unbounded "
-        "growth is reachable from the network.")
+        "(multiverso_tpu/serving/ + multiverso_tpu/fleet/ + "
+        "parallel/ps_service) where unbounded growth is reachable from "
+        "the network.")
 
     _GROWERS = {"append", "appendleft", "put", "put_nowait"}
     _DRAINERS = {"popleft", "pop", "get", "get_nowait", "clear",
@@ -452,8 +453,8 @@ class UnboundedQueueAppend(Rule):
         "list", "collections.deque", "queue.Queue", "queue.LifoQueue",
         "queue.PriorityQueue", "queue.SimpleQueue",
     }
-    _SCOPED = ("multiverso_tpu/serving/", "multiverso_tpu/parallel/"
-               "ps_service")
+    _SCOPED = ("multiverso_tpu/serving/", "multiverso_tpu/fleet/",
+               "multiverso_tpu/parallel/ps_service")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         if ctx.role == "script":

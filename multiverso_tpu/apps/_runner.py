@@ -77,6 +77,57 @@ def serve_config() -> dict:
     }
 
 
+def fleet_config() -> dict:
+    """Resolve the ``-fleet_*`` flags into router/member/client kwargs
+    (one parse, like :func:`serve_config` — README documents the table)."""
+    from multiverso_tpu.utils.configure import get_flag
+    from multiverso_tpu.utils.log import FatalError
+
+    hedge: object = str(get_flag("fleet_hedge"))
+    if hedge not in ("adaptive", "off"):
+        try:
+            hedge = float(hedge)
+        except ValueError:
+            raise FatalError(f"bad -fleet_hedge value '{hedge}' "
+                             "(want adaptive|off|<ms>)") from None
+    router_raw = str(get_flag("fleet_router"))
+    router = None
+    if router_raw:
+        try:
+            host, port = router_raw.rsplit(":", 1)
+            router = (host, int(port))
+        except ValueError:
+            raise FatalError(f"bad -fleet_router value '{router_raw}' "
+                             "(want host:port)") from None
+    synthetic_raw = str(get_flag("fleet_synthetic"))
+    synthetic = None
+    if synthetic_raw:
+        try:
+            dims, seed = synthetic_raw.split("@") \
+                if "@" in synthetic_raw else (synthetic_raw, "0")
+            rows, cols = dims.lower().split("x")
+            synthetic = (int(rows), int(cols), int(seed))
+        except ValueError:
+            raise FatalError(f"bad -fleet_synthetic value "
+                             f"'{synthetic_raw}' (want ROWSxCOLS@SEED)") \
+                from None
+    return {
+        "role": str(get_flag("fleet_role")),
+        "router": router,
+        "port": int(get_flag("fleet_port")),
+        "replicas": int(get_flag("fleet_replicas")),
+        "vnodes": int(get_flag("fleet_vnodes")),
+        "heartbeat_ms": float(get_flag("fleet_heartbeat_ms")),
+        "liveness_misses": int(get_flag("fleet_liveness_misses")),
+        "hedge": hedge,
+        "member_id": str(get_flag("fleet_member_id")),
+        "addr_file": str(get_flag("fleet_addr_file")),
+        "synthetic": synthetic,
+        "proxy": bool(get_flag("fleet_proxy")),
+        "drain_timeout_s": float(get_flag("fleet_drain_timeout_s")),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Distributed-launch helpers shared by the app CLIs (-world_size=N): the
 # single-host `mpirun -np N` analog of the reference's deployment

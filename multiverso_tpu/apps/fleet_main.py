@@ -1,0 +1,289 @@
+"""Fleet CLI: stand up a multi-replica serving fabric.
+
+Three roles (``-fleet_role``):
+
+* ``router``  — the membership/routing front end (``FleetRouter``).
+  Writes its bound control address to ``-fleet_addr_file``; with
+  ``-fleet_proxy`` (default) it also answers plain ``Serve_Request``
+  traffic by proxying into the fleet.
+* ``replica`` — one serving process: loads a checkpoint replica
+  (``-checkpoint_dir``, hot-swap on drain) or a seeded synthetic table
+  (``-fleet_synthetic=ROWSxCOLS@SEED`` — benches/smokes), warms every
+  bucket executable, then joins the router and heartbeats.
+* ``local``   — dev/bench topology in one command: an in-process router
+  plus ``-fleet_replicas`` spawned replica processes (each pinned to CPU
+  unless ``-serve_device=default`` — N local replicas must not fight
+  over one chip).
+
+* ``drain``   — operator command against a RUNNING fleet: sends
+  ``Fleet_Drain`` to the router and waits for the rolling cycle (each
+  replica in turn finishes in-flight batches, hot-swaps to the newest
+  checkpoint, re-warms, rejoins; the ring never loses more than one
+  member and no request is dropped).
+
+    python -m multiverso_tpu.apps.fleet_main -fleet_role=local \\
+        -checkpoint_dir=/ckpts -fleet_replicas=3 -serve_duration=600
+    # ...training lands a new checkpoint...
+    python -m multiverso_tpu.apps.fleet_main -fleet_role=drain \\
+        -fleet_router=127.0.0.1:7071
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List
+
+from multiverso_tpu.apps._runner import (fleet_config,
+                                         pin_device_if_requested, run_app,
+                                         serve_config)
+from multiverso_tpu.utils.configure import define_string, get_flag
+from multiverso_tpu.utils.log import check, log
+
+# Shared with serve_main (flag registration is idempotent per type).
+define_string("checkpoint_dir", "", "checkpoint directory to serve from "
+              "(latest complete ckpt_* is loaded; drains hot-swap to it)")
+define_string("serve_table", "", "table name to serve rows from (empty = "
+              "the checkpoint's first table)")
+define_string("serve_device", "default", "default|cpu: cpu pins jax off "
+              "the chip (serving a replica needs no accelerator)")
+
+
+def _write_addr_file(path: str, address) -> None:
+    if not path:
+        return
+    with open(path + ".tmp", "w") as f:
+        f.write(f"{address[0]}:{address[1]}")
+    os.replace(path + ".tmp", path)
+
+
+def _wait_duration() -> None:
+    duration = float(get_flag("serve_duration"))
+    deadline = time.monotonic() + duration if duration > 0 else None
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        log.info("fleet_main: interrupted, shutting down")
+
+
+def _build_synthetic_runner(rows: int, cols: int, seed: int):
+    """Seeded synthetic lookup table: every replica spawned with the same
+    -fleet_synthetic value serves bitwise-identical rows (what the bench
+    parity check and the smoke's get_rows comparison rely on)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from multiverso_tpu.core.table import ServerStore
+    from multiverso_tpu.core.updater import get_updater
+    from multiverso_tpu.serving import SparseLookupRunner
+
+    rng = np.random.default_rng(seed)
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("server",))
+    store = ServerStore(
+        "fleet_synthetic", (rows, cols), np.float32,
+        get_updater(np.float32, "default"), mesh, num_workers=1,
+        init_array=rng.normal(size=(rows, cols)).astype(np.float32))
+    return SparseLookupRunner(store), None
+
+
+def _build_checkpoint_runner(ckpt_dir: str):
+    from multiverso_tpu.serving import CheckpointReplica, ReplicaLookupRunner
+
+    replica = CheckpointReplica(ckpt_dir)
+    snap = replica.snapshot()
+    table = str(get_flag("serve_table")) or snap.names[0]
+    check(table in snap.names,
+          f"-serve_table={table!r} not in checkpoint (has {snap.names})")
+    return ReplicaLookupRunner(replica, table), replica
+
+
+def _replica_body(cfg: dict) -> int:
+    from multiverso_tpu.fleet import FleetMember
+    from multiverso_tpu.serving import ServingService
+
+    check(cfg["router"] is not None,
+          "-fleet_router=host:port is required for the replica role")
+    scfg = serve_config()
+    ckpt_dir = str(get_flag("checkpoint_dir"))
+    if cfg["synthetic"] is not None:
+        runner, replica = _build_synthetic_runner(*cfg["synthetic"])
+    else:
+        check(bool(ckpt_dir), "replica role needs -checkpoint_dir or "
+              "-fleet_synthetic")
+        runner, replica = _build_checkpoint_runner(ckpt_dir)
+
+    service = ServingService(host=scfg["host"], port=scfg["port"])
+    service.register_runner(runner, buckets=scfg["buckets"],
+                            max_batch=scfg["max_batch"],
+                            max_wait_ms=scfg["max_wait_ms"],
+                            max_queue=scfg["max_queue"])
+    # Warm BEFORE joining the ring: the first routed request must never
+    # pay a trace.
+    warmed = service.warmup()
+    swap_fn = replica.refresh if replica is not None else None
+    member = FleetMember(cfg["router"], service,
+                         member_id=cfg["member_id"] or None,
+                         swap_fn=swap_fn,
+                         drain_timeout_s=cfg["drain_timeout_s"]).start()
+    host, port = service.address
+    log.info("fleet replica %s serving at %s:%d (%d executables warm)",
+             member.member_id, host, port, warmed)
+    _write_addr_file(str(get_flag("serve_addr_file")), service.address)
+    try:
+        _wait_duration()
+    finally:
+        member.close()
+        service.close()
+        if replica is not None:
+            replica.close()
+    return 0
+
+
+def _drain_body(cfg: dict) -> int:
+    """Operator command: trigger a rolling drain on a RUNNING fleet and
+    wait for every member's drain cycle to complete (observed through
+    the routing table's monotonic per-member drains_completed)."""
+    from multiverso_tpu.fleet import FleetClient, request_drain
+
+    check(cfg["router"] is not None,
+          "-fleet_router=host:port is required for the drain role")
+    target = cfg["member_id"] or None
+    cli = FleetClient(cfg["router"], hedge="off")
+    try:
+        before = {m["id"]: int(m.get("drains_completed", 0))
+                  for m in cli.routing().members}
+        check(bool(before), "fleet has no members to drain")
+        ack = request_drain(cfg["router"], member_id=target,
+                            timeout_s=cfg["drain_timeout_s"])
+        check(bool(ack.get("started")),
+              f"router refused drain: {ack.get('reason', '?')}")
+        want = [target] if target else sorted(before)
+        log.info("drain started for %s; waiting for cycles", want)
+        deadline = time.monotonic() + \
+            cfg["drain_timeout_s"] * (len(want) + 1)
+        pending = list(want)    # reported if the loop never iterates
+        while time.monotonic() < deadline:
+            table = {m["id"]: m for m in cli.refresh().members}
+            pending = [mid for mid in want
+                       if mid in table
+                       and (int(table[mid].get("drains_completed", 0))
+                            <= before.get(mid, 0)
+                            or table[mid].get("draining"))]
+            if not pending:
+                log.info("drain complete: %s", want)
+                return 0
+            time.sleep(0.1)
+        log.error("drain timed out; still pending: %s", pending)
+        return 1
+    finally:
+        cli.close()
+
+
+def _router_body(cfg: dict) -> int:
+    from multiverso_tpu.fleet import FleetRouter
+
+    router = FleetRouter(host=str(get_flag("serve_host")),
+                         port=cfg["port"], vnodes=cfg["vnodes"],
+                         heartbeat_ms=cfg["heartbeat_ms"],
+                         liveness_misses=cfg["liveness_misses"],
+                         proxy=cfg["proxy"])
+    _write_addr_file(cfg["addr_file"], router.address)
+    try:
+        _wait_duration()
+    finally:
+        router.close()
+    return 0
+
+
+def _spawn_replicas(cfg: dict, router_addr, args: List[str],
+                    count: int) -> List:
+    """Re-exec this module once per replica, pointed at the router. Each
+    child defaults to CPU pinning (N local replicas would otherwise fight
+    for one accelerator)."""
+    import subprocess
+
+    base = [a for a in args
+            if not a.lstrip("-").startswith(("fleet_role=", "fleet_router=",
+                                             "fleet_replicas=",
+                                             "fleet_port=",
+                                             "fleet_addr_file=",
+                                             "serve_addr_file=",
+                                             "serve_port="))]
+    if not any(a.lstrip("-").startswith("serve_device=") for a in base):
+        base.append("-serve_device=cpu")
+    procs = []
+    for r in range(count):
+        cmd = [sys.executable, "-m", "multiverso_tpu.apps.fleet_main",
+               "-fleet_role=replica",
+               f"-fleet_router={router_addr[0]}:{router_addr[1]}",
+               f"-fleet_member_id=replica-{r}", *base]
+        procs.append(subprocess.Popen(cmd))
+    return procs
+
+
+def _local_body(cfg: dict, remaining_args: List[str]) -> int:
+    from multiverso_tpu.fleet import FleetRouter
+
+    router = FleetRouter(host=str(get_flag("serve_host")),
+                         port=cfg["port"], vnodes=cfg["vnodes"],
+                         heartbeat_ms=cfg["heartbeat_ms"],
+                         liveness_misses=cfg["liveness_misses"],
+                         proxy=cfg["proxy"])
+    _write_addr_file(cfg["addr_file"], router.address)
+    procs = _spawn_replicas(cfg, router.address, remaining_args,
+                            cfg["replicas"])
+    try:
+        deadline = time.monotonic() + 120
+        while len(router.group.member_ids()) < cfg["replicas"]:
+            check(time.monotonic() < deadline,
+                  "fleet replicas never joined the router")
+            if any(p.poll() is not None for p in procs):
+                check(False, "a fleet replica exited during bring-up")
+            time.sleep(0.05)
+        log.info("fleet up: %d replicas behind %s:%d",
+                 cfg["replicas"], *router.address)
+        _wait_duration()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except Exception:  # noqa: BLE001 - last resort on shutdown
+                p.kill()
+        router.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    # Serving processes juggle many short GIL slices (conn readers,
+    # batcher, heartbeat); the default 5ms switch interval convoys them
+    # and inflates request p50 toward the switch interval on small hosts.
+    sys.setswitchinterval(5e-4)
+    args = list(argv if argv is not None else sys.argv[1:])
+    pin_device_if_requested(args, "serve_device")
+    raw_args = list(args)
+
+    def _body(remaining: List[str]) -> int:
+        del remaining
+        cfg = fleet_config()
+        role = cfg["role"]
+        if role == "replica":
+            return _replica_body(cfg)
+        if role == "router":
+            return _router_body(cfg)
+        if role == "drain":
+            return _drain_body(cfg)
+        check(role == "local",
+              f"-fleet_role must be local|router|replica|drain, "
+              f"got '{role}'")
+        return _local_body(cfg, raw_args)
+
+    return run_app(_body, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

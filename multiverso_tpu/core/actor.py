@@ -45,6 +45,19 @@ class MsgType(enum.IntEnum):
     Serve_Reply = -21
     Heartbeat = 40
     Heartbeat_Reply = -40
+    # Fleet control plane (multiverso_tpu/fleet): replica-group membership
+    # + routing-table exchange over the same framing. Payloads are the
+    # net.py JSON control codec (low-rate control traffic, not data path).
+    Fleet_Join = 42
+    Reply_Fleet_Join = -42
+    Fleet_Heartbeat = 43
+    Reply_Fleet_Heartbeat = -43
+    Fleet_Route = 44
+    Reply_Fleet_Route = -44
+    Fleet_Leave = 45
+    Reply_Fleet_Leave = -45
+    Fleet_Drain = 46        # operator-initiated rolling drain trigger
+    Reply_Fleet_Drain = -46
     Reply_Error = -99   # server-side rejection (e.g. unknown table); wakes
     Exit = 99           # the waiter loudly instead of hanging a BSP wait
 

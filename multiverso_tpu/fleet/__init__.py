@@ -1,0 +1,41 @@
+"""Fleet layer: a multi-replica serving fabric over the serving plane.
+
+PR 5 built ONE serving process; this package makes N of them a single
+logical service (ROADMAP item 2 — the TensorFlow training/serving split
+taken to its fleet conclusion). Layers:
+
+* ``hashring``   — virtual-node consistent hashing: row -> replica
+  ownership with minimal key movement on membership change;
+* ``health``     — replica health scores computed from the ``serve.*``
+  gauges each replica already exports;
+* ``membership`` — ``ReplicaGroup`` (router-side join/leave/heartbeat
+  authority) + ``FleetMember`` (replica-side agent with the
+  drain -> hot-swap -> re-warm -> rejoin lifecycle);
+* ``hedge``      — adaptive-delay hedged requests (tail-latency
+  mitigation, Dean & Barroso);
+* ``router``     — ``FleetRouter``: the control-plane service, an
+  optional data-plane proxy, and the rolling-drain driver;
+* ``client``     — ``FleetClient``: ring-routed lookups, health-balanced
+  decode, hedging + typed-failover.
+
+See docs/SERVING.md ("Fleet") for topology and tuning, and
+docs/OBSERVABILITY.md for the ``fleet.*`` metric catalog.
+"""
+
+from multiverso_tpu.fleet.client import (FleetClient, RoutingTable,
+                                         request_drain)
+from multiverso_tpu.fleet.hashring import HashRing
+from multiverso_tpu.fleet.health import (STAT_FIELDS, health_score,
+                                         local_stats)
+from multiverso_tpu.fleet.hedge import (AdaptiveDelay, HedgedCall,
+                                        HedgeScheduler)
+from multiverso_tpu.fleet.membership import (FleetMember, MemberInfo,
+                                             ReplicaGroup)
+from multiverso_tpu.fleet.router import FleetRouter
+
+__all__ = [
+    "AdaptiveDelay", "FleetClient", "FleetMember", "FleetRouter",
+    "HashRing", "HedgeScheduler", "HedgedCall", "MemberInfo",
+    "ReplicaGroup", "RoutingTable", "STAT_FIELDS", "health_score",
+    "local_stats", "request_drain",
+]

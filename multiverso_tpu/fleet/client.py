@@ -1,0 +1,471 @@
+"""Fleet client: ring-routed, health-balanced, hedged serving requests.
+
+The smart-client half of the fleet design: the router owns MEMBERSHIP
+(who is alive, who is draining, how loaded everyone is) and ships it as a
+versioned routing table; the client owns the DATA PATH (direct replica
+connections — the router never proxies a hot-path byte unless asked to).
+
+Routing policy:
+
+* **Row lookups** route by ring ownership. ``split=True`` partitions the
+  requested rows by their consistent-hash owner and fans sub-lookups to
+  each owner concurrently (the generalization of
+  ``RoutedLookupClient`` — correct when replicas hold row PARTITIONS).
+  ``split=False`` (default) sends the whole request to the ring owner of
+  the request's combined key hash — the cache-affinity policy for a
+  REPLICATED fleet where any member can answer and sticky routing keeps
+  hot rows hot.
+* **Replica-agnostic requests** (LM decode) go to the healthiest member.
+
+Every dispatch is a :class:`~multiverso_tpu.fleet.hedge.HedgedCall` over
+a preference list of DISTINCT replicas: a reply slower than the adaptive
+p95 threshold triggers a second attempt elsewhere, first reply wins, the
+loser is discarded; a dead replica (typed
+:class:`~multiverso_tpu.serving.client.ReplicaUnavailableError`) fails
+over immediately and is locally suspected until the routing table
+confirms its fate — so a SIGKILLed replica costs at most the requests
+that were in flight on it at kill time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from multiverso_tpu.core.actor import Message, MsgType
+from multiverso_tpu.fleet.hashring import HashRing, _splitmix64
+from multiverso_tpu.fleet.hedge import (AdaptiveDelay, HedgeBudget,
+                                        HedgedCall, HedgeScheduler,
+                                        default_scheduler)
+from multiverso_tpu.parallel.net import (pack_json_blob, recv_message,
+                                         send_message, unpack_json_blob)
+from multiverso_tpu.serving.client import (ReplicaUnavailableError,
+                                           ServingClient,
+                                           connect_with_backoff)
+from multiverso_tpu.telemetry import counter, histogram
+from multiverso_tpu.utils.log import check, log
+
+_SUSPECT_TTL_S = 1.0    # local quarantine until the router confirms death
+
+
+class RoutingTable:
+    """One immutable snapshot of the fleet's routing state. Ranked order
+    is precomputed once — ``ranked()`` sits on the per-request path."""
+
+    __slots__ = ("version", "vnodes", "members", "by_id", "ring",
+                 "_ranked")
+
+    def __init__(self, payload: Dict):
+        self.version = int(payload.get("version", 0))
+        self.vnodes = int(payload.get("vnodes", 64))
+        self.members: List[Dict] = list(payload.get("members", []))
+        self.by_id = {m["id"]: m for m in self.members}
+        routable = sorted(m["id"] for m in self.members
+                          if not m.get("draining")
+                          and m.get("health", 0.0) > 0.0)
+        self.ring = HashRing(routable, vnodes=self.vnodes)
+        live = [m for m in self.members if m["id"] in self.ring.members]
+        live.sort(key=lambda m: (-float(m.get("health", 0.0)), m["id"]))
+        self._ranked = [m["id"] for m in live]
+
+    def ranked(self, exclude: Sequence[str] = ()) -> List[str]:
+        """Member ids by descending health, the routable ones only."""
+        if not exclude:
+            return self._ranked
+        skip = set(exclude)
+        return [m for m in self._ranked if m not in skip]
+
+    def addr(self, member_id: str) -> Tuple[str, int]:
+        m = self.by_id[member_id]
+        return (m["host"], int(m["port"]))
+
+
+class _RouterFeed:
+    """Pulls the routing table from a FleetRouter over ``Fleet_Route``
+    (persistent connection, re-dialed with backoff on loss)."""
+
+    def __init__(self, addr: Tuple[str, int]):
+        self.addr = (str(addr[0]), int(addr[1]))
+        self._sock = None
+        self._msg_id = 0
+        self._lock = threading.Lock()
+        self._reconnected = False
+
+    def consume_reconnected(self) -> bool:
+        """True once after each re-dial: a restarted router's version
+        counter restarts too, so the consumer must accept the next table
+        even if its version regressed."""
+        with self._lock:
+            fresh, self._reconnected = self._reconnected, False
+            return fresh
+
+    def fetch(self) -> Dict:
+        with self._lock:
+            if self._sock is None:
+                self._sock = connect_with_backoff(*self.addr, attempts=4)
+                self._reconnected = True
+            try:
+                self._msg_id += 1
+                send_message(self._sock, Message(
+                    type=MsgType.Fleet_Route, msg_id=self._msg_id,
+                    data=[pack_json_blob({})]))
+                reply = recv_message(self._sock)
+            except (IOError, OSError):
+                self._close_locked()
+                raise
+            if reply is None or not reply.data:
+                self._close_locked()
+                raise OSError("fleet router closed the routing feed")
+            return unpack_json_blob(reply.data[0])
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+
+class _GroupFeed:
+    """In-process routing feed straight off a ReplicaGroup (the router's
+    own data plane, and tests, skip the TCP hop)."""
+
+    def __init__(self, group):
+        self.group = group
+
+    def fetch(self) -> Dict:
+        return self.group.routing_payload()
+
+    def close(self) -> None:
+        pass
+
+
+def request_drain(router: Tuple[str, int],
+                  member_id: Optional[str] = None,
+                  timeout_s: float = 60.0) -> Dict:
+    """Operator-side drain trigger: ask the router (over ``Fleet_Drain``)
+    to drain one member, or rolling-drain the whole fleet when
+    ``member_id`` is None. Returns the router's ack; poll the routing
+    table (``Fleet_Route`` / :meth:`FleetClient.routing`) for per-member
+    ``drains_completed`` to observe completion."""
+    sock = connect_with_backoff(*router, attempts=4)
+    try:
+        payload: Dict = {"timeout_s": float(timeout_s)}
+        if member_id is not None:
+            payload["id"] = str(member_id)
+        send_message(sock, Message(type=MsgType.Fleet_Drain, msg_id=1,
+                                   data=[pack_json_blob(payload)]))
+        reply = recv_message(sock)
+        if reply is None or not reply.data:
+            raise OSError("fleet router closed the drain channel")
+        if reply.type == MsgType.Reply_Error:
+            raise OSError("fleet router rejected drain: "
+                          + reply.data[0].tobytes().decode())
+        return unpack_json_blob(reply.data[0])
+    finally:
+        sock.close()
+
+
+class FleetClient:
+    """Routed + hedged client over a replica fleet.
+
+    ``router`` is either a ``(host, port)`` of a FleetRouter's control
+    listener or a :class:`~multiverso_tpu.fleet.membership.ReplicaGroup`
+    for in-process use. ``hedge`` is ``"adaptive"`` (p95-tracking delay),
+    a fixed delay in ms, or ``"off"``. ``max_attempts`` bounds the
+    distinct replicas one logical request may touch (primary + hedges +
+    failover)."""
+
+    def __init__(self, router, runner_id: int = 0,
+                 refresh_s: float = 0.25,
+                 hedge: Union[str, float] = "adaptive",
+                 max_attempts: int = 3,
+                 scheduler: Optional[HedgeScheduler] = None):
+        from multiverso_tpu.fleet.membership import ReplicaGroup
+        self._feed = _GroupFeed(router) if isinstance(router, ReplicaGroup) \
+            else _RouterFeed(router)
+        self.runner_id = int(runner_id)
+        self.max_attempts = max(1, int(max_attempts))
+        self._hedge_on = hedge != "off"
+        self._fixed_delay = None if isinstance(hedge, str) \
+            else float(hedge)
+        self._delay = AdaptiveDelay()
+        self._budget = HedgeBudget()
+        self._sched = scheduler or default_scheduler()
+        self._lock = threading.Lock()
+        self._conns: Dict[str, ServingClient] = {}
+        self._suspects: Dict[str, float] = {}
+        self._table: Optional[RoutingTable] = None
+        self._stop = threading.Event()
+        self._h_lat = histogram("fleet.latency.request")
+        self._c_requests = counter("fleet.requests")
+        self._c_lookup = counter("fleet.route.lookup")
+        self._c_decode = counter("fleet.route.decode")
+        self._c_sub = counter("fleet.route.subrequests")
+        self._c_errors = counter("fleet.errors")
+        self.refresh()          # fail loudly if the router is unreachable
+        self._refresh_s = float(refresh_s)
+        self._refresher = threading.Thread(
+            target=self._refresh_loop, name="fleet-routing", daemon=True)
+        self._refresher.start()
+
+    # -- routing table ------------------------------------------------------
+    def refresh(self) -> RoutingTable:
+        payload = self._feed.fetch()
+        # A re-dialed feed means a (possibly restarted) router whose
+        # version counter restarted — its table must win even when the
+        # version number regressed, or the client routes to stale
+        # addresses forever.
+        fresh_feed = getattr(self._feed, "consume_reconnected",
+                             lambda: False)()
+        table = RoutingTable(payload)
+        with self._lock:
+            if self._table is None or fresh_feed \
+                    or table.version >= self._table.version:
+                self._table = table
+            return self._table
+
+    def _refresh_loop(self) -> None:
+        misses = 0
+        while not self._stop.wait(self._refresh_s):
+            try:
+                self.refresh()
+                misses = 0
+            except (IOError, OSError) as e:
+                misses += 1
+                if misses in (1, 10):   # log the first and the persistent
+                    log.warning("fleet client: routing refresh failed "
+                                "(%s); serving from last table", e)
+
+    def routing(self) -> RoutingTable:
+        with self._lock:
+            table = self._table
+        check(table is not None, "fleet client has no routing table")
+        return table
+
+    # -- connections --------------------------------------------------------
+    def _conn(self, member_id: str) -> ServingClient:
+        table = self.routing()
+        with self._lock:
+            cli = self._conns.get(member_id)
+            if cli is not None and not cli.dead:
+                return cli
+            self._conns.pop(member_id, None)
+        host, port = table.addr(member_id)
+        # Fail fast on a dead replica: one connect try here — the hedge
+        # machinery fails over to the next candidate, and the member gets
+        # suspected below; the slow multi-attempt backoff is for
+        # SINGLE-destination clients with nowhere else to go.
+        cli = ServingClient(host, port, connect_attempts=1)
+        with self._lock:
+            cur = self._conns.setdefault(member_id, cli)
+        if cur is not cli:
+            cli.close()
+        return cur
+
+    def _suspect(self, member_id: str) -> None:
+        with self._lock:
+            self._suspects[member_id] = time.monotonic() + _SUSPECT_TTL_S
+            cli = self._conns.pop(member_id, None)
+        if cli is not None:
+            cli.close()
+
+    def _candidates(self, pref: List[str]) -> List[str]:
+        """Preference order minus locally-suspected members — unless that
+        empties the list (better a suspect than nobody). Fast path: no
+        suspects (the steady state) touches no lock."""
+        if not self._suspects:
+            return pref
+        now = time.monotonic()
+        with self._lock:
+            self._suspects = {m: t for m, t in self._suspects.items()
+                              if t > now}
+            live = [m for m in pref if m not in self._suspects]
+        return live or pref
+
+    # -- hedged dispatch ----------------------------------------------------
+    def _hedge_delay_ms(self) -> float:
+        if self._fixed_delay is not None:
+            return self._fixed_delay
+        return self._delay.delay_ms()
+
+    def _make_attempt(self, member_id: str, payload: np.ndarray,
+                      deadline_ms: float, runner_id: int) -> Callable:
+        def attempt(deliver):
+            try:
+                cli = self._conn(member_id)
+            except ReplicaUnavailableError:
+                self._suspect(member_id)
+                raise
+
+            def cb(res):
+                try:
+                    deliver(res.wait(timeout=1.0))
+                except ReplicaUnavailableError as e:
+                    self._suspect(member_id)
+                    deliver(e)
+                except Exception as e:  # noqa: BLE001 - shed/decode errors
+                    deliver(e)          # belong to the hedge state machine
+
+            try:
+                cli.request_async(payload, deadline_ms, runner_id,
+                                  on_done=cb)
+            except ReplicaUnavailableError:
+                self._suspect(member_id)
+                raise
+        return attempt
+
+    def request_async(self, payload: np.ndarray, pref: List[str],
+                      on_done: Callable, deadline_ms: float = 100.0,
+                      runner_id: Optional[int] = None) -> None:
+        """Hedged dispatch of one payload along a replica preference
+        list; ``on_done`` receives ``(values, clock)`` or an exception
+        instance, exactly once."""
+        rid = self.runner_id if runner_id is None else int(runner_id)
+        pref = self._candidates(pref)[:self.max_attempts]
+        if not pref:
+            on_done(ReplicaUnavailableError("fleet has no live replicas"))
+            return
+        self._c_requests.inc()
+        self._budget.on_request()
+        t0 = time.monotonic()
+
+        def done(result):
+            if isinstance(result, BaseException):
+                self._c_errors.inc()
+            else:
+                ms = (time.monotonic() - t0) * 1e3
+                self._delay.observe(ms)
+                self._h_lat.observe(ms)
+            on_done(result)
+
+        attempts = [self._make_attempt(m, payload, deadline_ms, rid)
+                    for m in pref]
+        HedgedCall(attempts, done, delay_ms=self._hedge_delay_ms(),
+                   scheduler=self._sched, hedge=self._hedge_on,
+                   allow_hedge=self._budget.try_spend).launch()
+
+    # -- lookups ------------------------------------------------------------
+    def _affinity_pref(self, rows: np.ndarray,
+                       table: RoutingTable) -> List[str]:
+        """Ring owner of the request's combined key hash first, then the
+        rest by health — sticky per key-set, balanced across sets."""
+        if rows.size and len(table.ring):
+            rep = int(_splitmix64(rows.astype(np.uint64)).sum()
+                      % np.uint64(2**63 - 1))
+            owner = table.ring.owner(rep)
+            return [owner] + table.ranked(exclude=(owner,))
+        return table.ranked()
+
+    def lookup_async(self, rows, on_done: Callable,
+                     deadline_ms: float = 100.0, split: bool = False,
+                     runner_id: Optional[int] = None) -> None:
+        """Row lookup; ``on_done`` gets ``(values, clock)`` or exception,
+        exactly once. ``split=True`` fans rows out to their ring owners
+        and stitches replies back in request order."""
+        rows = np.asarray(rows, dtype=np.int32).reshape(-1)
+        table = self.routing()
+        self._c_lookup.inc()
+        if not split or rows.size == 0:
+            self.request_async(rows, self._affinity_pref(rows, table),
+                               on_done, deadline_ms, runner_id)
+            return
+        if not len(table.ring):
+            on_done(ReplicaUnavailableError("fleet has no live replicas"))
+            return
+        parts = table.ring.partition(rows.astype(np.int64))
+        self._c_sub.inc(len(parts))
+        state = {"remaining": len(parts), "out": None, "clock": None,
+                 "done": False}
+        state_lock = threading.Lock()
+
+        def sub_done(result, pos):
+            with state_lock:
+                if state["done"]:
+                    return
+                if isinstance(result, BaseException):
+                    state["done"] = True
+                    err = result
+                else:
+                    values, clock = result
+                    if state["out"] is None:
+                        state["out"] = np.empty(
+                            (len(rows),) + values.shape[1:], values.dtype)
+                    state["out"][pos] = values
+                    state["clock"] = clock if state["clock"] is None \
+                        else min(state["clock"], clock)
+                    state["remaining"] -= 1
+                    if state["remaining"]:
+                        return
+                    state["done"] = True
+                    err = None
+            on_done(err if err is not None
+                    else (state["out"], state["clock"]))
+
+        for member_id, pos in parts.items():
+            pref = [member_id] + table.ranked(exclude=(member_id,))
+            self.request_async(
+                rows[pos], pref,
+                lambda result, _pos=pos: sub_done(result, _pos),
+                deadline_ms, runner_id)
+
+    def lookup(self, rows, deadline_ms: float = 100.0,
+               split: bool = False, timeout: Optional[float] = 30.0,
+               runner_id: Optional[int] = None) -> np.ndarray:
+        """Synchronous routed lookup; returns the value rows."""
+        values, _ = self._sync(
+            lambda cb: self.lookup_async(rows, cb, deadline_ms, split,
+                                         runner_id), timeout)
+        return values
+
+    # -- decode -------------------------------------------------------------
+    def generate_async(self, tokens, on_done: Callable,
+                       deadline_ms: float = 1000.0,
+                       runner_id: Optional[int] = None) -> None:
+        """Replica-agnostic request (LM decode): healthiest member first."""
+        tokens = np.asarray(tokens, dtype=np.int32).reshape(-1)
+        self._c_decode.inc()
+        self.request_async(tokens, self.routing().ranked(), on_done,
+                           deadline_ms, runner_id)
+
+    def generate(self, tokens, deadline_ms: float = 1000.0,
+                 timeout: Optional[float] = 60.0,
+                 runner_id: Optional[int] = None) -> np.ndarray:
+        values, _ = self._sync(
+            lambda cb: self.generate_async(tokens, cb, deadline_ms,
+                                           runner_id), timeout)
+        return values
+
+    # -- plumbing -----------------------------------------------------------
+    @staticmethod
+    def _sync(start: Callable, timeout: Optional[float]):
+        event = threading.Event()
+        slot: List = []
+
+        def cb(result):
+            slot.append(result)
+            event.set()
+
+        start(cb)
+        check(event.wait(timeout), "fleet request timed out")
+        if isinstance(slot[0], BaseException):
+            raise slot[0]
+        return slot[0]
+
+    def close(self) -> None:
+        self._stop.set()
+        self._refresher.join(timeout=5)
+        self._feed.close()
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for cli in conns:
+            cli.close()

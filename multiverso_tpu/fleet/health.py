@@ -1,0 +1,63 @@
+"""Replica health scoring: turning ``serve.*`` gauges into routing weight.
+
+Each replica's heartbeat carries the load gauges its serving plane already
+exports (``serve.queue_depth``, ``serve.inflight``, ``serve.replica_step``
+— docs/OBSERVABILITY.md): no second measurement path, the fleet routes by
+the same numbers an operator graphs. The router folds them into one scalar
+in ``(0, 1]``:
+
+    load   = queue_depth / max_queue  +  inflight / max_batch
+             + staleness_steps * STALENESS_WEIGHT
+    health = 1 / (1 + load)          (0.0 when draining or dead)
+
+Queue depth is the forward-looking signal (requests already committed to
+this replica), inflight the instantaneous one, and staleness — how many
+checkpoint steps the replica lags the freshest member — a soft penalty so
+traffic drifts toward replicas serving newer parameters without starving
+a refresh-lagged one outright. Draining or dead pins the score to 0.0,
+which removes the replica from every candidate list.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+STALENESS_WEIGHT = 0.25     # one checkpoint step behind ~ 25% extra load
+
+#: Heartbeat stat fields. ``drains_completed`` is a per-member monotonic
+#: count — the router's drain driver watches it instead of trying to
+#: catch the (possibly sub-heartbeat) draining=1 window in flight.
+STAT_FIELDS = ("queue_depth", "inflight", "replica_step", "draining",
+               "max_queue", "max_batch", "drains_completed")
+
+
+def health_score(stats: Mapping[str, float], fleet_max_step: float) -> float:
+    """One replica's score in ``[0, 1]``; 0.0 iff unroutable (draining)."""
+    if stats.get("draining", 0.0):
+        return 0.0
+    q_bound = max(1.0, float(stats.get("max_queue", 1.0)))
+    b_width = max(1.0, float(stats.get("max_batch", 1.0)))
+    load = (float(stats.get("queue_depth", 0.0)) / q_bound
+            + float(stats.get("inflight", 0.0)) / b_width)
+    step = float(stats.get("replica_step", -1.0))
+    if step >= 0.0 and fleet_max_step > step:
+        load += (fleet_max_step - step) * STALENESS_WEIGHT
+    return 1.0 / (1.0 + load)
+
+
+def local_stats(max_queue: int, max_batch: int) -> Dict[str, float]:
+    """A replica's own heartbeat payload, read from the process-local
+    telemetry registry — the exported gauges ARE the health feed. The
+    member overlays its instance-local drain state on top (the registry
+    is process-global; two members in one test process must not read
+    each other's drain flag)."""
+    from multiverso_tpu.telemetry import gauge
+    return {
+        "queue_depth": float(gauge("serve.queue_depth").last),
+        "inflight": float(gauge("serve.inflight").last),
+        "replica_step": float(gauge("serve.replica_step").last),
+        "draining": 0.0,
+        "max_queue": float(max_queue),
+        "max_batch": float(max_batch),
+        "drains_completed": 0.0,
+    }

@@ -1,0 +1,383 @@
+"""Replica-group membership: join/leave/heartbeat over the DCN framing.
+
+Two halves of one protocol (``Fleet_*`` MsgTypes, ``core/actor.py``):
+
+* :class:`ReplicaGroup` — the ROUTER-side authority. Tracks members,
+  their last heartbeat and load stats, computes health scores, sweeps the
+  dead (``liveness_misses`` missed heartbeats), and maintains a
+  monotonically-versioned routing table. The consistent-hash ring is a
+  pure function of the live non-draining member ids (``hashring.py``), so
+  clients rebuild the identical ring from the shipped id list.
+* :class:`FleetMember` — the REPLICA-side agent embedded in a serving
+  process. One daemon thread dials the router (capped-backoff connect),
+  joins, then heartbeats at the router-assigned cadence, reporting the
+  load stats its own ``serve.*`` gauges already export. Heartbeat REPLIES
+  carry directives: ``drain`` starts the rolling-swap lifecycle (finish
+  in-flight batches -> hot-swap checkpoint -> re-warm every bucket
+  executable -> rejoin), ``rejoin`` re-registers after a router restart.
+
+The member keeps SERVING throughout a drain — draining only removes it
+from the ring so new traffic routes elsewhere; requests that still arrive
+(stale client tables, in-flight hedges) are answered, which is why a
+rolling fleet upgrade drops zero requests.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from multiverso_tpu.core.actor import Message, MsgType
+from multiverso_tpu.fleet.hashring import HashRing
+from multiverso_tpu.fleet.health import STAT_FIELDS, health_score, local_stats
+from multiverso_tpu.parallel.net import (pack_json_blob, recv_message,
+                                         send_message, unpack_json_blob)
+from multiverso_tpu.telemetry import counter, gauge, span
+from multiverso_tpu.utils.log import check, log
+
+
+class MemberInfo:
+    """Router-side record of one replica."""
+
+    __slots__ = ("id", "host", "port", "stats", "last_seen", "joined_at",
+                 "directive")
+
+    def __init__(self, member_id: str, host: str, port: int):
+        self.id = member_id
+        self.host = host
+        self.port = int(port)
+        self.stats: Dict[str, float] = {}
+        self.last_seen = time.monotonic()
+        self.joined_at = time.monotonic()
+        self.directive = "none"
+
+    @property
+    def draining(self) -> bool:
+        return bool(self.stats.get("draining", 0.0))
+
+    @property
+    def step(self) -> float:
+        return float(self.stats.get("replica_step", -1.0))
+
+    @property
+    def drains_completed(self) -> int:
+        return int(self.stats.get("drains_completed", 0.0))
+
+
+class ReplicaGroup:
+    """Membership + health + ring, versioned. Thread-safe."""
+
+    def __init__(self, vnodes: int = 64, heartbeat_ms: float = 100.0,
+                 liveness_misses: int = 5):
+        check(heartbeat_ms > 0, "heartbeat interval must be positive")
+        self.vnodes = int(vnodes)
+        self.heartbeat_ms = float(heartbeat_ms)
+        self.liveness_misses = max(1, int(liveness_misses))
+        self._lock = threading.Lock()
+        self._members: Dict[str, MemberInfo] = {}
+        self._version = 0
+        self._ring = HashRing((), vnodes=self.vnodes)
+        self._g_members = gauge("fleet.members")
+        self._g_version = gauge("fleet.ring_version")
+        self._c_joins = counter("fleet.joins")
+        self._c_heartbeats = counter("fleet.heartbeats")
+        self._c_dead = counter("fleet.member_dead")
+
+    # -- protocol handlers ---------------------------------------------------
+    def join(self, member_id: str, host: str, port: int) -> Dict:
+        with self._lock:
+            fresh = member_id not in self._members
+            info = MemberInfo(member_id, host, port)
+            self._members[member_id] = info
+            self._bump_locked()
+            self._c_joins.inc()
+            if fresh:
+                log.info("fleet: member %s joined at %s:%d (now %d)",
+                         member_id, host, port, len(self._members))
+            return {"ok": True, "version": self._version,
+                    "heartbeat_ms": self.heartbeat_ms,
+                    "liveness_misses": self.liveness_misses}
+
+    def heartbeat(self, member_id: str, stats: Dict[str, float]) -> Dict:
+        with self._lock:
+            info = self._members.get(member_id)
+            self._c_heartbeats.inc()
+            if info is None:
+                # Router restarted (or swept this member): ask it to
+                # re-register rather than silently resurrecting it here —
+                # the join reply re-delivers the cadence contract.
+                return {"directive": "rejoin", "version": self._version}
+            was_draining = info.draining
+            info.stats = {k: float(stats.get(k, 0.0)) for k in STAT_FIELDS}
+            info.last_seen = time.monotonic()
+            directive = info.directive
+            # Directive delivery is the TCP reply — clear it now. A
+            # sub-heartbeat drain (quiesce + warm finish before the next
+            # beat) must not be re-delivered forever; completion is
+            # tracked by the member's monotonic drains_completed stat,
+            # not by catching the draining=1 window in flight.
+            info.directive = "none"
+            if info.draining != was_draining:
+                self._bump_locked()           # ring membership changed
+            return {"directive": directive, "version": self._version}
+
+    def leave(self, member_id: str) -> Dict:
+        with self._lock:
+            if self._members.pop(member_id, None) is not None:
+                self._bump_locked()
+                log.info("fleet: member %s left (now %d)", member_id,
+                         len(self._members))
+            return {"ok": True, "version": self._version}
+
+    def sweep(self) -> List[str]:
+        """Remove members whose heartbeat is older than
+        ``liveness_misses`` intervals; returns the ids removed."""
+        horizon = self.liveness_misses * self.heartbeat_ms / 1e3
+        now = time.monotonic()
+        dead: List[str] = []
+        with self._lock:
+            for mid, info in list(self._members.items()):
+                if now - info.last_seen > horizon:
+                    del self._members[mid]
+                    dead.append(mid)
+            if dead:
+                self._bump_locked()
+                self._c_dead.inc(len(dead))
+        for mid in dead:
+            log.warning("fleet: member %s missed %d heartbeats — removed",
+                        mid, self.liveness_misses)
+        return dead
+
+    # -- control -------------------------------------------------------------
+    def drain(self, member_id: str) -> None:
+        """Queue a drain directive; delivered on the next heartbeat."""
+        with self._lock:
+            check(member_id in self._members,
+                  f"unknown fleet member '{member_id}'")
+            self._members[member_id].directive = "drain"
+            counter("fleet.drains").inc()
+
+    def member_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def is_draining(self, member_id: str) -> Optional[bool]:
+        with self._lock:
+            info = self._members.get(member_id)
+            return None if info is None else \
+                (info.draining or info.directive == "drain")
+
+    def drains_completed(self, member_id: str) -> Optional[int]:
+        """The member's monotonic drain-cycle count (None if gone)."""
+        with self._lock:
+            info = self._members.get(member_id)
+            return None if info is None else info.drains_completed
+
+    # -- routing table -------------------------------------------------------
+    def _bump_locked(self) -> None:
+        self._version += 1
+        routable = sorted(m.id for m in self._members.values()
+                          if not m.draining)
+        self._ring = HashRing(routable, vnodes=self.vnodes)
+        self._g_members.set(len(self._members))
+        self._g_version.set(self._version)
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    @property
+    def ring(self) -> HashRing:
+        with self._lock:
+            return self._ring
+
+    def routing_payload(self) -> Dict:
+        """JSON-able routing table for ``Fleet_Route`` replies: ids,
+        addresses, health scores. Clients rebuild the ring from the ids."""
+        with self._lock:
+            members = list(self._members.values())
+            version = self._version
+        max_step = max([m.step for m in members], default=-1.0)
+        return {
+            "version": version,
+            "vnodes": self.vnodes,
+            "heartbeat_ms": self.heartbeat_ms,
+            "members": [{
+                "id": m.id, "host": m.host, "port": m.port,
+                "health": round(health_score(m.stats, max_step), 6),
+                "draining": m.draining, "step": m.step,
+                # Monotonic per-member drain-cycle count: an operator
+                # polling the table can tell a rolling drain finished
+                # (every baseline member's count ticked) without any
+                # extra protocol state.
+                "drains_completed": m.drains_completed,
+            } for m in members],
+        }
+
+
+class FleetMember:
+    """Replica-side membership agent + drain lifecycle executor.
+
+    ``service`` is the process's :class:`ServingService` (supplies the
+    advertised address, the quiesce barrier, and bucket warm-up);
+    ``swap_fn`` runs between quiesce and warm-up during a drain —
+    typically ``CheckpointReplica.refresh`` for a rolling checkpoint
+    swap. The heartbeat loop, the reconnect backoff, and the drain worker
+    are all daemon threads joined by :meth:`close`."""
+
+    def __init__(self, router: Tuple[str, int], service,
+                 member_id: Optional[str] = None,
+                 swap_fn: Optional[Callable[[], object]] = None,
+                 drain_timeout_s: float = 30.0):
+        self.router = (str(router[0]), int(router[1]))
+        self.service = service
+        self.member_id = member_id or \
+            f"{service.address[0]}:{service.address[1]}#{os.getpid()}"
+        self.swap_fn = swap_fn
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._msg_id = 0
+        self._heartbeat_s = 0.1
+        # Instance-local drain state is authoritative (the telemetry
+        # gauge is export-only: the registry is process-global and two
+        # members in one test process must not alias).
+        self._drain_active = False
+        self._drains_done = 0
+        self._g_draining = gauge("fleet.draining")
+        self._g_draining.set(0.0)
+        self._c_drains = counter("fleet.member_drains")
+        self._drain_thread: Optional[threading.Thread] = None
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-member", daemon=True)
+
+    def start(self) -> "FleetMember":
+        self._thread.start()
+        return self
+
+    # -- wire ----------------------------------------------------------------
+    def _rpc(self, msg_type: int, payload: Dict) -> Dict:
+        check(self._sock is not None, "fleet member is not connected")
+        self._msg_id += 1
+        send_message(self._sock, Message(
+            type=msg_type, msg_id=self._msg_id,
+            data=[pack_json_blob(payload)]))
+        reply = recv_message(self._sock)
+        if reply is None:
+            raise OSError("fleet router closed the connection")
+        if reply.type == MsgType.Reply_Error:
+            reason = reply.data[0].tobytes().decode() if reply.data else "?"
+            raise OSError(f"fleet router rejected request: {reason}")
+        return unpack_json_blob(reply.data[0]) if reply.data else {}
+
+    def _join(self) -> None:
+        from multiverso_tpu.serving.client import connect_with_backoff
+        # A rejoin (router swept us, or asked us to re-register) must not
+        # leak the previous socket — each leak also pins a dead conn slot
+        # + reader thread on the router until MAX_CONNS starves joins.
+        self._close_sock()
+        self._sock = connect_with_backoff(*self.router, attempts=6)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        host, port = self.service.address
+        reply = self._rpc(MsgType.Fleet_Join, {
+            "id": self.member_id, "host": host, "port": port})
+        self._heartbeat_s = float(reply.get("heartbeat_ms", 100.0)) / 1e3
+        log.info("fleet member %s: joined router %s:%d (heartbeat %.0fms)",
+                 self.member_id, self.router[0], self.router[1],
+                 self._heartbeat_s * 1e3)
+
+    # -- heartbeat loop ------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self._sock is None:
+                    self._join()
+                self._stop.wait(self._heartbeat_s)
+                if self._stop.is_set():
+                    return
+                b = self.service.batcher(0)
+                stats = local_stats(b.max_queue, b.max_batch)
+                stats["draining"] = 1.0 if self._drain_active else 0.0
+                stats["drains_completed"] = float(self._drains_done)
+                reply = self._rpc(MsgType.Fleet_Heartbeat, {
+                    "id": self.member_id, "stats": stats})
+                directive = reply.get("directive", "none")
+                if directive == "drain":
+                    self._begin_drain()
+                elif directive == "rejoin":
+                    self._join()
+            except (IOError, OSError) as e:
+                if self._stop.is_set():
+                    return
+                log.warning("fleet member %s: router connection lost (%s); "
+                            "re-dialing", self.member_id, e)
+                self._close_sock()
+                self._stop.wait(0.2)
+
+    # -- drain lifecycle -----------------------------------------------------
+    def _begin_drain(self) -> None:
+        if self._drain_thread is not None and self._drain_thread.is_alive():
+            return              # a drain is already running
+        self._drain_active = True
+        self._g_draining.set(1.0)
+        self._drain_thread = threading.Thread(
+            target=self._drain, name="fleet-drain", daemon=True)
+        self._drain_thread.start()
+
+    def _drain(self) -> None:
+        """Finish in-flight batches, hot-swap, re-warm, rejoin. The
+        service keeps answering throughout — drain changes ROUTING, not
+        availability."""
+        self._c_drains.inc()
+        with span("fleet.drain", member=self.member_id):
+            try:
+                if not self.service.quiesce(self.drain_timeout_s):
+                    log.warning("fleet member %s: drain quiesce timed out "
+                                "after %.1fs; swapping anyway",
+                                self.member_id, self.drain_timeout_s)
+                if self.swap_fn is not None:
+                    self.swap_fn()
+                self.service.warmup()
+            except Exception as e:  # noqa: BLE001 - a failed swap must
+                # re-enter the ring rather than leave the replica parked
+                # in draining state forever (the old snapshot still
+                # serves correctly).
+                log.error("fleet member %s: drain swap failed: %s",
+                          self.member_id, e)
+            finally:
+                self._drains_done += 1
+                self._drain_active = False
+                self._g_draining.set(0.0)
+        log.info("fleet member %s: drain complete — rejoining ring",
+                 self.member_id)
+
+    # -- lifecycle -----------------------------------------------------------
+    def _close_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            # Let the heartbeat loop finish its in-flight RPC before we
+            # share its socket for the goodbye (two writers on one framed
+            # stream would interleave).
+            self._thread.join(timeout=2)
+        if not self._thread.is_alive() and self._sock is not None:
+            try:
+                self._rpc(MsgType.Fleet_Leave, {"id": self.member_id})
+            except (IOError, OSError):
+                pass            # best-effort: the sweep will reap us
+        self._close_sock()      # also breaks a recv the loop is stuck in
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+        if self._drain_thread is not None:
+            self._drain_thread.join(timeout=self.drain_timeout_s + 5)

@@ -159,6 +159,38 @@ def unpack_serve_payload(blobs: List[np.ndarray]) -> np.ndarray:
     raise IOError(f"unknown serve payload mode {mode}")
 
 
+# ---------------------------------------------------------------------------
+# Fleet control-plane payload codec (multiverso_tpu/fleet). Membership and
+# routing-table exchange is low-rate structured control traffic — it rides
+# the same length-prefixed blob framing as everything else, as one uint8
+# blob of canonical JSON. Data-path payloads never use this (they stay raw
+# arrays); a malformed control blob decodes to an IOError like any other
+# bad frame, never an exception escaping into a reader loop.
+# ---------------------------------------------------------------------------
+_MAX_JSON_BYTES = 1 << 22   # 4 MB of control JSON is already absurd
+
+
+def pack_json_blob(obj) -> np.ndarray:
+    """Control dict/list -> one uint8 blob for Message.data."""
+    import json
+    raw = json.dumps(obj, separators=(",", ":"), sort_keys=True).encode()
+    if len(raw) > _MAX_JSON_BYTES:
+        raise IOError(f"control payload too large ({len(raw)} bytes)")
+    return np.frombuffer(raw, dtype=np.uint8)
+
+
+def unpack_json_blob(blob: np.ndarray):
+    """Inverse of :func:`pack_json_blob`; raises IOError on garbage."""
+    import json
+    raw = np.asarray(blob, dtype=np.uint8).tobytes()
+    if len(raw) > _MAX_JSON_BYTES:
+        raise IOError(f"control payload too large ({len(raw)} bytes)")
+    try:
+        return json.loads(raw.decode())
+    except (UnicodeDecodeError, ValueError) as e:
+        raise IOError(f"bad control payload: {e}") from e
+
+
 def recv_message(sock: socket.socket) -> Optional[Message]:
     """Blocking read of one framed message; None on clean EOF."""
     magic = _recv_exact(sock, _MAGIC.size)

@@ -16,8 +16,10 @@ See docs/SERVING.md for architecture and tuning.
 
 from multiverso_tpu.serving.batcher import (BucketLadder, DynamicBatcher,
                                             ServeRequest, ShedError)
-from multiverso_tpu.serving.client import (RoutedLookupClient, ServeResult,
-                                           ServingClient)
+from multiverso_tpu.serving.client import (ReplicaUnavailableError,
+                                           RoutedLookupClient, ServeResult,
+                                           ServingClient,
+                                           connect_with_backoff)
 from multiverso_tpu.serving.replica import (CheckpointReplica,
                                             ReplicaSnapshot,
                                             load_checkpoint_tables)
@@ -30,7 +32,8 @@ from multiverso_tpu.serving.service import ServingService
 __all__ = [
     "AttentionLMRunner", "BucketLadder", "CheckpointReplica",
     "DynamicBatcher", "ReplicaLookupRunner", "ReplicaSnapshot",
-    "RoutedLookupClient", "ServeRequest", "ServeResult", "ServingClient",
-    "ServingRunner", "ServingService", "ShedError", "SparseLookupRunner",
+    "ReplicaUnavailableError", "RoutedLookupClient", "ServeRequest",
+    "ServeResult", "ServingClient", "ServingRunner", "ServingService",
+    "ShedError", "SparseLookupRunner", "connect_with_backoff",
     "load_checkpoint_tables",
 ]

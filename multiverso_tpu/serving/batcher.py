@@ -116,6 +116,7 @@ class DynamicBatcher:
         self._cv = threading.Condition()
         self._queue: "collections.deque[ServeRequest]" = collections.deque()
         self._running = True
+        self._busy = False      # a batch is mid-dispatch (quiesce barrier)
         # Telemetry (docs/OBSERVABILITY.md catalog, serve.* family).
         self._g_depth = gauge("serve.queue_depth")
         self._g_inflight = gauge("serve.inflight")
@@ -210,11 +211,30 @@ class DynamicBatcher:
             if batch is None:
                 return
             if not batch:
+                self._busy = False      # popped entries all expired
                 continue
             self._c_requests.inc(len(batch))
             self._g_inflight.set(len(batch))
-            self._run_batch(batch)
+            try:
+                self._run_batch(batch)
+            finally:
+                self._busy = False
             self._g_inflight.set(0)
+
+    def quiesce(self, timeout_s: float = 30.0) -> bool:
+        """Block until the queue is empty AND no batch is mid-dispatch —
+        the drain barrier a rolling checkpoint swap needs before touching
+        the runner's weights. New submissions are NOT blocked (a draining
+        fleet replica keeps serving; it just waits for a quiet instant),
+        so under sustained load this can time out: returns False then."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            with self._cv:
+                idle = not self._queue and not self._busy
+            if idle:
+                return True
+            time.sleep(0.002)
+        return False
 
     def _gather_batch(self) -> Optional[List[ServeRequest]]:
         """Blocks for the head request, then waits up to ``max_wait_ms``
@@ -232,6 +252,12 @@ class DynamicBatcher:
                 self._cv.wait(max(flush_at - time.monotonic(), 1e-4))
             batch = [self._queue.popleft()
                      for _ in range(min(self.max_batch, len(self._queue)))]
+            if batch:
+                # Atomic with the pop, under the cv: quiesce() must never
+                # observe "queue empty, not busy" while a just-gathered
+                # batch is on its way to dispatch — that window is exactly
+                # the straddling batch the drain barrier exists to stop.
+                self._busy = True
             self._g_depth.set(len(self._queue))
         now = time.monotonic()
         live: List[ServeRequest] = []

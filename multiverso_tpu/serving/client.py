@@ -4,7 +4,11 @@
 ONE persistent connection — a reader thread routes replies to waiters by
 msg_id (the Worker-side Communicator contract, reused for the read path).
 Replies legitimately arrive out of order; a shed request completes its
-waiter with a :class:`ShedError` instead of a timeout.
+waiter with a :class:`ShedError` instead of a timeout. Transport failures
+are TYPED: a refused/reset connect retries with capped exponential backoff
+and then surfaces as :class:`ReplicaUnavailableError` (an ``OSError``
+subclass), so callers can tell "dead replica — fail over" apart from "bad
+request — surface it".
 
 :class:`RoutedLookupClient` is the multi-shard composition: global row
 ids route to the shard service that owns them by the same contiguous
@@ -17,7 +21,8 @@ from __future__ import annotations
 import os
 import socket
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -25,24 +30,84 @@ from multiverso_tpu.core.actor import Message, MsgType
 from multiverso_tpu.parallel.net import (recv_message, send_message,
                                          unpack_serve_payload)
 from multiverso_tpu.serving.batcher import ShedError
-from multiverso_tpu.utils.log import check
+from multiverso_tpu.utils.log import check, log
+
+
+class ReplicaUnavailableError(OSError):
+    """The serving replica is unreachable: connect refused/reset after
+    retries, or an established connection died mid-request. Distinct from
+    :class:`ShedError` (the replica is healthy but rejected the request) so
+    a fleet client can fail over instead of surfacing a bad-request."""
+
+
+# Transient connect failures worth retrying: a replica mid-restart refuses,
+# a listener backlog overflow resets. Anything else (EHOSTUNREACH, bad
+# address) surfaces immediately.
+_TRANSIENT_CONNECT = (ConnectionRefusedError, ConnectionResetError,
+                      ConnectionAbortedError, socket.timeout)
+
+
+def connect_with_backoff(host: str, port: int, attempts: int = 4,
+                         base_delay_s: float = 0.05,
+                         timeout_s: float = 30.0) -> socket.socket:
+    """``socket.create_connection`` with capped exponential backoff over
+    transient refusals. Raises :class:`ReplicaUnavailableError` once the
+    attempts are spent — the caller knows it is a DEAD REPLICA, not a bad
+    request."""
+    attempts = max(1, int(attempts))
+    last: Optional[BaseException] = None
+    for i in range(attempts):
+        try:
+            return socket.create_connection((host, port), timeout=timeout_s)
+        except _TRANSIENT_CONNECT as e:
+            last = e
+            if i + 1 < attempts:
+                time.sleep(min(base_delay_s * (2 ** i), 0.5))
+    raise ReplicaUnavailableError(
+        f"replica {host}:{port} unavailable after {attempts} connect "
+        f"attempts: {last}")
 
 
 class ServeResult:
-    """Waiter for one in-flight request."""
+    """Waiter for one in-flight request. ``add_callback`` registers a
+    completion hook (fired on the reader thread — reply, server error, or
+    lost connection alike); a callback added after completion fires
+    immediately on the caller's thread."""
 
-    __slots__ = ("event", "slot")
+    __slots__ = ("event", "slot", "_callbacks", "_cb_lock")
 
     def __init__(self):
         self.event = threading.Event()
         self.slot: List[object] = []
+        self._callbacks: List[Callable[["ServeResult"], None]] = []
+        self._cb_lock = threading.Lock()
+
+    def add_callback(self, fn: Callable[["ServeResult"], None]) -> None:
+        with self._cb_lock:
+            if not self.event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)        # already complete: fire now, outside the lock
+
+    def _complete(self) -> None:
+        with self._cb_lock:
+            self.event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception as e:  # noqa: BLE001 - a callback raise must
+                # not kill the reader loop delivering sibling replies
+                log.error("serve client: completion callback failed: %s", e)
 
     def wait(self, timeout: Optional[float] = 60.0):
         """Returns ``(values, clock)``; raises :class:`ShedError` when the
-        server shed the request, ``OSError`` on a lost connection."""
+        server shed the request, :class:`ReplicaUnavailableError` on a
+        lost connection."""
         check(self.event.wait(timeout), "serve request timed out")
         if not self.slot:
-            raise OSError("connection to serving service lost")
+            raise ReplicaUnavailableError(
+                "connection to serving service lost")
         msg = self.slot[0]
         if msg.type == MsgType.Reply_Error:
             reason = msg.data[0].tobytes().decode() if msg.data else "?"
@@ -60,8 +125,9 @@ class ServingClient:
     _msg_counter = int.from_bytes(os.urandom(6), "little")
     _counter_lock = threading.Lock()
 
-    def __init__(self, host: str, port: int):
-        self._sock = socket.create_connection((host, port), timeout=30)
+    def __init__(self, host: str, port: int, connect_attempts: int = 4):
+        self._sock = connect_with_backoff(host, port,
+                                          attempts=connect_attempts)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = threading.Lock()
@@ -80,23 +146,32 @@ class ServingClient:
 
     def request_async(self, payload: np.ndarray,
                       deadline_ms: float = 100.0,
-                      runner_id: int = 0) -> ServeResult:
+                      runner_id: int = 0,
+                      on_done: Optional[Callable[[ServeResult], None]]
+                      = None) -> ServeResult:
+        """``on_done`` (optional) fires on the reader thread at completion
+        — success, server error, and lost connection alike — so a fleet
+        client or proxy can hedge/relay without a thread per request."""
         if self._dead:
-            raise OSError("connection to serving service is closed")
+            raise ReplicaUnavailableError(
+                "connection to serving service is closed")
         msg = Message(type=MsgType.Serve_Request, table_id=runner_id,
                       msg_id=self._next_msg_id(),
                       data=[np.ascontiguousarray(payload),
                             np.asarray([deadline_ms], dtype=np.float64)])
         result = ServeResult()
+        if on_done is not None:
+            result.add_callback(on_done)
         with self._waiters_lock:
             self._waiters[msg.msg_id] = result
         try:
             with self._send_lock:
                 send_message(self._sock, msg)
-        except OSError:
+        except OSError as e:
             with self._waiters_lock:
                 self._waiters.pop(msg.msg_id, None)
-            raise
+            raise ReplicaUnavailableError(
+                f"send to serving service failed: {e}") from e
         return result
 
     def lookup(self, keys, deadline_ms: float = 100.0,
@@ -127,7 +202,7 @@ class ServingClient:
                     waiter = self._waiters.pop(msg.msg_id, None)
                 if waiter is not None:
                     waiter.slot.append(msg)
-                    waiter.event.set()
+                    waiter._complete()
         except OSError:
             pass
         self._dead = True
@@ -135,7 +210,13 @@ class ServingClient:
             pending = list(self._waiters.values())
             self._waiters.clear()
         for waiter in pending:
-            waiter.event.set()      # empty slot -> OSError in wait()
+            waiter._complete()      # empty slot -> ReplicaUnavailableError
+
+    @property
+    def dead(self) -> bool:
+        """True once the connection is lost; a pool should discard and
+        re-dial rather than keep submitting into the dead socket."""
+        return self._dead
 
     def close(self) -> None:
         try:

@@ -80,6 +80,39 @@ class ServingService:
     def batcher(self, runner_id: int = 0) -> DynamicBatcher:
         return self._batchers[runner_id]
 
+    # -- fleet lifecycle hooks ----------------------------------------------
+    def quiesce(self, timeout_s: float = 30.0) -> bool:
+        """Wait for every batcher to reach a quiet instant (empty queue,
+        no batch mid-dispatch). The fleet drain barrier: a replica calls
+        this before hot-swapping its checkpoint so no in-flight batch
+        straddles the swap. The listener stays up — requests arriving
+        during a drain are still served, never dropped."""
+        with self._lock:
+            batchers = list(self._batchers.values())
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        for b in batchers:
+            if not b.quiesce(max(0.0, deadline - time.monotonic())):
+                return False
+        return True
+
+    def warmup(self) -> int:
+        """Drive one zero batch per (runner, bucket) straight through each
+        runner — compiles/refreshes every bucket executable so the first
+        real request after bring-up or a checkpoint swap never pays a
+        trace. Returns the number of executables warmed."""
+        with self._lock:
+            pairs = [(self._runners[rid], b)
+                     for rid, b in self._batchers.items()]
+        warmed = 0
+        for runner, b in pairs:
+            dtype = getattr(runner, "payload_dtype", np.int32)
+            pad_id = getattr(runner, "pad_id", 0)
+            for bucket in b.ladder.buckets:
+                mat = np.full((b.max_batch, bucket), pad_id, dtype=dtype)
+                runner.run(mat, np.zeros(b.max_batch, dtype=np.int32))
+                warmed += 1
+        return warmed
+
     # -- connection handling -------------------------------------------------
     def _accept_loop(self) -> None:
         while self._running:

@@ -232,6 +232,37 @@ define_string("serve_addr_file", "", "write 'host:port' here once the "
               "serving listener is bound (rendezvous for clients/tests)")
 define_double("serve_duration", 0.0, "serve for N seconds then exit "
               "(0 = until killed) — CI and smoke hooks")
+# Fleet layer (multiverso_tpu/fleet; docs/SERVING.md "Fleet").
+define_string("fleet_role", "local", "local|router|replica|drain: local "
+              "spawns a router + -fleet_replicas replica processes; "
+              "router/replica run one role (production: one per host); "
+              "drain triggers a rolling checkpoint drain on a running "
+              "fleet (-fleet_router; -fleet_member_id to drain one)")
+define_string("fleet_router", "", "host:port of the fleet router's "
+              "control listener (replica role + fleet clients)")
+define_int("fleet_port", 0, "router control/proxy listener port "
+           "(0 = ephemeral; written to -fleet_addr_file)")
+define_int("fleet_replicas", 2, "local role: replica processes to spawn")
+define_int("fleet_vnodes", 64, "virtual nodes per member on the "
+           "consistent-hash ring (balance vs rebuild cost)")
+define_double("fleet_heartbeat_ms", 100.0, "member heartbeat cadence; "
+              "the router assigns it at join")
+define_int("fleet_liveness_misses", 5, "missed heartbeats before the "
+           "router declares a member dead and drops it from the ring")
+define_string("fleet_hedge", "adaptive", "adaptive|off|<ms>: client hedge "
+              "delay — adaptive tracks ~1.25x p95 of recent latency")
+define_string("fleet_member_id", "", "replica id on the ring (default "
+              "host:port#pid — stable ids give stable ring arcs)")
+define_string("fleet_addr_file", "", "router writes 'host:port' of the "
+              "bound control listener here (rendezvous for replicas)")
+define_string("fleet_synthetic", "", "ROWSxCOLS@SEED: serve a seeded "
+              "synthetic lookup table instead of -checkpoint_dir "
+              "(benches + smokes; replicas with equal seeds serve "
+              "bitwise-identical rows)")
+define_bool("fleet_proxy", True, "router also proxies plain Serve_Request "
+            "traffic (clients that don't speak the routing protocol)")
+define_double("fleet_drain_timeout_s", 30.0, "drain barrier: max wait for "
+              "in-flight batches before the checkpoint swap proceeds")
 # Telemetry export (multiverso_tpu/telemetry; docs/OBSERVABILITY.md).
 define_string("telemetry_dir", "", "write periodic metrics snapshots "
               "(metrics-<pid>-<seq>.json) and a Chrome trace "

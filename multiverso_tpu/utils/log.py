@@ -19,8 +19,9 @@ from typing import Any, Optional
 class LogLevel(enum.IntEnum):
     DEBUG = 0
     INFO = 1
-    ERROR = 2
-    FATAL = 3
+    WARNING = 2
+    ERROR = 3
+    FATAL = 4
 
 
 class FatalError(RuntimeError):
@@ -85,6 +86,14 @@ class Logger:
 
     def info(self, msg: str, *args: Any) -> None:
         self._emit(LogLevel.INFO, msg, *args)
+
+    def warning(self, msg: str, *args: Any) -> None:
+        """Notable-but-survivable: lost heartbeats, retried refreshes.
+        (Several long-standing call sites used this name against the
+        4-level reference enum and died with AttributeError the first
+        time their failure path actually fired — a dropped stalled peer
+        took the whole ps_service sweeper thread with it.)"""
+        self._emit(LogLevel.WARNING, msg, *args)
 
     def error(self, msg: str, *args: Any) -> None:
         self._emit(LogLevel.ERROR, msg, *args)

@@ -39,3 +39,15 @@ def pool(fns):
         w.start()
     for w in workers:
         w.join()
+
+
+class FleetAgent:
+    """The heartbeat daemon pattern: the loop dies with the process
+    (daemon=True) AND close() joins it for orderly shutdown."""
+
+    def start_heartbeat(self, beat):
+        self._hb = threading.Thread(target=beat, daemon=True)
+        self._hb.start()
+
+    def close(self):
+        self._hb.join(timeout=5)

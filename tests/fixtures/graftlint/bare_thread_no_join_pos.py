@@ -16,3 +16,12 @@ class Engine:
 
 def anonymous(fn):
     threading.Thread(target=fn).start()  # expect: bare-thread-no-join
+
+
+class FleetAgent:
+    """Heartbeat loop on a non-daemon thread with no join on any
+    shutdown path: interpreter exit hangs on the last beat."""
+
+    def start_heartbeat(self, beat):
+        self._hb = threading.Thread(target=beat)  # expect: bare-thread-no-join
+        self._hb.start()

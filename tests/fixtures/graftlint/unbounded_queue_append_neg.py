@@ -54,3 +54,14 @@ def unknown_origin(entry, sock):
         if msg is None:
             break
         slot.append(msg)
+
+
+class HeartbeatDaemonBounded:
+    """Fleet heartbeat agent keeping a BOUNDED beat journal (ring)."""
+
+    def __init__(self):
+        self._beats = collections.deque(maxlen=256)
+
+    def heartbeat_loop(self, router, stop):
+        while not stop.is_set():
+            self._beats.append(router.heartbeat())

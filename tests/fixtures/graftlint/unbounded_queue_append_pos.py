@@ -56,3 +56,16 @@ class InfiniteBounds:
             self._q.put(sock.recv())  # expect: unbounded-queue-append
             self._q2.put(sock.recv())  # expect: unbounded-queue-append
             self._ring.append(sock.recv())  # expect: unbounded-queue-append
+
+
+class HeartbeatDaemon:
+    """Fleet heartbeat agent that journals every beat forever — the
+    membership-layer variant of the slow-consumer OOM."""
+
+    def __init__(self):
+        self._beats = []
+
+    def heartbeat_loop(self, router, stop):
+        while not stop.is_set():
+            stats = router.heartbeat()
+            self._beats.append(stats)  # expect: unbounded-queue-append

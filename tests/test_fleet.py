@@ -1,0 +1,435 @@
+"""Unit + in-process integration tests for the fleet layer.
+
+Covers the ISSUE-6 test satellite: HashRing ownership-stability property
+tests (adding one replica to N moves <= ~1/(N+1) of keys; removal
+reassigns ONLY the removed replica's keys), hedge-cancellation semantics
+(losing reply discarded, ``on_done`` fires exactly once), plus the
+membership/drain/failover machinery end to end with real sockets —
+everything in one process so the suite stays fast.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.fleet import (AdaptiveDelay, FleetClient, FleetMember,
+                                  FleetRouter, HashRing, HedgedCall,
+                                  ReplicaGroup, health_score)
+from multiverso_tpu.fleet.hedge import HedgeBudget, HedgeScheduler
+
+KEYS = np.arange(20_000, dtype=np.int64)
+
+
+def _owners(ring, keys=KEYS):
+    members = ring.members
+    return [members[i] for i in ring.owner_indices(keys)]
+
+
+# ---------------------------------------------------------------------------
+# HashRing properties
+# ---------------------------------------------------------------------------
+def test_ring_deterministic_across_instances():
+    a = HashRing(["r2", "r0", "r1"])
+    b = HashRing(["r0", "r1", "r2"])     # order must not matter
+    assert _owners(a) == _owners(b)
+
+
+def test_ring_balance_reasonable():
+    ring = HashRing([f"r{i}" for i in range(5)])
+    counts = np.bincount(ring.owner_indices(KEYS), minlength=5)
+    assert counts.min() > 0.5 * KEYS.size / 5
+    assert counts.max() < 1.6 * KEYS.size / 5
+
+
+@pytest.mark.parametrize("n", [2, 4, 7])
+def test_ring_add_moves_about_one_over_n_plus_one(n):
+    before = HashRing([f"r{i}" for i in range(n)])
+    after = HashRing([f"r{i}" for i in range(n + 1)])
+    own_b, own_a = _owners(before), _owners(after)
+    moved = sum(1 for x, y in zip(own_b, own_a) if x != y)
+    ideal = KEYS.size / (n + 1)
+    # Minimal movement: within 1.6x of the consistent-hashing ideal —
+    # contiguous-offset routing would move ~half the keyspace.
+    assert moved < 1.6 * ideal, (moved, ideal)
+    # ...and every moved key moved TO the new member, nowhere else.
+    new = f"r{n}"
+    assert all(y == new for x, y in zip(own_b, own_a) if x != y)
+
+
+def test_ring_removal_reassigns_only_the_removed_members_keys():
+    members = [f"r{i}" for i in range(5)]
+    full = HashRing(members)
+    own_full = _owners(full)
+    reduced = HashRing(members)
+    assert reduced.remove("r2")
+    own_red = _owners(reduced)
+    for x, y in zip(own_full, own_red):
+        if x != "r2":
+            assert y == x          # survivor keys never move
+        else:
+            assert y != "r2"       # orphaned keys all found a new home
+
+
+def test_ring_partition_covers_all_positions():
+    ring = HashRing(["a", "b", "c"])
+    parts = ring.partition(KEYS[:999])
+    got = np.sort(np.concatenate(list(parts.values())))
+    np.testing.assert_array_equal(got, np.arange(999))
+
+
+def test_ring_membership_api():
+    ring = HashRing()
+    assert ring.add("x") and not ring.add("x")
+    assert "x" in ring and len(ring) == 1
+    assert ring.remove("x") and not ring.remove("x")
+
+
+# ---------------------------------------------------------------------------
+# Hedging: exactly-once, discard, failover, budget
+# ---------------------------------------------------------------------------
+def _async_attempt(delay_s, result):
+    def attempt(deliver):
+        t = threading.Timer(delay_s, deliver, args=(result,))
+        t.daemon = True
+        t.start()
+    return attempt
+
+
+def test_hedge_loser_discarded_on_done_fires_exactly_once():
+    sched = HedgeScheduler()
+    done = []
+    call = HedgedCall([_async_attempt(0.2, "slow-primary"),
+                       _async_attempt(0.01, "fast-hedge")],
+                      done.append, delay_ms=20, scheduler=sched)
+    call.launch()
+    time.sleep(0.4)                # both replies have landed by now
+    assert done == ["fast-hedge"]  # hedge won; loser discarded, one fire
+    sched.close()
+
+
+def test_hedge_primary_wins_when_fast():
+    sched = HedgeScheduler()
+    done = []
+    HedgedCall([_async_attempt(0.01, "primary"),
+                _async_attempt(0.01, "hedge")],
+               done.append, delay_ms=150, scheduler=sched).launch()
+    time.sleep(0.3)
+    assert done == ["primary"]
+    sched.close()
+
+
+def test_hedge_sync_raise_fails_over_immediately():
+    sched = HedgeScheduler()
+    done = []
+
+    def dead(deliver):
+        raise OSError("connect refused")
+
+    t0 = time.monotonic()
+    HedgedCall([dead, _async_attempt(0.01, "backup")], done.append,
+               delay_ms=10_000, scheduler=sched).launch()
+    time.sleep(0.3)
+    assert done == ["backup"]
+    assert time.monotonic() - t0 < 5  # did not wait for the hedge timer
+    sched.close()
+
+
+def test_hedge_all_attempts_fail_delivers_last_error():
+    sched = HedgeScheduler()
+    done = []
+    err = OSError("boom")
+    HedgedCall([_async_attempt(0.01, OSError("first")),
+                _async_attempt(0.01, err)],
+               done.append, delay_ms=5, scheduler=sched).launch()
+    time.sleep(0.4)
+    assert len(done) == 1 and isinstance(done[0], OSError)
+    sched.close()
+
+
+def test_hedge_budget_suppresses_when_dry():
+    budget = HedgeBudget(ratio=0.0, burst=0.0)    # never allows a hedge
+    sched = HedgeScheduler()
+    done = []
+    HedgedCall([_async_attempt(0.15, "slow-primary"),
+                _async_attempt(0.01, "hedge")],
+               done.append, delay_ms=10, scheduler=sched,
+               allow_hedge=budget.try_spend).launch()
+    time.sleep(0.4)
+    assert done == ["slow-primary"]   # hedge never fired: primary answered
+    sched.close()
+
+
+def test_hedge_budget_token_arithmetic():
+    budget = HedgeBudget(ratio=0.5, burst=1.0)
+    assert budget.try_spend()          # starts with the burst
+    assert not budget.try_spend()      # dry
+    budget.on_request()
+    assert not budget.try_spend()      # 0.5 tokens: still dry
+    budget.on_request()
+    assert budget.try_spend()          # 1.0 tokens: one hedge
+
+
+def test_adaptive_delay_tracks_p95():
+    d = AdaptiveDelay(floor_ms=1.0, ceil_ms=500.0, initial_ms=25.0,
+                      min_samples=10)
+    assert d.delay_ms() == 25.0        # no data yet
+    for _ in range(64):
+        d.observe(10.0)
+    assert 10.0 <= d.delay_ms() <= 20.0   # ~1.25 * p95
+
+
+# ---------------------------------------------------------------------------
+# Health + membership state machine (no sockets)
+# ---------------------------------------------------------------------------
+def test_health_score_shape():
+    idle = health_score({"queue_depth": 0, "inflight": 0,
+                         "max_queue": 64, "max_batch": 8,
+                         "replica_step": 5}, fleet_max_step=5)
+    busy = health_score({"queue_depth": 64, "inflight": 8,
+                         "max_queue": 64, "max_batch": 8,
+                         "replica_step": 5}, fleet_max_step=5)
+    stale = health_score({"queue_depth": 0, "inflight": 0,
+                          "max_queue": 64, "max_batch": 8,
+                          "replica_step": 1}, fleet_max_step=5)
+    draining = health_score({"draining": 1.0}, fleet_max_step=5)
+    assert idle == 1.0
+    assert 0.0 < busy < idle
+    assert 0.0 < stale < idle
+    assert draining == 0.0
+
+
+def test_replica_group_join_heartbeat_sweep():
+    group = ReplicaGroup(heartbeat_ms=20.0, liveness_misses=3)
+    reply = group.join("a", "127.0.0.1", 1111)
+    assert reply["ok"] and reply["heartbeat_ms"] == 20.0
+    group.join("b", "127.0.0.1", 2222)
+    v0 = group.version
+    assert group.member_ids() == ["a", "b"]
+    assert sorted(group.ring.members) == ["a", "b"]
+    # heartbeat for an unknown member asks it to rejoin
+    assert group.heartbeat("ghost", {})["directive"] == "rejoin"
+    # a drain directive is delivered exactly once
+    group.drain("a")
+    assert group.heartbeat("a", {})["directive"] == "drain"
+    assert group.heartbeat("a", {})["directive"] == "none"
+    # draining=1 removes from the ring, rejoin restores it
+    group.heartbeat("a", {"draining": 1.0})
+    assert group.ring.members == ("b",)
+    group.heartbeat("a", {"draining": 0.0, "drains_completed": 1.0})
+    assert sorted(group.ring.members) == ["a", "b"]
+    assert group.drains_completed("a") == 1
+    assert group.version > v0
+    # b stops heartbeating -> swept after the liveness horizon
+    deadline = time.monotonic() + 5
+    dead = []
+    while time.monotonic() < deadline and not dead:
+        group.heartbeat("a", {})
+        dead = group.sweep()
+        time.sleep(0.02)
+    assert dead == ["b"]
+    assert group.member_ids() == ["a"]
+
+
+def test_routing_payload_health_ranking():
+    group = ReplicaGroup(heartbeat_ms=50.0)
+    group.join("busy", "h", 1)
+    group.join("idle", "h", 2)
+    group.heartbeat("busy", {"queue_depth": 64, "inflight": 8,
+                             "max_queue": 64, "max_batch": 8})
+    group.heartbeat("idle", {"queue_depth": 0, "inflight": 0,
+                             "max_queue": 64, "max_batch": 8})
+    payload = group.routing_payload()
+    by_id = {m["id"]: m for m in payload["members"]}
+    assert by_id["idle"]["health"] > by_id["busy"]["health"]
+    from multiverso_tpu.fleet import RoutingTable
+    table = RoutingTable(payload)
+    assert table.ranked()[0] == "idle"
+    assert table.ranked(exclude=("idle",)) == ["busy"]
+
+
+def test_json_blob_codec_roundtrip():
+    from multiverso_tpu.parallel.net import pack_json_blob, unpack_json_blob
+    obj = {"id": "r0", "stats": {"queue_depth": 3.0}, "list": [1, 2]}
+    assert unpack_json_blob(pack_json_blob(obj)) == obj
+    with pytest.raises(IOError):
+        unpack_json_blob(np.frombuffer(b"not json", dtype=np.uint8))
+
+
+# ---------------------------------------------------------------------------
+# In-process fleet integration over real sockets
+# ---------------------------------------------------------------------------
+ROWS, COLS = 512, 8
+
+
+@pytest.fixture
+def fleet_env(mv_env):
+    """Router + two serving replicas (same seeded table) + members."""
+    import jax
+    from jax.sharding import Mesh
+
+    from multiverso_tpu.core.table import ServerStore
+    from multiverso_tpu.core.updater import get_updater
+    from multiverso_tpu.serving import ServingService, SparseLookupRunner
+
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(ROWS, COLS)).astype(np.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("server",))
+    services, members = [], []
+    router = FleetRouter(heartbeat_ms=40.0, liveness_misses=5, proxy=True)
+    for i in range(2):
+        store = ServerStore(f"fleet_t{i}", (ROWS, COLS), np.float32,
+                            get_updater(np.float32, "default"), mesh,
+                            num_workers=1, init_array=data.copy())
+        svc = ServingService()
+        svc.register_runner(SparseLookupRunner(store), buckets=(4, 8),
+                            max_batch=4, max_wait_ms=1.0)
+        svc.warmup()
+        services.append(svc)
+        members.append(FleetMember(router.address, svc,
+                                   member_id=f"r{i}").start())
+    deadline = time.monotonic() + 20
+    while len(router.group.member_ids()) < 2:
+        assert time.monotonic() < deadline, "members never joined"
+        time.sleep(0.02)
+    yield router, services, members, data
+    for m in members:
+        m.close()
+    for s in services:
+        s.close()
+    router.close()
+
+
+def test_fleet_client_lookup_parity(fleet_env):
+    router, services, members, data = fleet_env
+    cli = FleetClient(router.address)
+    try:
+        rows = np.asarray([3, 481, 77, 0, 511], np.int32)
+        got = cli.lookup(rows, deadline_ms=10_000, timeout=30)
+        np.testing.assert_array_equal(got, data[rows])
+        got = cli.lookup(rows, deadline_ms=10_000, split=True, timeout=30)
+        np.testing.assert_array_equal(got, data[rows])
+        # empty lookup keeps the real column shape
+        got = cli.lookup(np.zeros(0, np.int32), deadline_ms=10_000,
+                         timeout=30)
+        assert got.shape == (0, COLS)
+    finally:
+        cli.close()
+
+
+def test_fleet_router_proxy_serves_plain_clients(fleet_env):
+    router, services, members, data = fleet_env
+    from multiverso_tpu.serving import ServingClient
+    pc = ServingClient(*router.address)
+    try:
+        rows = np.asarray([1, 500, 42], np.int32)
+        got = pc.lookup(rows, deadline_ms=10_000, timeout=30)
+        np.testing.assert_array_equal(got, data[rows])
+    finally:
+        pc.close()
+
+
+def test_fleet_rolling_drain_zero_drops_under_load(fleet_env):
+    router, services, members, data = fleet_env
+    cli = FleetClient(router.address, refresh_s=0.05)
+    errors = []
+    stop = threading.Event()
+
+    def loader():
+        rng = np.random.default_rng(3)
+        while not stop.is_set():
+            rows = rng.integers(0, ROWS, 4).astype(np.int32)
+            try:
+                got = cli.lookup(rows, deadline_ms=10_000, timeout=30)
+                np.testing.assert_array_equal(got, data[rows])
+            except Exception as e:  # noqa: BLE001 - the assertion below
+                errors.append(e)    # reports every failure mode at once
+    t = threading.Thread(target=loader, daemon=True)
+    t.start()
+    try:
+        assert router.rolling_drain(timeout_s_per_member=30)
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        cli.close()
+    assert not errors, errors[:3]
+    # both members completed a full drain cycle
+    for mid in ("r0", "r1"):
+        assert router.group.drains_completed(mid) == 1
+
+
+def test_fleet_wire_drain_trigger(fleet_env):
+    """Operator path: Fleet_Drain over the wire starts a rolling drain;
+    completion is observable via the routing table's per-member
+    monotonic drains_completed."""
+    router, services, members, data = fleet_env
+    from multiverso_tpu.fleet import request_drain
+    ack = request_drain(router.address)
+    assert ack["started"] and ack["rolling"]
+    assert sorted(ack["members"]) == ["r0", "r1"]
+    deadline = time.monotonic() + 30
+    cli = FleetClient(router.address, refresh_s=0.05)
+    try:
+        while time.monotonic() < deadline:
+            table = {m["id"]: m for m in cli.refresh().members}
+            if all(m.get("drains_completed", 0) >= 1
+                   and not m.get("draining") for m in table.values()):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("wire-triggered rolling drain never "
+                                 "completed")
+        # unknown member is refused, not crashed
+        assert not request_drain(router.address,
+                                 member_id="ghost")["started"]
+    finally:
+        cli.close()
+
+
+def test_fleet_drain_runs_swap_fn(fleet_env):
+    router, services, members, data = fleet_env
+    swapped = threading.Event()
+    members[0].swap_fn = swapped.set
+    assert router.drain("r0", timeout_s=30)
+    assert swapped.is_set()
+
+
+def test_fleet_failover_masks_killed_replica(fleet_env):
+    router, services, members, data = fleet_env
+    cli = FleetClient(router.address, refresh_s=0.05)
+    try:
+        rows = np.asarray([9, 10, 11], np.int32)
+        np.testing.assert_array_equal(
+            cli.lookup(rows, deadline_ms=10_000, timeout=30), data[rows])
+        # hard-kill r1's serving socket + member agent (SIGKILL analog)
+        members[1].close()
+        services[1].close()
+        # every subsequent lookup still answers (failover masks the loss)
+        for _ in range(6):
+            np.testing.assert_array_equal(
+                cli.lookup(rows, deadline_ms=10_000, timeout=30),
+                data[rows])
+        # the sweep reaps the dead member within the liveness horizon
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                len(router.group.member_ids()) > 1:
+            time.sleep(0.05)
+        assert router.group.member_ids() == ["r0"]
+    finally:
+        cli.close()
+
+
+def test_replica_unavailable_error_is_typed(mv_env):
+    from multiverso_tpu.serving import (ReplicaUnavailableError,
+                                        ServingClient, connect_with_backoff)
+    t0 = time.monotonic()
+    with pytest.raises(ReplicaUnavailableError):
+        connect_with_backoff("127.0.0.1", 1, attempts=2,
+                             base_delay_s=0.01)
+    assert time.monotonic() - t0 < 5
+    # ...and it IS an OSError, so pre-fleet call sites keep working
+    assert issubclass(ReplicaUnavailableError, OSError)
+    with pytest.raises(OSError):
+        ServingClient("127.0.0.1", 1, connect_attempts=2)

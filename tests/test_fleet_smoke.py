@@ -1,0 +1,66 @@
+"""Tier-1 CPU smoke for the fleet serving fabric.
+
+Drives ``scripts/serve_bench.py --replicas 2 --dry-run`` end to end: an
+in-process FleetRouter, two replica SUBPROCESSES serving the same seeded
+synthetic table, and a hedged FleetClient — asserting the three fleet
+contracts the record carries:
+
+* routed lookups (affinity AND ring-split) are bitwise-equal to a direct
+  gather of the table (``parity_ok``),
+* a rolling drain of every replica mid-load completes with ZERO failed
+  requests,
+* the load window finishes with no request errors and a non-trivial
+  achieved QPS, and the record lands in BENCH_SERVE_HISTORY.jsonl so the
+  serving trend file grows with every bench run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BENCH = os.path.join(_REPO, "scripts", "serve_bench.py")
+
+
+def test_serve_bench_fleet_dry_run(tmp_path):
+    out = tmp_path / "BENCH_SERVE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--dry-run", "--replicas", "2",
+         f"--out={out}"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["benchmark"] == "serve_fleet_lookup"
+    assert line["replicas"] == 2
+
+    record = json.loads(out.read_text())
+    assert record["schema"] == "multiverso_tpu.bench_serve/v2"
+    assert record["replicas"] == 2
+
+    # Routed lookups bitwise-equal to the direct table gather.
+    assert record["parity_ok"] is True
+
+    # Rolling drain mid-load: completed, zero dropped requests.
+    drain = record["drill"]["drain"]
+    assert drain["completed"] is True
+    assert drain["failed_requests"] == 0
+
+    # The load window itself served cleanly.
+    assert record["n_error"] == 0
+    assert record["n_ok"] > 0
+    assert record["achieved_qps"] > 0
+    lat = record["latency_ms"]
+    assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+
+    # fleet.* metrics ride along with the record.
+    assert any(k.startswith("fleet.")
+               for k in record["serve_metrics"]["counters"])
+
+    # Every record appends to the serving trend file beside --out.
+    history = tmp_path / "BENCH_SERVE_HISTORY.jsonl"
+    assert history.exists()
+    entries = [json.loads(l) for l in history.read_text().splitlines()]
+    assert entries and entries[-1]["benchmark"] == "serve_fleet_lookup"

@@ -28,7 +28,8 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["benchmark"] == "serve_lookup"
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v1"
+    # v2: adds benchmark/n_error/error_rate + fleet fields (superset of v1)
+    assert record["schema"] == "multiverso_tpu.bench_serve/v2"
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
     assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]

@@ -89,8 +89,11 @@ def test_hot_swap_under_concurrent_gets(mv_env, tmp_path):
             except Exception as e:  # noqa: BLE001 - collect, don't die
                 errors.append(repr(e))
                 return
+            # Snapshot the dict: the main thread update()s it while we
+            # iterate, and a RuntimeError here would kill the reader
+            # UNCAUGHT — the torn-read assertion would pass vacuously.
             ok = any(np.array_equal(got, tab[keys])
-                     for tab in by_step.values())
+                     for tab in list(by_step.values()))
             if not ok:
                 errors.append(f"torn read for keys {keys.tolist()}")
                 return
