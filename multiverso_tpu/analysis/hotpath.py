@@ -13,7 +13,10 @@ time; every rule here statically rejects one mechanism of that tax:
 * ``missing-donation``     — an update step jitted without donation holds
   two copies of every table in HBM and forces a copy per step;
 * ``host-jnp-in-loop``     — jnp scalar/array constructors on host
-  control paths create a device round trip where numpy was meant.
+  control paths create a device round trip where numpy was meant;
+* ``span-in-traced-fn``    — telemetry ``span()``/``observe()`` inside a
+  traced body fires at TRACE time, not run time: the metric silently
+  stops measuring after the first compilation.
 """
 
 from __future__ import annotations
@@ -291,3 +294,90 @@ class HostJnpInLoop(Rule):
                     f"{name}() inside a host loop allocates on-device "
                     "per iteration — keep host-side state in numpy and "
                     "upload once")
+
+
+# Telemetry call targets whose execution inside a traced body is a silent
+# no-op after the first compilation (they run at TRACE time only).
+_TELEMETRY_SPAN_FNS = {
+    "multiverso_tpu.telemetry.span",
+    "multiverso_tpu.telemetry.spans.span",
+    "multiverso_tpu.telemetry.emit_span",
+    "multiverso_tpu.telemetry.spans.emit_span",
+}
+_TELEMETRY_METRIC_FACTORIES = {
+    "multiverso_tpu.telemetry.histogram",
+    "multiverso_tpu.telemetry.metrics.histogram",
+    "multiverso_tpu.telemetry.counter",
+    "multiverso_tpu.telemetry.metrics.counter",
+    "multiverso_tpu.telemetry.gauge",
+    "multiverso_tpu.telemetry.metrics.gauge",
+}
+_METRIC_METHODS = {"observe", "inc", "set"}
+
+
+@register
+class SpanInTracedFn(Rule):
+    id = "span-in-traced-fn"
+    severity = "error"
+    rationale = (
+        "telemetry span()/emit_span() and histogram observe() (counter "
+        "inc(), gauge set()) calls lexically inside a jit/shard_map-"
+        "traced function body execute at TRACE time only: after the "
+        "first compilation the metric never updates again — a silent "
+        "observability no-op that reads as 'this path is never slow'. "
+        "Time the traced call from the HOST side (wrap the call site, "
+        "not the body), or use jax.profiler annotations for device "
+        "regions.")
+
+    def _metric_receivers(self, ctx: FileContext) -> Set[str]:
+        """Names assigned from a telemetry metric factory anywhere in
+        the file (module attrs and locals alike): ``h = histogram(..)``
+        then ``h.observe(..)`` inside a traced body still fires."""
+        names: Set[str] = set()
+        for node in ctx.walk():
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            if astutil.resolve_name(node.value.func, ctx.aliases) \
+                    not in _TELEMETRY_METRIC_FACTORIES:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    names.add(t.attr)
+        return names
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        receivers = self._metric_receivers(ctx)
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            if not astutil.is_traced_context(node, ctx.traced):
+                continue
+            name = astutil.resolve_name(node.func, ctx.aliases)
+            if name in _TELEMETRY_SPAN_FNS:
+                yield self.finding(
+                    ctx, node,
+                    f"{name.rsplit('.', 1)[1]}() inside a traced "
+                    "function body fires at trace time, not run time — "
+                    "the span records exactly once, at compilation")
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute) or \
+                    fn.attr not in _METRIC_METHODS:
+                continue
+            recv = fn.value
+            direct = isinstance(recv, ast.Call) and \
+                astutil.resolve_name(recv.func, ctx.aliases) \
+                in _TELEMETRY_METRIC_FACTORIES
+            named = (isinstance(recv, ast.Name) and recv.id in receivers) \
+                or (isinstance(recv, ast.Attribute)
+                    and recv.attr in receivers)
+            if direct or named:
+                yield self.finding(
+                    ctx, node,
+                    f".{fn.attr}() on a telemetry metric inside a "
+                    "traced function body fires at trace time, not run "
+                    "time — the metric stops updating after the first "
+                    "compilation")

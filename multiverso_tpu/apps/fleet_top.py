@@ -1,0 +1,116 @@
+"""fleet_top: live cluster-wide serving metrics — `top` for the fleet.
+
+Pulls the router's versioned ``Fleet_Stats`` rollup (built from the
+compact metric snapshots every replica heartbeat already carries) and
+renders a refreshing per-replica table plus a fleet summary row:
+QPS, shed rate, queue depth, in-flight, stage-latency percentiles
+(total leg), SLO burn, drain cycles, and health — the numbers ROADMAP
+item 1's throughput work is tuned against, per replica instead of one
+aggregate histogram.
+
+    python -m multiverso_tpu.apps.fleet_top -fleet_router=127.0.0.1:7071
+    python -m multiverso_tpu.apps.fleet_top -fleet_router=... \\
+        -fleet_top_n=1            # one snapshot and exit (scripts, CI)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List
+
+from multiverso_tpu.apps._runner import fleet_config, run_app
+from multiverso_tpu.utils.configure import (define_double, define_int,
+                                            get_flag)
+from multiverso_tpu.utils.log import check, log
+
+define_double("fleet_top_interval", 1.0, "seconds between fleet_top "
+              "stats refreshes")
+define_int("fleet_top_n", 0, "number of refreshes before exiting "
+           "(0 = run until interrupted)")
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _fmt_ms(v: float) -> str:
+    return f"{v:9.2f}"
+
+
+def render_stats(stats: Dict, clear: bool = False) -> str:
+    """The fleet table as one string (pure function — unit-testable and
+    reused by the bench's --fleet-top embed)."""
+    lines: List[str] = []
+    if clear:
+        lines.append(_CLEAR.rstrip("\n"))
+    fleet = stats.get("fleet", {})
+    replicas = stats.get("replicas", {})
+    stamp = time.strftime("%H:%M:%S",
+                          time.localtime(stats.get("time_unix", 0)))
+    lines.append(f"fleet_top  v{stats.get('version', 0)}  {stamp}  "
+                 f"replicas={fleet.get('replicas', 0)}  "
+                 f"qps={fleet.get('qps', 0.0):.1f}  "
+                 f"shed={100 * fleet.get('shed_rate', 0.0):.2f}%  "
+                 f"slo_burn={fleet.get('slo_violations', 0)}")
+    header = (f"{'MEMBER':24s} {'HEALTH':>7s} {'QPS':>8s} {'SHED%':>7s} "
+              f"{'QUEUE':>6s} {'INFL':>5s} {'P50ms':>9s} {'P95ms':>9s} "
+              f"{'P99ms':>9s} {'SLO':>6s} {'DRAINS':>6s} {'STATE':>8s}")
+    lines.append(header)
+    for mid in sorted(replicas):
+        r = replicas[mid]
+        total = r.get("stages", {}).get("total", {})
+        state = "drain" if r.get("draining") else "up"
+        lines.append(
+            f"{mid[:24]:24s} {r.get('health', 0.0):7.3f} "
+            f"{r.get('qps', 0.0):8.1f} "
+            f"{100 * r.get('shed_rate', 0.0):7.2f} "
+            f"{r.get('queue_depth', 0.0):6.0f} "
+            f"{r.get('inflight', 0.0):5.0f} "
+            f"{_fmt_ms(total.get('p50', 0.0))} "
+            f"{_fmt_ms(total.get('p95', 0.0))} "
+            f"{_fmt_ms(total.get('p99', 0.0))} "
+            f"{r.get('slo_violations', 0):6d} "
+            f"{r.get('drains_completed', 0):6d} {state:>8s}")
+    ftotal = fleet.get("stages", {}).get("total", {})
+    lines.append(
+        f"{'FLEET':24s} {'':7s} {fleet.get('qps', 0.0):8.1f} "
+        f"{100 * fleet.get('shed_rate', 0.0):7.2f} "
+        f"{fleet.get('queue_depth', 0.0):6.0f} "
+        f"{fleet.get('inflight', 0.0):5.0f} "
+        f"{_fmt_ms(ftotal.get('p50', 0.0))} "
+        f"{_fmt_ms(ftotal.get('p95', 0.0))} "
+        f"{_fmt_ms(ftotal.get('p99', 0.0))} "
+        f"{fleet.get('slo_violations', 0):6d} "
+        f"{'':6s} {'n=%d' % fleet.get('replicas', 0):>8s}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    args = list(argv if argv is not None else sys.argv[1:])
+
+    def _body(remaining) -> int:
+        del remaining
+        from multiverso_tpu.fleet import fetch_fleet_stats
+        cfg = fleet_config()
+        check(cfg["router"] is not None,
+              "-fleet_router=host:port is required for fleet_top")
+        interval = max(0.1, float(get_flag("fleet_top_interval")))
+        n = int(get_flag("fleet_top_n"))
+        shown = 0
+        try:
+            while True:
+                stats = fetch_fleet_stats(cfg["router"])
+                # Clear only on live refresh: a single -fleet_top_n=1
+                # snapshot must stay pipeable (CI greps it).
+                log.raw("%s", render_stats(stats, clear=(n != 1)))
+                shown += 1
+                if n and shown >= n:
+                    return 0
+                time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+    return run_app(_body, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

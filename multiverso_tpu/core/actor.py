@@ -43,6 +43,9 @@ class MsgType(enum.IntEnum):
     # over the same framing. In the server range so to_server routing holds.
     Serve_Request = 21
     Serve_Reply = -21
+    Serve_Cancel = 22   # hedged-loser cancel: drop the request at admission
+    # (msg_id names the original request; best-effort, no reply of its own
+    # — a cancelled request answers its ORIGINAL msg_id with Reply_Error)
     Heartbeat = 40
     Heartbeat_Reply = -40
     # Fleet control plane (multiverso_tpu/fleet): replica-group membership
@@ -58,6 +61,8 @@ class MsgType(enum.IntEnum):
     Reply_Fleet_Leave = -45
     Fleet_Drain = 46        # operator-initiated rolling drain trigger
     Reply_Fleet_Drain = -46
+    Fleet_Stats = 47        # cluster-wide metric rollup pull (fleet_top)
+    Reply_Fleet_Stats = -47
     Reply_Error = -99   # server-side rejection (e.g. unknown table); wakes
     Exit = 99           # the waiter loudly instead of hanging a BSP wait
 

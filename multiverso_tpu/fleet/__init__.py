@@ -23,10 +23,10 @@ docs/OBSERVABILITY.md for the ``fleet.*`` metric catalog.
 """
 
 from multiverso_tpu.fleet.client import (FleetClient, RoutingTable,
-                                         request_drain)
+                                         fetch_fleet_stats, request_drain)
 from multiverso_tpu.fleet.hashring import HashRing
 from multiverso_tpu.fleet.health import (STAT_FIELDS, health_score,
-                                         local_stats)
+                                         local_stats, metrics_payload)
 from multiverso_tpu.fleet.hedge import (AdaptiveDelay, HedgedCall,
                                         HedgeScheduler)
 from multiverso_tpu.fleet.membership import (FleetMember, MemberInfo,
@@ -36,6 +36,6 @@ from multiverso_tpu.fleet.router import FleetRouter
 __all__ = [
     "AdaptiveDelay", "FleetClient", "FleetMember", "FleetRouter",
     "HashRing", "HedgeScheduler", "HedgedCall", "MemberInfo",
-    "ReplicaGroup", "RoutingTable", "STAT_FIELDS", "health_score",
-    "local_stats", "request_drain",
+    "ReplicaGroup", "RoutingTable", "STAT_FIELDS", "fetch_fleet_stats",
+    "health_score", "local_stats", "metrics_payload", "request_drain",
 ]

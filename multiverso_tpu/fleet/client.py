@@ -45,10 +45,24 @@ from multiverso_tpu.parallel.net import (pack_json_blob, recv_message,
 from multiverso_tpu.serving.client import (ReplicaUnavailableError,
                                            ServingClient,
                                            connect_with_backoff)
-from multiverso_tpu.telemetry import counter, histogram
+from multiverso_tpu.telemetry import counter, emit_span, histogram
+from multiverso_tpu.telemetry import context as trace_context
+from multiverso_tpu.telemetry.context import TraceContext
 from multiverso_tpu.utils.log import check, log
 
 _SUSPECT_TTL_S = 1.0    # local quarantine until the router confirms death
+
+_UNSET = object()       # "resolve the trace context here" sentinel
+
+
+def _resolve_root() -> Optional[TraceContext]:
+    """Root (or child-of-ambient) context for one logical fleet request.
+    The AMBIENT case is the router's data proxy: its fleet client must
+    continue the trace the proxied frame carried, not start a new one."""
+    cur = trace_context.current_context()
+    if cur is not None:
+        return trace_context.child_of(cur)
+    return trace_context.maybe_new_root()
 
 
 class RoutingTable:
@@ -148,6 +162,28 @@ class _GroupFeed:
         pass
 
 
+def fetch_fleet_stats(router: Tuple[str, int],
+                      timeout_s: float = 10.0) -> Dict:
+    """One ``Fleet_Stats`` pull: the router's versioned cluster-wide
+    metric rollup (per-replica QPS/shed/queue/stage percentiles + fleet
+    sums). The data feed behind ``apps/fleet_top.py`` and the bench's
+    rollup embed."""
+    sock = connect_with_backoff(*router, attempts=4,
+                                timeout_s=timeout_s)
+    try:
+        send_message(sock, Message(type=MsgType.Fleet_Stats, msg_id=1,
+                                   data=[pack_json_blob({})]))
+        reply = recv_message(sock)
+        if reply is None or not reply.data:
+            raise OSError("fleet router closed the stats channel")
+        if reply.type == MsgType.Reply_Error:
+            raise OSError("fleet router rejected stats pull: "
+                          + reply.data[0].tobytes().decode())
+        return unpack_json_blob(reply.data[0])
+    finally:
+        sock.close()
+
+
 def request_drain(router: Tuple[str, int],
                   member_id: Optional[str] = None,
                   timeout_s: float = 60.0) -> Dict:
@@ -211,6 +247,7 @@ class FleetClient:
         self._c_decode = counter("fleet.route.decode")
         self._c_sub = counter("fleet.route.subrequests")
         self._c_errors = counter("fleet.errors")
+        self._c_cancels = counter("fleet.hedge.cancelled")
         self.refresh()          # fail loudly if the router is unreachable
         self._refresh_s = float(refresh_s)
         self._refresher = threading.Thread(
@@ -298,8 +335,20 @@ class FleetClient:
         return self._delay.delay_ms()
 
     def _make_attempt(self, member_id: str, payload: np.ndarray,
-                      deadline_ms: float, runner_id: int) -> Callable:
+                      deadline_ms: float, runner_id: int, idx: int,
+                      root: Optional[TraceContext],
+                      state: Dict) -> Callable:
+        """One attempt launcher. ``state`` is the per-logical-request
+        bookkeeping shared with :meth:`request_async`: ``launched`` (how
+        many attempts fired — attempt spans read it to tag EVERY sibling
+        of a hedged pair ``hedge=1``, not just the duplicate) and
+        ``sent`` (attempt idx -> (member, msg_id) for loser cancels)."""
         def attempt(deliver):
+            ctx = trace_context.child_of(root, hedge=idx) \
+                if root is not None else None
+            t_a = time.monotonic()
+            with state["lock"]:
+                state["launched"] += 1
             try:
                 cli = self._conn(member_id)
             except ReplicaUnavailableError:
@@ -307,6 +356,13 @@ class FleetClient:
                 raise
 
             def cb(res):
+                if ctx is not None and ctx.sampled:
+                    with state["lock"]:
+                        hedged = state["launched"] > 1
+                    emit_span("fleet.attempt", ctx, t_a,
+                              (time.monotonic() - t_a) * 1e3,
+                              member=member_id, attempt=idx,
+                              hedge=1 if hedged else 0)
                 try:
                     deliver(res.wait(timeout=1.0))
                 except ReplicaUnavailableError as e:
@@ -316,20 +372,47 @@ class FleetClient:
                     deliver(e)          # belong to the hedge state machine
 
             try:
-                cli.request_async(payload, deadline_ms, runner_id,
-                                  on_done=cb)
+                res = cli.request_async(payload, deadline_ms, runner_id,
+                                        on_done=cb, trace_ctx=ctx)
+                with state["lock"]:
+                    state["sent"][idx] = (member_id, res.msg_id)
             except ReplicaUnavailableError:
                 self._suspect(member_id)
                 raise
         return attempt
 
+    def _cancel_losers(self, winner: int, state: Dict,
+                       runner_id: int) -> None:
+        """Server-side cancel for hedged losers: the winning reply is in,
+        so every OTHER launched attempt is asked to drop its copy at
+        admission instead of computing a discarded answer. Best-effort —
+        a dead conn or an already-dispatched batch just means the old
+        discard-at-client behavior."""
+        with state["lock"]:
+            losers = [(idx, m, mid) for idx, (m, mid)
+                      in state["sent"].items() if idx != winner]
+        for _idx, member_id, msg_id in losers:
+            with self._lock:
+                cli = self._conns.get(member_id)
+            if cli is None or cli.dead:
+                continue
+            cli.cancel(msg_id, runner_id)
+            self._c_cancels.inc()
+
     def request_async(self, payload: np.ndarray, pref: List[str],
                       on_done: Callable, deadline_ms: float = 100.0,
-                      runner_id: Optional[int] = None) -> None:
+                      runner_id: Optional[int] = None,
+                      trace_ctx=_UNSET) -> None:
         """Hedged dispatch of one payload along a replica preference
         list; ``on_done`` receives ``(values, clock)`` or an exception
-        instance, exactly once."""
+        instance, exactly once. This is the TRACE ROOT of a fleet
+        request unless ``trace_ctx`` hands one in (split lookups): one
+        ``fleet.request`` span per logical request, one ``fleet.attempt``
+        child per launched attempt (hedged duplicates are siblings
+        tagged ``hedge=1``), and the attempt context rides the wire so
+        replica-side spans parent under the attempt."""
         rid = self.runner_id if runner_id is None else int(runner_id)
+        root = _resolve_root() if trace_ctx is _UNSET else trace_ctx
         pref = self._candidates(pref)[:self.max_attempts]
         if not pref:
             on_done(ReplicaUnavailableError("fleet has no live replicas"))
@@ -337,21 +420,38 @@ class FleetClient:
         self._c_requests.inc()
         self._budget.on_request()
         t0 = time.monotonic()
+        state: Dict = {"lock": threading.Lock(), "launched": 0, "sent": {}}
 
         def done(result):
-            if isinstance(result, BaseException):
+            failed = isinstance(result, BaseException)
+            ms = (time.monotonic() - t0) * 1e3
+            if failed:
                 self._c_errors.inc()
             else:
-                ms = (time.monotonic() - t0) * 1e3
                 self._delay.observe(ms)
                 self._h_lat.observe(ms)
+            if root is not None:
+                # Tail exemplars: errors/sheds and slow requests record
+                # even when the head decision was "don't sample".
+                force = failed or ms > trace_context.slow_ms()
+                if failed:
+                    emit_span("fleet.request", root, t0, ms, force=force,
+                              outcome=type(result).__name__)
+                else:
+                    emit_span("fleet.request", root, t0, ms, force=force)
             on_done(result)
 
-        attempts = [self._make_attempt(m, payload, deadline_ms, rid)
-                    for m in pref]
+        def settled(winner: int, launched: int):
+            if winner >= 0 and launched > 1:
+                self._cancel_losers(winner, state, rid)
+
+        attempts = [self._make_attempt(m, payload, deadline_ms, rid, i,
+                                       root, state)
+                    for i, m in enumerate(pref)]
         HedgedCall(attempts, done, delay_ms=self._hedge_delay_ms(),
                    scheduler=self._sched, hedge=self._hedge_on,
-                   allow_hedge=self._budget.try_spend).launch()
+                   allow_hedge=self._budget.try_spend,
+                   on_settled=settled).launch()
 
     # -- lookups ------------------------------------------------------------
     def _affinity_pref(self, rows: np.ndarray,
@@ -383,6 +483,11 @@ class FleetClient:
             return
         parts = table.ring.partition(rows.astype(np.int64))
         self._c_sub.inc(len(parts))
+        # ONE trace for the whole split lookup: the sub-requests become
+        # fleet.request children of this fleet.lookup root, so a stitched
+        # trace shows the fan-out to every owner replica.
+        lroot = _resolve_root()
+        t0 = time.monotonic()
         state = {"remaining": len(parts), "out": None, "clock": None,
                  "done": False}
         state_lock = threading.Lock()
@@ -407,15 +512,22 @@ class FleetClient:
                         return
                     state["done"] = True
                     err = None
+            if lroot is not None:
+                ms = (time.monotonic() - t0) * 1e3
+                force = err is not None or ms > trace_context.slow_ms()
+                emit_span("fleet.lookup", lroot, t0, ms, force=force,
+                          parts=len(parts))
             on_done(err if err is not None
                     else (state["out"], state["clock"]))
 
         for member_id, pos in parts.items():
             pref = [member_id] + table.ranked(exclude=(member_id,))
+            sub_ctx = trace_context.child_of(lroot) \
+                if lroot is not None else None
             self.request_async(
                 rows[pos], pref,
                 lambda result, _pos=pos: sub_done(result, _pos),
-                deadline_ms, runner_id)
+                deadline_ms, runner_id, trace_ctx=sub_ctx)
 
     def lookup(self, rows, deadline_ms: float = 100.0,
                split: bool = False, timeout: Optional[float] = 30.0,

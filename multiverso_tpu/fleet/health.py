@@ -61,3 +61,68 @@ def local_stats(max_queue: int, max_batch: int) -> Dict[str, float]:
         "max_batch": float(max_batch),
         "drains_completed": 0.0,
     }
+
+
+# Stage-latency histograms every replica already maintains (batcher +
+# service; docs/OBSERVABILITY.md serve.* catalog) -> heartbeat snapshot
+# keys. The fleet rollup merges these count-weighted across replicas.
+STAGE_HISTOGRAMS = (
+    ("admit", "serve.latency.admit"),
+    ("batch", "serve.latency.batch"),
+    ("device", "serve.latency.device"),
+    ("reply", "serve.latency.reply"),
+    ("total", "serve.latency.total"),
+)
+
+
+def slo_violations(hist, threshold_ms: float) -> int:
+    """Observations above ``threshold_ms`` in a telemetry Histogram,
+    counted from the fixed log-2 buckets: every bucket whose LOWER edge
+    is >= the threshold counts whole (an under-count by at most the one
+    straddling bucket — a stable burn counter beats an optimistic one)."""
+    with hist._lock:
+        counts = list(hist._counts)
+    total = 0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        lower = 0.0 if i == 0 else hist.BOUNDS[i - 1]
+        if lower >= threshold_ms:
+            total += c
+    return total
+
+
+def metrics_payload() -> Dict:
+    """Compact per-replica metric snapshot shipped on every heartbeat —
+    the raw material of the router's cluster-wide rollup (``Fleet_Stats``
+    / ``fleet_top``). Counters are CUMULATIVE (the router differentiates
+    them into rates over its own clock); stage latencies ship as
+    p50/p95/p99 + count so the rollup can merge them count-weighted."""
+    from multiverso_tpu.telemetry import get_registry
+    from multiverso_tpu.utils.configure import get_flag
+    reg = get_registry()
+    try:
+        slo_ms = float(get_flag("serve_slo_ms"))
+    except Exception:  # noqa: BLE001 - flags not parsed (bare library use)
+        slo_ms = 50.0
+    shed = sum(reg.counter(f"serve.shed.{r}").value
+               for r in ("queue_full", "deadline", "oversize"))
+    stages: Dict[str, Dict] = {}
+    for key, name in STAGE_HISTOGRAMS:
+        h = reg.histogram(name)
+        snap = h.snapshot()
+        stages[key] = {"count": snap["count"], "p50": round(snap["p50"], 4),
+                       "p95": round(snap["p95"], 4),
+                       "p99": round(snap["p99"], 4)}
+    return {
+        "requests": reg.counter("serve.requests").value,
+        "replies": reg.counter("serve.replies").value,
+        "shed": shed,
+        "cancelled": reg.counter("serve.cancelled").value,
+        "queue_depth": float(reg.gauge("serve.queue_depth").last),
+        "inflight": float(reg.gauge("serve.inflight").last),
+        "slo_ms": slo_ms,
+        "slo_violations": slo_violations(
+            reg.histogram("serve.latency.total"), slo_ms),
+        "stages": stages,
+    }

@@ -252,10 +252,15 @@ class HedgedCall:
     def __init__(self, attempts: List[Callable], on_done: Callable,
                  delay_ms: float, scheduler: Optional[HedgeScheduler] = None,
                  hedge: bool = True,
-                 allow_hedge: Optional[Callable[[], bool]] = None):
+                 allow_hedge: Optional[Callable[[], bool]] = None,
+                 on_settled: Optional[Callable[[int, int], None]] = None):
         check(len(attempts) >= 1, "hedged call needs at least one attempt")
         self._attempts = attempts
         self._on_done = on_done
+        # Fires exactly once right after completion with (winner_idx,
+        # launched) — winner_idx -1 when every attempt failed. The fleet
+        # client's hook for cancelling hedged LOSERS server-side.
+        self._on_settled = on_settled
         self._delay_s = max(0.0, float(delay_ms)) / 1e3
         self._sched = scheduler or default_scheduler()
         self._hedge = bool(hedge) and len(attempts) > 1
@@ -328,10 +333,18 @@ class HedgedCall:
                     self._metrics.wasted.inc()
             if (self._done or fire_next) and self._timer is not None:
                 self._timer.cancel()
+            launched = self._launched
+            winner = -1 if failed else idx
         if fire_next:
             self._launch_next(via_timer=False, via_failover=True)
             return
         if complete:
+            if self._on_settled is not None:
+                try:
+                    self._on_settled(winner, launched)
+                except Exception as e:  # noqa: BLE001 - a cancel-hook
+                    log.error("hedged call: on_settled failed: %s", e)
+                    # failure must not cost the caller its result
             try:
                 self._on_done(result)
             except Exception as e:  # noqa: BLE001 - downstream callback

@@ -24,6 +24,7 @@ rolling fleet upgrade drops zero requests.
 
 from __future__ import annotations
 
+import collections
 import os
 import socket
 import threading
@@ -32,7 +33,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from multiverso_tpu.core.actor import Message, MsgType
 from multiverso_tpu.fleet.hashring import HashRing
-from multiverso_tpu.fleet.health import STAT_FIELDS, health_score, local_stats
+from multiverso_tpu.fleet.health import (STAT_FIELDS, health_score,
+                                         local_stats, metrics_payload)
 from multiverso_tpu.parallel.net import (pack_json_blob, recv_message,
                                          send_message, unpack_json_blob)
 from multiverso_tpu.telemetry import counter, gauge, span
@@ -43,7 +45,12 @@ class MemberInfo:
     """Router-side record of one replica."""
 
     __slots__ = ("id", "host", "port", "stats", "last_seen", "joined_at",
-                 "directive")
+                 "directive", "metrics", "history")
+
+    #: Rate window: counter deltas are differentiated over the oldest
+    #: retained sample within this horizon — long enough to smooth
+    #: heartbeat jitter, short enough that fleet_top tracks load shifts.
+    RATE_WINDOW_S = 5.0
 
     def __init__(self, member_id: str, host: str, port: int):
         self.id = member_id
@@ -53,6 +60,37 @@ class MemberInfo:
         self.last_seen = time.monotonic()
         self.joined_at = time.monotonic()
         self.directive = "none"
+        #: Latest compact metric snapshot from the heartbeat ({} until
+        #: the first metrics-bearing beat arrives).
+        self.metrics: Dict = {}
+        #: (t_monotonic, requests, replies, shed) samples for rates.
+        self.history: "collections.deque" = collections.deque(maxlen=64)
+
+    def observe_metrics(self, metrics: Dict, now: float) -> None:
+        self.metrics = metrics
+        self.history.append((now, float(metrics.get("requests", 0)),
+                             float(metrics.get("replies", 0)),
+                             float(metrics.get("shed", 0))))
+        # Keep at least TWO samples even when the heartbeat interval
+        # exceeds the window — rates() needs a baseline, and a sparse
+        # heartbeat must degrade to "rate over one beat", not to zeros.
+        while len(self.history) > 2 and now - self.history[0][0] \
+                > self.RATE_WINDOW_S:
+            self.history.popleft()
+
+    def rates(self) -> Dict[str, float]:
+        """QPS / shed-rate over the retained window (zeros until two
+        samples exist — rates need a baseline, not a guess)."""
+        if len(self.history) < 2:
+            return {"qps": 0.0, "request_rate": 0.0, "shed_rate": 0.0}
+        t0, req0, rep0, shed0 = self.history[0]
+        t1, req1, rep1, shed1 = self.history[-1]
+        dt = max(t1 - t0, 1e-6)
+        d_req = max(req1 - req0, 0.0)
+        d_shed = max(shed1 - shed0, 0.0)
+        return {"qps": round(max(rep1 - rep0, 0.0) / dt, 3),
+                "request_rate": round(d_req / dt, 3),
+                "shed_rate": round(d_shed / max(d_req + d_shed, 1.0), 5)}
 
     @property
     def draining(self) -> bool:
@@ -79,6 +117,7 @@ class ReplicaGroup:
         self._lock = threading.Lock()
         self._members: Dict[str, MemberInfo] = {}
         self._version = 0
+        self._stats_seq = 0     # bumps per metrics-bearing heartbeat
         self._ring = HashRing((), vnodes=self.vnodes)
         self._g_members = gauge("fleet.members")
         self._g_version = gauge("fleet.ring_version")
@@ -101,7 +140,8 @@ class ReplicaGroup:
                     "heartbeat_ms": self.heartbeat_ms,
                     "liveness_misses": self.liveness_misses}
 
-    def heartbeat(self, member_id: str, stats: Dict[str, float]) -> Dict:
+    def heartbeat(self, member_id: str, stats: Dict[str, float],
+                  metrics: Optional[Dict] = None) -> Dict:
         with self._lock:
             info = self._members.get(member_id)
             self._c_heartbeats.inc()
@@ -113,6 +153,9 @@ class ReplicaGroup:
             was_draining = info.draining
             info.stats = {k: float(stats.get(k, 0.0)) for k in STAT_FIELDS}
             info.last_seen = time.monotonic()
+            if metrics:
+                info.observe_metrics(dict(metrics), info.last_seen)
+                self._stats_seq += 1
             directive = info.directive
             # Directive delivery is the TCP reply — clear it now. A
             # sub-heartbeat drain (quiesce + warm finish before the next
@@ -218,6 +261,77 @@ class ReplicaGroup:
             } for m in members],
         }
 
+    def stats_payload(self) -> Dict:
+        """Versioned CLUSTER-WIDE metric rollup for ``Fleet_Stats``
+        (fleet_top, benches): per-replica rates + stage percentiles from
+        the heartbeat metric snapshots, and a fleet summary whose
+        counters/rates are exact SUMS of the per-replica records (the
+        tier-1 smoke asserts the sums match) with stage percentiles
+        merged count-weighted — the same documented approximation the
+        telemetry report CLI uses."""
+        with self._lock:
+            members = list(self._members.values())
+            version = self._stats_seq
+        max_step = max([m.step for m in members], default=-1.0)
+        per: Dict[str, Dict] = {}
+        for m in members:
+            met, rates = m.metrics, m.rates()
+            per[m.id] = {
+                "host": m.host, "port": m.port,
+                "health": round(health_score(m.stats, max_step), 6),
+                "draining": m.draining,
+                "drains_completed": m.drains_completed,
+                "qps": rates["qps"],
+                "request_rate": rates["request_rate"],
+                "shed_rate": rates["shed_rate"],
+                "requests": int(met.get("requests", 0)),
+                "replies": int(met.get("replies", 0)),
+                "shed": int(met.get("shed", 0)),
+                "cancelled": int(met.get("cancelled", 0)),
+                "queue_depth": float(met.get("queue_depth", 0.0)),
+                "inflight": float(met.get("inflight", 0.0)),
+                "slo_ms": float(met.get("slo_ms", 0.0)),
+                "slo_violations": int(met.get("slo_violations", 0)),
+                "stages": dict(met.get("stages", {})),
+            }
+        fleet: Dict = {
+            "replicas": len(per),
+            "qps": round(sum(p["qps"] for p in per.values()), 3),
+            "request_rate": round(sum(p["request_rate"]
+                                      for p in per.values()), 3),
+            "requests": sum(p["requests"] for p in per.values()),
+            "replies": sum(p["replies"] for p in per.values()),
+            "shed": sum(p["shed"] for p in per.values()),
+            "cancelled": sum(p["cancelled"] for p in per.values()),
+            "queue_depth": round(sum(p["queue_depth"]
+                                     for p in per.values()), 3),
+            "inflight": round(sum(p["inflight"] for p in per.values()), 3),
+            "slo_violations": sum(p["slo_violations"]
+                                  for p in per.values()),
+        }
+        total = fleet["requests"] + fleet["shed"]
+        fleet["shed_rate"] = round(fleet["shed"] / total, 5) if total \
+            else 0.0
+        stages: Dict[str, Dict] = {}
+        for p in per.values():
+            for key, s in p["stages"].items():
+                agg = stages.setdefault(key, {"count": 0, "_wp": [0.0] * 3})
+                n = int(s.get("count", 0))
+                agg["count"] += n
+                for i, q in enumerate(("p50", "p95", "p99")):
+                    agg["_wp"][i] += float(s.get(q, 0.0)) * n
+        for agg in stages.values():
+            n = max(agg["count"], 1)
+            agg["p50"], agg["p95"], agg["p99"] = \
+                (round(w / n, 4) for w in agg.pop("_wp"))
+        fleet["stages"] = stages
+        return {"schema": "multiverso_tpu.fleet_stats/v1",
+                "version": version,
+                "time_unix": time.time(),
+                "heartbeat_ms": self.heartbeat_ms,
+                "replicas": per,
+                "fleet": fleet}
+
 
 class FleetMember:
     """Replica-side membership agent + drain lifecycle executor.
@@ -304,7 +418,10 @@ class FleetMember:
                 stats["draining"] = 1.0 if self._drain_active else 0.0
                 stats["drains_completed"] = float(self._drains_done)
                 reply = self._rpc(MsgType.Fleet_Heartbeat, {
-                    "id": self.member_id, "stats": stats})
+                    "id": self.member_id, "stats": stats,
+                    # Compact metric snapshot riding every beat: the
+                    # router's Fleet_Stats rollup is built from these.
+                    "metrics": metrics_payload()})
                 directive = reply.get("directive", "none")
                 if directive == "drain":
                     self._begin_drain()

@@ -38,8 +38,8 @@ from multiverso_tpu.core.actor import Message, MsgType
 from multiverso_tpu.fleet.membership import ReplicaGroup
 from multiverso_tpu.parallel.net import (pack_json_blob, pack_serve_payload,
                                          recv_message, send_message,
-                                         unpack_json_blob)
-from multiverso_tpu.telemetry import counter, gauge, span
+                                         unpack_json_blob, unpack_trace_ctx)
+from multiverso_tpu.telemetry import activate, counter, gauge, span
 from multiverso_tpu.utils.log import check, log
 
 
@@ -69,6 +69,7 @@ class FleetRouter:
         self._g_conns = gauge("fleet.router.connections")
         self._c_proxied = counter("fleet.router.proxied")
         self._c_route_pulls = counter("fleet.router.route_pulls")
+        self._c_stats_pulls = counter("fleet.router.stats_pulls")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="fleet-accept", daemon=True)
         self._accept_thread.start()
@@ -133,13 +134,18 @@ class FleetRouter:
         elif msg.type == MsgType.Fleet_Heartbeat:
             req = unpack_json_blob(msg.data[0])
             reply = self.group.heartbeat(str(req["id"]),
-                                         dict(req.get("stats", {})))
+                                         dict(req.get("stats", {})),
+                                         req.get("metrics"))
             self._reply_json(conn, msg, MsgType.Reply_Fleet_Heartbeat,
                              reply)
         elif msg.type == MsgType.Fleet_Route:
             self._c_route_pulls.inc()
             self._reply_json(conn, msg, MsgType.Reply_Fleet_Route,
                              self.group.routing_payload())
+        elif msg.type == MsgType.Fleet_Stats:
+            self._c_stats_pulls.inc()
+            self._reply_json(conn, msg, MsgType.Reply_Fleet_Stats,
+                             self.group.stats_payload())
         elif msg.type == MsgType.Fleet_Leave:
             req = unpack_json_blob(msg.data[0])
             self._reply_json(conn, msg, MsgType.Reply_Fleet_Leave,
@@ -159,6 +165,13 @@ class FleetRouter:
         payload = np.asarray(msg.data[0])
         deadline_ms = float(msg.data[1][0]) if len(msg.data) > 1 \
             and msg.data[1].size else 100.0
+        # A trace context on the proxied frame continues through the
+        # embedded fleet client: the proxy hop and every replica span
+        # parent under the ORIGINAL client's trace, not a router-local
+        # one — that is what makes "where did this request spend its
+        # time" answerable across all three processes.
+        wire_ctx = unpack_trace_ctx(msg.data[2]) if len(msg.data) > 2 \
+            else None
         self._c_proxied.inc()
         fleet = self._proxy()
 
@@ -172,7 +185,8 @@ class FleetRouter:
                           *pack_serve_payload(np.asarray(values))]
             self._send(_conn, reply)
 
-        with span("fleet.proxy", runner=msg.table_id):
+        with activate(wire_ctx), \
+                span("fleet.proxy", runner=msg.table_id):
             if msg.table_id in self._lookup_runners:
                 fleet.lookup_async(payload, relay, deadline_ms,
                                    runner_id=msg.table_id)

@@ -160,6 +160,25 @@ def unpack_serve_payload(blobs: List[np.ndarray]) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Trace-context codec (multiverso_tpu/telemetry/context.py). A request's
+# distributed trace identity rides the same framing as one extra uint64[5]
+# blob on Serve_Request ([trace_hi, trace_lo, span, parent, flags]); an
+# absent or malformed blob simply means "no context" — tracing must never
+# fail the request it annotates, and peers without the blob interoperate.
+# ---------------------------------------------------------------------------
+def pack_trace_ctx(ctx) -> np.ndarray:
+    """TraceContext -> uint64[5] wire blob."""
+    from multiverso_tpu.telemetry.context import to_wire
+    return to_wire(ctx)
+
+
+def unpack_trace_ctx(blob):
+    """uint64[5] wire blob -> TraceContext (None on anything malformed)."""
+    from multiverso_tpu.telemetry.context import from_wire
+    return from_wire(blob)
+
+
+# ---------------------------------------------------------------------------
 # Fleet control-plane payload codec (multiverso_tpu/fleet). Membership and
 # routing-table exchange is low-rate structured control traffic — it rides
 # the same length-prefixed blob framing as everything else, as one uint8

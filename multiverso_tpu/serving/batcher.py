@@ -28,15 +28,17 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from multiverso_tpu.telemetry import counter, gauge, histogram, span
+from multiverso_tpu.telemetry import (child_of, counter, current_context,
+                                      emit_span, gauge, histogram, span)
+from multiverso_tpu.telemetry.context import TraceContext
 from multiverso_tpu.utils.log import check, log
 
 
 class ShedError(RuntimeError):
     """Request rejected: admission control shed it or its deadline passed
     before service. Carries ``reason`` in {"queue_full", "deadline",
-    "oversize", "malformed", "closed"} ("server" client-side, when the
-    reason string arrived over the wire)."""
+    "oversize", "malformed", "cancelled", "closed"} ("server"
+    client-side, when the reason string arrived over the wire)."""
 
     def __init__(self, reason: str, detail: str = ""):
         super().__init__(f"request shed ({reason})"
@@ -70,11 +72,18 @@ class ServeRequest:
     """One queued request. ``on_done`` receives either the result row
     (runner-sliced) or a :class:`ShedError`; it runs on the batcher worker
     thread and must be cheap (hand the bytes to an IO layer, set an
-    event)."""
+    event). ``ctx`` is the trace context active at submission — the
+    batcher worker emits this request's per-stage spans under it (the
+    submit thread's thread-local stack does not reach the worker).
+    ``cancelled`` is set by :meth:`DynamicBatcher.cancel` (hedged-loser
+    server-side cancel); a cancelled request is dropped at batch
+    formation instead of spending device time on a discarded answer."""
     payload: np.ndarray
     deadline: float                      # absolute time.monotonic()
     t_submit: float
     on_done: Callable[[object], None]
+    ctx: Optional[TraceContext] = None
+    cancelled: bool = False
 
 
 class _Future:
@@ -125,6 +134,7 @@ class DynamicBatcher:
         self._c_shed_full = counter("serve.shed.queue_full")
         self._c_shed_deadline = counter("serve.shed.deadline")
         self._c_shed_oversize = counter("serve.shed.oversize")
+        self._c_cancelled = counter("serve.cancelled")
         self._h_admit = histogram("serve.latency.admit")
         self._h_batch = histogram("serve.latency.batch")
         self._h_device = histogram("serve.latency.device")
@@ -142,9 +152,12 @@ class DynamicBatcher:
         return fut
 
     def submit_callback(self, payload: np.ndarray, deadline_ms: float,
-                        on_done: Callable[[object], None]) -> None:
+                        on_done: Callable[[object], None]
+                        ) -> Optional[ServeRequest]:
         """Admission-controlled enqueue; sheds synchronously (via
-        ``on_done``) when the request cannot be admitted."""
+        ``on_done``) when the request cannot be admitted. Returns the
+        admitted request as a CANCEL TOKEN for :meth:`cancel` (None when
+        the request was shed at admission)."""
         now = time.monotonic()
         payload = np.atleast_1d(np.asarray(payload))
         if payload.ndim != 1:
@@ -154,16 +167,17 @@ class DynamicBatcher:
             on_done(ShedError("malformed",
                               f"payload must be 1-D, got shape "
                               f"{payload.shape}"))
-            return
+            return None
         if self.ladder.pick(payload.shape[0]) is None:
             self._c_shed_oversize.inc()
             on_done(ShedError("oversize",
                               f"payload length {payload.shape[0]} exceeds "
                               f"largest bucket {self.ladder.max}"))
-            return
+            return None
         req = ServeRequest(payload=payload,
                            deadline=now + max(deadline_ms, 0.0) / 1e3,
-                           t_submit=now, on_done=on_done)
+                           t_submit=now, on_done=on_done,
+                           ctx=current_context())
         shed: List[Tuple[ServeRequest, ShedError]] = []
         with self._cv:
             if not self._running:
@@ -174,6 +188,28 @@ class DynamicBatcher:
                 self._cv.notify()
         for victim, err in shed:
             victim.on_done(err)
+        return None if any(v is req for v, _ in shed) else req
+
+    def cancel(self, req: ServeRequest) -> bool:
+        """Server-side hedged-loser cancel: drop ``req`` at admission if
+        it is still queued (delivering ``ShedError("cancelled")`` so the
+        waiter/inflight bookkeeping completes), or mark it so batch
+        formation skips it. Returns True when the request will NOT reach
+        the device; False when it already has (too late — the normal
+        reply wins and the client discards it)."""
+        with self._cv:
+            req.cancelled = True
+            try:
+                self._queue.remove(req)
+                removed = True
+                self._g_depth.set(len(self._queue))
+            except ValueError:
+                removed = False
+        if removed:
+            self._c_cancelled.inc()
+            self._safe_done(req, ShedError("cancelled",
+                                           "hedged loser cancelled"))
+        return removed
 
     def _admit_locked(self, req: ServeRequest, now: float,
                       shed: List[Tuple[ServeRequest, ShedError]]) -> None:
@@ -262,7 +298,13 @@ class DynamicBatcher:
         now = time.monotonic()
         live: List[ServeRequest] = []
         for r in batch:
-            if r.deadline < now:
+            if r.cancelled:
+                # Hedged loser whose cancel raced the pop: still before
+                # the device — dropping it here is the whole point.
+                self._c_cancelled.inc()
+                self._safe_done(r, ShedError("cancelled",
+                                             "hedged loser cancelled"))
+            elif r.deadline < now:
                 self._c_shed_deadline.inc()
                 self._safe_done(r, ShedError("deadline",
                                              "expired while queued"))
@@ -306,7 +348,20 @@ class DynamicBatcher:
                                              f"runner error: {e}"))
             return
         self._c_batches.inc()
-        self._h_device.observe((time.monotonic() - t1) * 1e3)
+        t2 = time.monotonic()
+        self._h_device.observe((t2 - t1) * 1e3)
+        for r in batch:
+            # Per-request stage spans for sampled traces: where did THIS
+            # request wait (admit), pad (batch-form), and compute
+            # (device)? Unsampled/uncontexted requests skip at the flag
+            # check — the emission cost rides only on sampled exemplars.
+            if r.ctx is not None and r.ctx.sampled:
+                emit_span("serve.admit_wait", child_of(r.ctx), r.t_submit,
+                          (t0 - r.t_submit) * 1e3)
+                emit_span("serve.batch_form", child_of(r.ctx), t0,
+                          (t1 - t0) * 1e3, bucket=bucket, size=len(batch))
+                emit_span("serve.device", child_of(r.ctx), t1,
+                          (t2 - t1) * 1e3, bucket=bucket)
         for i, r in enumerate(batch):
             try:
                 result = self.runner.slice_result(out, i, int(lengths[i]))

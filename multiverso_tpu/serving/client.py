@@ -27,9 +27,13 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from multiverso_tpu.core.actor import Message, MsgType
-from multiverso_tpu.parallel.net import (recv_message, send_message,
+from multiverso_tpu.parallel.net import (pack_trace_ctx, recv_message,
+                                         send_message,
                                          unpack_serve_payload)
 from multiverso_tpu.serving.batcher import ShedError
+from multiverso_tpu.telemetry import context as trace_context
+from multiverso_tpu.telemetry import emit_span
+from multiverso_tpu.telemetry.context import TraceContext
 from multiverso_tpu.utils.log import check, log
 
 
@@ -74,13 +78,16 @@ class ServeResult:
     lost connection alike); a callback added after completion fires
     immediately on the caller's thread."""
 
-    __slots__ = ("event", "slot", "_callbacks", "_cb_lock")
+    __slots__ = ("event", "slot", "_callbacks", "_cb_lock", "msg_id")
 
     def __init__(self):
         self.event = threading.Event()
         self.slot: List[object] = []
         self._callbacks: List[Callable[["ServeResult"], None]] = []
         self._cb_lock = threading.Lock()
+        #: Wire id of the request this result waits on — what
+        #: :meth:`ServingClient.cancel` takes to cancel a hedged loser.
+        self.msg_id = -1
 
     def add_callback(self, fn: Callable[["ServeResult"], None]) -> None:
         with self._cb_lock:
@@ -117,6 +124,26 @@ class ServeResult:
         return values, clock
 
 
+def _emit_client_span(res: "ServeResult", ctx: TraceContext,
+                      t_send: float) -> None:
+    """Root-span emission for a plain (fleet-less) client request —
+    fires on the reader thread at completion. Unsampled requests record
+    only when the outcome is a tail exemplar (shed / lost connection /
+    slower than ``-telemetry_slow_ms``)."""
+    dur_ms = (time.monotonic() - t_send) * 1e3
+    outcome = ""
+    if not res.slot:
+        outcome = "error"
+    elif res.slot[0].type == MsgType.Reply_Error:
+        outcome = "shed"
+    force = bool(outcome) or dur_ms > trace_context.slow_ms()
+    if outcome:
+        emit_span("serve.client", ctx, t_send, dur_ms, force=force,
+                  outcome=outcome)
+    else:
+        emit_span("serve.client", ctx, t_send, dur_ms, force=force)
+
+
 class ServingClient:
     """One persistent connection; thread-safe concurrent requests."""
 
@@ -148,18 +175,42 @@ class ServingClient:
                       deadline_ms: float = 100.0,
                       runner_id: int = 0,
                       on_done: Optional[Callable[[ServeResult], None]]
-                      = None) -> ServeResult:
+                      = None,
+                      trace_ctx: Optional[TraceContext] = None
+                      ) -> ServeResult:
         """``on_done`` (optional) fires on the reader thread at completion
         — success, server error, and lost connection alike — so a fleet
-        client or proxy can hedge/relay without a thread per request."""
+        client or proxy can hedge/relay without a thread per request.
+
+        Trace context: an explicit ``trace_ctx`` (fleet attempts) or the
+        thread's current context propagates to the server as one extra
+        wire blob; with neither, this client IS the trace root — it draws
+        the head sampling decision and records a ``serve.client`` span at
+        completion (force-recorded for shed/error/slow outcomes even
+        when unsampled: the tail exemplars)."""
         if self._dead:
             raise ReplicaUnavailableError(
                 "connection to serving service is closed")
+        ctx = trace_ctx
+        owns_root = False
+        if ctx is None:
+            ctx = trace_context.current_context()
+            if ctx is None:
+                ctx = trace_context.maybe_new_root()
+                owns_root = ctx is not None
+        data = [np.ascontiguousarray(payload),
+                np.asarray([deadline_ms], dtype=np.float64)]
+        if ctx is not None:
+            data.append(pack_trace_ctx(ctx))
         msg = Message(type=MsgType.Serve_Request, table_id=runner_id,
-                      msg_id=self._next_msg_id(),
-                      data=[np.ascontiguousarray(payload),
-                            np.asarray([deadline_ms], dtype=np.float64)])
+                      msg_id=self._next_msg_id(), data=data)
         result = ServeResult()
+        result.msg_id = msg.msg_id
+        if owns_root:
+            t_send = time.monotonic()
+            result.add_callback(
+                lambda res, _ctx=ctx, _t=t_send: _emit_client_span(
+                    res, _ctx, _t))
         if on_done is not None:
             result.add_callback(on_done)
         with self._waiters_lock:
@@ -173,6 +224,20 @@ class ServingClient:
             raise ReplicaUnavailableError(
                 f"send to serving service failed: {e}") from e
         return result
+
+    def cancel(self, msg_id: int, runner_id: int = 0) -> None:
+        """Best-effort server-side cancel of an in-flight request (the
+        hedged-loser path): the server drops it at admission if it has
+        not reached the device. No reply of its own — a successfully
+        cancelled request completes its waiter with
+        ``ShedError("cancelled")`` via the original msg_id."""
+        msg = Message(type=MsgType.Serve_Cancel, table_id=runner_id,
+                      msg_id=msg_id, data=[])
+        try:
+            with self._send_lock:
+                send_message(self._sock, msg)
+        except OSError:
+            pass    # dead conn: the waiter completes via the read loop
 
     def lookup(self, keys, deadline_ms: float = 100.0,
                runner_id: int = 0,

@@ -23,15 +23,16 @@ from __future__ import annotations
 import socket
 import threading
 import time
-from typing import Dict, Optional, Sequence
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
 from multiverso_tpu.core.actor import Message, MsgType
 from multiverso_tpu.parallel.net import (pack_serve_payload, recv_message,
-                                         send_message)
+                                         send_message, unpack_trace_ctx)
 from multiverso_tpu.serving.batcher import DynamicBatcher, ShedError
-from multiverso_tpu.telemetry import counter, gauge, histogram, span
+from multiverso_tpu.telemetry import (activate, child_of, counter, emit_span,
+                                      gauge, histogram)
 from multiverso_tpu.utils.log import check, log
 
 
@@ -56,8 +57,17 @@ class ServingService:
         self._listener.listen(64)
         self.address = self._listener.getsockname()
         self._conns: Dict[socket.socket, threading.Lock] = {}
+        # In-flight requests by (conn identity, msg_id): the lookup table
+        # Serve_Cancel needs to reach a queued request's cancel token.
+        # Entries are popped in on_done, which the batcher fires exactly
+        # once per admitted request — the map is bounded by true inflight.
+        self._inflight: Dict[Tuple[int, int],
+                             Tuple[DynamicBatcher, object]] = {}
+        self._inflight_lock = threading.Lock()
         self._g_conns = gauge("serve.connections")
         self._c_replies = counter("serve.replies")
+        self._c_cancel_req = counter("serve.cancel.requests")
+        self._c_cancel_miss = counter("serve.cancel.miss")
         self._h_reply = histogram("serve.latency.reply")
         self._h_total = histogram("serve.latency.total")
         self._accept_thread = threading.Thread(
@@ -139,6 +149,9 @@ class ServingService:
                     break
                 if msg is None:
                     break
+                if msg.type == MsgType.Serve_Cancel:
+                    self._cancel(conn, msg)
+                    continue
                 if msg.type != MsgType.Serve_Request:
                     self._reply_error(conn, msg, "unknown message type")
                     continue
@@ -166,11 +179,26 @@ class ServingService:
         payload = msg.data[0]
         deadline_ms = float(msg.data[1][0]) if len(msg.data) > 1 \
             and msg.data[1].size else 100.0
+        # Third blob (optional): the client's trace context. The server's
+        # residency span is a child of it; the batcher inherits the server
+        # span as the parent for the per-stage spans.
+        wire_ctx = unpack_trace_ctx(msg.data[2]) if len(msg.data) > 2 \
+            else None
+        server_ctx = child_of(wire_ctx) if wire_ctx is not None else None
         runner = self._runners[msg.table_id]
+        runner_name = getattr(runner, "name", "?")
+        inflight_key = (id(conn), msg.msg_id)
+
+        done_flag: list = []
 
         def on_done(result, _conn=conn, _msg=msg, _t0=t0):
             t1 = time.monotonic()
-            if isinstance(result, ShedError):
+            with self._inflight_lock:
+                done_flag.append(1)
+                self._inflight.pop(inflight_key, None)
+            shed_reason = result.reason if isinstance(result, ShedError) \
+                else ""
+            if shed_reason:
                 self._reply_error(_conn, _msg, str(result))
             else:
                 reply = _msg.create_reply()
@@ -187,9 +215,46 @@ class ServingService:
             now = time.monotonic()
             self._h_reply.observe((now - t1) * 1e3)
             self._h_total.observe((now - _t0) * 1e3)
+            if server_ctx is not None:
+                if server_ctx.sampled:
+                    emit_span("serve.reply", child_of(server_ctx), t1,
+                              (now - t1) * 1e3)
+                # Sheds force-record the residency span even when
+                # head-unsampled — the tail exemplar is the point.
+                if shed_reason:
+                    emit_span("serve.request", server_ctx, _t0,
+                              (now - _t0) * 1e3, force=True,
+                              runner=runner_name, shed=shed_reason)
+                else:
+                    emit_span("serve.request", server_ctx, _t0,
+                              (now - _t0) * 1e3, runner=runner_name)
 
-        with span("serve.request", runner=getattr(runner, "name", "?")):
-            batcher.submit_callback(payload, deadline_ms, on_done)
+        with activate(server_ctx):
+            token = batcher.submit_callback(payload, deadline_ms, on_done)
+        if token is not None:
+            with self._inflight_lock:
+                # A fast request can complete (popping the key) before
+                # this insert runs; registering it anyway would leak the
+                # entry forever. done_flag is written under this same
+                # lock, so the check-and-insert is race-free.
+                if not done_flag:
+                    self._inflight[inflight_key] = (batcher, token)
+
+    def _cancel(self, conn: socket.socket, msg: Message) -> None:
+        """Serve_Cancel: a hedged winner landed elsewhere — drop the
+        loser at admission if it has not reached the device. Best-effort
+        and reply-less: a successfully cancelled request answers its
+        ORIGINAL msg_id with Reply_Error("cancelled") via the batcher's
+        delivery path, a too-late cancel changes nothing."""
+        self._c_cancel_req.inc()
+        with self._inflight_lock:
+            entry = self._inflight.get((id(conn), msg.msg_id))
+        if entry is None:
+            self._c_cancel_miss.inc()
+            return
+        batcher, token = entry
+        if not batcher.cancel(token):
+            self._c_cancel_miss.inc()
 
     def _reply_error(self, conn: socket.socket, msg: Message,
                      reason: str) -> None:

@@ -15,6 +15,9 @@ are histogram-backed through this package) plus cross-actor tracing:
 See docs/OBSERVABILITY.md for the metric catalog and schemas.
 """
 
+from multiverso_tpu.telemetry.context import (TraceContext, activate,
+                                              child_of, current_context,
+                                              maybe_new_root, new_root)
 from multiverso_tpu.telemetry.export import (SNAPSHOT_SCHEMA,
                                              TelemetryExporter,
                                              build_chrome_trace,
@@ -22,21 +25,27 @@ from multiverso_tpu.telemetry.export import (SNAPSHOT_SCHEMA,
                                              maybe_start_exporter_from_flags,
                                              merge_traces, metrics_snapshot,
                                              reset_telemetry, start_exporter,
-                                             stop_exporter,
+                                             stitch_traces, stop_exporter,
+                                             trace_index,
                                              validate_chrome_trace,
                                              validate_snapshot)
 from multiverso_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
                                               MetricsRegistry, counter,
                                               gauge, get_registry, histogram)
 from multiverso_tpu.telemetry.spans import (TraceBuffer, current_identity,
-                                            get_trace_buffer, span)
+                                            emit_span, get_trace_buffer,
+                                            span)
 
 __all__ = [
     "SNAPSHOT_SCHEMA", "TelemetryExporter", "build_chrome_trace",
     "export_chrome_trace", "maybe_start_exporter_from_flags",
     "merge_traces", "metrics_snapshot", "reset_telemetry", "start_exporter",
-    "stop_exporter", "validate_chrome_trace", "validate_snapshot",
+    "stitch_traces", "stop_exporter", "trace_index",
+    "validate_chrome_trace", "validate_snapshot",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "counter", "gauge",
     "get_registry", "histogram",
-    "TraceBuffer", "current_identity", "get_trace_buffer", "span",
+    "TraceBuffer", "current_identity", "emit_span", "get_trace_buffer",
+    "span",
+    "TraceContext", "activate", "child_of", "current_context",
+    "maybe_new_root", "new_root",
 ]

@@ -41,7 +41,8 @@ from multiverso_tpu.telemetry.spans import (TraceBuffer, _reset_identity_cache,
                                             get_trace_buffer)
 
 __all__ = ["SNAPSHOT_SCHEMA", "metrics_snapshot", "build_chrome_trace",
-           "export_chrome_trace", "merge_traces", "validate_chrome_trace",
+           "export_chrome_trace", "merge_traces", "stitch_traces",
+           "trace_index", "validate_chrome_trace",
            "validate_snapshot", "TelemetryExporter", "start_exporter",
            "stop_exporter", "maybe_start_exporter_from_flags",
            "reset_telemetry"]
@@ -127,6 +128,103 @@ def merge_traces(paths: Iterable[str], out_path: Optional[str] = None
     return merged
 
 
+# ---------------------------------------------------------------------------
+# Cross-process trace stitching (distributed tracing; docs/OBSERVABILITY.md
+# "Distributed tracing"). Span events carry args.trace/span/parent from
+# telemetry/context.py; stitching groups them by trace id and synthesizes
+# Chrome FLOW events (ph "s"/"f") for every parent->child edge that crosses
+# a process boundary, so Perfetto draws the request's hop arrows.
+# ---------------------------------------------------------------------------
+def _span_events(traces: Iterable[Dict]) -> List[Dict]:
+    out = []
+    for trace in traces:
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") == "X" and \
+                    isinstance(ev.get("args"), dict) and \
+                    ev["args"].get("trace"):
+                out.append(ev)
+    return out
+
+
+def trace_index(events: Iterable[Dict]) -> Dict[str, Dict]:
+    """Per-trace summary over span events: span/pid counts, root, total
+    duration, and whether every non-root parent link resolves — the
+    "correctly parented" check the smoke asserts."""
+    by_trace: Dict[str, List[Dict]] = {}
+    for ev in events:
+        by_trace.setdefault(ev["args"]["trace"], []).append(ev)
+    out: Dict[str, Dict] = {}
+    for tid, evs in by_trace.items():
+        span_ids = {e["args"].get("span") for e in evs}
+        roots = [e for e in evs if not e["args"].get("parent")]
+        orphans = [e for e in evs
+                   if e["args"].get("parent")
+                   and e["args"]["parent"] not in span_ids]
+        root = min(roots, key=lambda e: e.get("ts", 0)) if roots else None
+        out[tid] = {
+            "n_spans": len(evs),
+            "pids": sorted({int(e.get("pid", 0)) for e in evs}),
+            "names": sorted({e.get("name", "") for e in evs}),
+            "root_name": root.get("name") if root else None,
+            "dur_us": int(root.get("dur", 0)) if root else
+            max((int(e.get("dur", 0)) for e in evs), default=0),
+            "n_roots": len(roots),
+            "n_orphans": len(orphans),
+            "parented_ok": bool(roots) and not orphans,
+        }
+    return out
+
+
+def stitch_traces(paths: Iterable[str], trace_id: Optional[str] = None,
+                  out_path: Optional[str] = None) -> Dict:
+    """Merge per-process trace files into ONE trace keyed by trace id:
+    keeps only span events that carry a trace context (optionally just
+    ``trace_id``), sorts them on the shared epoch time axis, and adds a
+    flow-event pair for every parent->child edge whose endpoints live in
+    different processes. The result answers "where did this request
+    spend its time" across client, router, and replicas in one Perfetto
+    view."""
+    traces = []
+    for path in sorted(paths):
+        with open(path) as f:
+            traces.append(json.load(f))
+    events = _span_events(traces)
+    if trace_id is not None:
+        events = [e for e in events if e["args"]["trace"] == trace_id]
+    events.sort(key=lambda e: e.get("ts", 0))
+    by_span: Dict[tuple, Dict] = {}
+    for ev in events:
+        by_span[(ev["args"]["trace"], ev["args"].get("span"))] = ev
+    flows: List[Dict] = []
+    flow_seq = 0
+    for ev in events:
+        parent_span = ev["args"].get("parent")
+        if not parent_span:
+            continue
+        parent = by_span.get((ev["args"]["trace"], parent_span))
+        if parent is None or parent.get("pid") == ev.get("pid"):
+            continue
+        flow_seq += 1
+        common = {"cat": "trace_flow", "name": "hop", "id": flow_seq}
+        flows.append({**common, "ph": "s", "ts": parent.get("ts", 0),
+                      "pid": parent.get("pid", 0),
+                      "tid": parent.get("tid", 0)})
+        flows.append({**common, "ph": "f", "bp": "e",
+                      "ts": max(ev.get("ts", 0), parent.get("ts", 0)),
+                      "pid": ev.get("pid", 0), "tid": ev.get("tid", 0)})
+    pids = sorted({int(e.get("pid", 0)) for e in events})
+    meta = [{"ph": "M", "name": "process_name", "pid": p, "tid": 0,
+             "args": {"name": f"multiverso_tpu pid={p}"}} for p in pids]
+    stitched = {"traceEvents": meta + events + flows,
+                "displayTimeUnit": "ms",
+                "otherData": {"schema": "chrome-trace-events/json",
+                              "stitched_by": "trace_id",
+                              "n_traces": len(trace_index(events))}}
+    if out_path:
+        _atomic_write_json(out_path, stitched)
+    return stitched
+
+
 def validate_chrome_trace(trace: Dict) -> None:
     """Raise ``ValueError`` unless ``trace`` is loadable by
     chrome://tracing / Perfetto (JSON object format). Shared by the schema
@@ -158,6 +256,13 @@ def validate_chrome_trace(trace: Dict) -> None:
                 raise ValueError(f"traceEvents[{i}] bad 'ts' {ts!r}")
             if not isinstance(dur, (int, float)) or dur < 0:
                 raise ValueError(f"traceEvents[{i}] bad 'dur' {dur!r}")
+        elif ph in ("s", "f"):
+            # Flow events (stitched cross-process hops): need an id and
+            # a timestamp; "f" additionally binds to the enclosing slice.
+            if "id" not in ev:
+                raise ValueError(f"traceEvents[{i}] flow event missing id")
+            if not isinstance(ev.get("ts"), (int, float)):
+                raise ValueError(f"traceEvents[{i}] flow event missing ts")
         else:
             raise ValueError(f"traceEvents[{i}] unexpected phase {ph!r}")
 

@@ -23,9 +23,12 @@ import threading
 import time
 from typing import Dict, Iterator, List, Optional
 
+from multiverso_tpu.telemetry import context as trace_context
+from multiverso_tpu.telemetry.context import TraceContext
 from multiverso_tpu.telemetry.metrics import get_registry
 
-__all__ = ["span", "TraceBuffer", "get_trace_buffer", "current_identity"]
+__all__ = ["span", "emit_span", "TraceBuffer", "get_trace_buffer",
+           "current_identity"]
 
 
 class TraceBuffer:
@@ -145,28 +148,88 @@ def _clean_attrs(attrs: Dict) -> Dict:
             for k, v in attrs.items()}
 
 
+def _trace_args(args: Dict, ctx: TraceContext) -> Dict:
+    args["trace"] = ctx.trace_hex
+    args["span"] = ctx.span_hex
+    if ctx.parent_id:
+        args["parent"] = f"{ctx.parent_id:016x}"
+    if ctx.hedge:
+        args["hedge"] = 1
+        args["attempt"] = ctx.hedge
+    return args
+
+
 @contextlib.contextmanager
 def span(name: str, **attrs) -> Iterator[None]:
     """Named host-side region: Chrome trace event + ``span.<name>``
-    latency histogram + nested device-trace annotation."""
+    latency histogram + nested device-trace annotation.
+
+    When a :class:`~multiverso_tpu.telemetry.context.TraceContext` is
+    active on this thread, the region becomes a CHILD span of it (and the
+    child is the current context for the body, so nested spans and
+    wire-propagated requests parent correctly); an UNSAMPLED context
+    still times the histogram but skips the trace buffer — head-based
+    sampling keeps the request hot path cheap. With no active context the
+    behavior is exactly the pre-tracing one (recorded unconditionally,
+    no trace fields)."""
     ident = current_identity()
+    parent = trace_context.current_context()
+    ctx = trace_context.child_of(parent) if parent is not None else None
     ts_us = time.time() * 1e6
     t0 = time.perf_counter()
     try:
-        with _trace_annotation(name):
+        with trace_context.activate(ctx), _trace_annotation(name):
             yield
     finally:
         dur_ms = (time.perf_counter() - t0) * 1e3
-        args = _clean_attrs(attrs)
-        args["rank"] = ident.get("rank", 0)
-        get_trace_buffer().record({
-            "name": name,
-            "ph": "X",
-            "ts": int(ts_us),
-            "dur": max(int(dur_ms * 1e3), 0),
-            "pid": ident["pid"],
-            "tid": threading.get_ident() % (1 << 31),
-            "cat": "multiverso_tpu",
-            "args": args,
-        })
+        if ctx is None or ctx.sampled:
+            args = _clean_attrs(attrs)
+            args["rank"] = ident.get("rank", 0)
+            if ctx is not None:
+                _trace_args(args, ctx)
+            get_trace_buffer().record({
+                "name": name,
+                "ph": "X",
+                "ts": int(ts_us),
+                "dur": max(int(dur_ms * 1e3), 0),
+                "pid": ident["pid"],
+                "tid": threading.get_ident() % (1 << 31),
+                "cat": "multiverso_tpu",
+                "args": args,
+            })
         get_registry().histogram(f"span.{name}").observe(dur_ms)
+
+
+def emit_span(name: str, ctx: Optional[TraceContext], t0_mono: float,
+              dur_ms: float, force: bool = False, **attrs) -> None:
+    """Record a COMPLETED span from explicit timestamps — for stages whose
+    begin/end straddle threads or callbacks (batcher admit-wait, device
+    window, reply leg), where a ``with`` block can't wrap the region.
+
+    ``ctx`` IS the span's identity (build one with ``child_of(parent)``);
+    ``t0_mono`` is the ``time.monotonic()`` start. Skipped entirely for
+    an unsampled context unless ``force`` (tail-exemplar path: shed /
+    error / slow requests get recorded even when head-unsampled). The
+    ``span.<name>`` histogram observes only when the event records, so
+    span-derived percentiles always describe the events in the trace."""
+    if ctx is None or not (ctx.sampled or force):
+        return
+    ident = current_identity()
+    epoch_minus_mono = time.time() - time.monotonic()
+    args = _clean_attrs(attrs)
+    args["rank"] = ident.get("rank", 0)
+    _trace_args(args, ctx)
+    if force and not ctx.sampled:
+        args["tail"] = 1
+    dur_ms = max(float(dur_ms), 0.0)
+    get_trace_buffer().record({
+        "name": name,
+        "ph": "X",
+        "ts": int((epoch_minus_mono + t0_mono) * 1e6),
+        "dur": max(int(dur_ms * 1e3), 0),
+        "pid": ident["pid"],
+        "tid": threading.get_ident() % (1 << 31),
+        "cat": "multiverso_tpu",
+        "args": args,
+    })
+    get_registry().histogram(f"span.{name}").observe(dur_ms)

@@ -270,3 +270,15 @@ define_string("telemetry_dir", "", "write periodic metrics snapshots "
 define_double("telemetry_interval", 10.0, "seconds between telemetry "
               "snapshot exports (a final snapshot is always written at "
               "shutdown)")
+define_double("telemetry_sample_rate", 0.02, "head-based trace sampling: "
+              "fraction of serving requests whose distributed trace is "
+              "recorded (the root client draws once; every hop honors "
+              "the decision). Low by default so the request hot path "
+              "stays cheap; 0 disables request tracing entirely; shed/"
+              "error/slow requests record regardless (tail exemplars)")
+define_double("telemetry_slow_ms", 100.0, "tail-exemplar threshold: a "
+              "head-UNSAMPLED request that sheds, errors, or exceeds "
+              "this latency still records its root span (tagged tail=1)")
+define_double("serve_slo_ms", 50.0, "serving latency SLO: requests whose "
+              "total latency exceeds this count toward the fleet "
+              "rollup's slo_violations burn counter")

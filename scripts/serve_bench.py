@@ -5,19 +5,27 @@ a whole fleet.
 Single-process mode (default, PR 5's harness): one in-process
 ServingService over a synthetic embedding table, N paced client threads.
 
-Fleet mode (``--replicas N``): an in-process FleetRouter plus N replica
-SUBPROCESSES (real process isolation — each replica owns its GIL and its
-jax dispatch), driven through a hedged, ring-routed FleetClient. Extras:
+Fleet mode (``--replicas N``): a router SUBPROCESS (control plane + data
+proxy — its own pid, so stitched traces really cross client -> router ->
+replica) plus N replica SUBPROCESSES (real process isolation — each
+replica owns its GIL and its jax dispatch), driven through a hedged,
+ring-routed FleetClient over the wire APIs only. Extras:
 
-* ``--drain-drill``  — rolling-drain every replica mid-load; the bench
-  counts request failures during the drain window (the zero-drop claim
-  is measured, not asserted by fiat).
+* ``--drain-drill``  — rolling-drain every replica mid-load (wire
+  ``Fleet_Drain``); the bench counts request failures during the drain
+  window (the zero-drop claim is measured, not asserted by fiat).
 * ``--fault-drill``  — SIGKILL one replica at half-time; errors and the
   post-kill p99 quantify how well hedging + failover mask the death.
 * parity check       — routed lookups (both affinity and split mode)
   compared bitwise against the same seeded table computed locally.
 * ``--baseline``     — path to a previous record; the new record embeds
   ``scaleout_vs_baseline`` (aggregate-QPS ratio at equal offered load).
+* distributed tracing — the load runs in INTERLEAVED untraced/traced
+  windows (A,B,A,B — drift in box load cancels out of the comparison);
+  the record carries both QPS numbers (sampling overhead measured, not
+  guessed), a per-stage p50/p95/p99 breakdown derived from the stitched
+  traces, the K slowest requests' cross-process stage timelines, and
+  the router's ``Fleet_Stats`` cluster rollup.
 
 Every record is written to ``--out`` AND appended to
 ``BENCH_SERVE_HISTORY.jsonl`` next to it (mirroring
@@ -33,11 +41,13 @@ like the training benches.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import signal
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -160,6 +170,154 @@ def _run_load(do_request, stats: _LoadStats, threads: int, qps: float,
 
 
 # ---------------------------------------------------------------------------
+# Distributed-trace analysis: stitched per-stage attribution + slow-request
+# timelines (docs/OBSERVABILITY.md "Distributed tracing").
+# ---------------------------------------------------------------------------
+_STAGE_SPANS = {
+    "admit_wait": "serve.admit_wait",
+    "batch_form": "serve.batch_form",
+    "device": "serve.device",
+    "reply": "serve.reply",
+    "server_total": "serve.request",
+    "proxy": "fleet.proxy",
+}
+
+
+def _set_sample_rate(rate: float) -> None:
+    from multiverso_tpu.utils.configure import set_flag
+    set_flag("telemetry_sample_rate", float(rate))
+
+
+def _pcts(vals) -> dict:
+    arr = np.asarray(vals, dtype=np.float64)
+    if not arr.size:
+        return {"count": 0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+    return {"count": int(arr.size),
+            "p50": round(float(np.percentile(arr, 50)), 4),
+            "p95": round(float(np.percentile(arr, 95)), 4),
+            "p99": round(float(np.percentile(arr, 99)), 4)}
+
+
+def _stage_breakdown(spans) -> dict:
+    """Per-stage latency percentiles DERIVED FROM TRACES (not the server
+    histograms — these are the sampled exemplars, attributable to
+    specific requests). ``proxy_hop`` is client-observed attempt time
+    minus server residency: the wire + framing + routing overhead of
+    one hop."""
+    by_name: dict = {}
+    by_span: dict = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e["dur"] / 1e3)
+        by_span[(e["args"]["trace"], e["args"].get("span"))] = e
+    out = {stage: _pcts(by_name.get(name, []))
+           for stage, name in _STAGE_SPANS.items()}
+    hops = []
+    for e in spans:
+        if e["name"] != "serve.request":
+            continue
+        parent = by_span.get((e["args"]["trace"], e["args"].get("parent")))
+        if parent is not None and parent["name"] in ("fleet.attempt",
+                                                     "serve.client"):
+            hops.append(max(parent["dur"] - e["dur"], 0) / 1e3)
+    out["proxy_hop"] = _pcts(hops)
+    return out
+
+
+def _slowest_timelines(spans, idx, k: int) -> list:
+    """The K slowest stitched requests, each as a cross-process stage
+    timeline (the "where did THIS p99 request spend its time" answer).
+    Single-span traces are skipped: a tail exemplar whose head decision
+    dropped the downstream spans has no stage timeline to show — it
+    stays in the stitched file, but the slow-K block is for stages."""
+    ranked = sorted(((tid, info) for tid, info in idx.items()
+                     if info["parented_ok"] and info["n_spans"] >= 2),
+                    key=lambda kv: -kv[1]["dur_us"])[:max(k, 0)]
+    out = []
+    for tid, info in ranked:
+        evs = sorted((e for e in spans if e["args"]["trace"] == tid),
+                     key=lambda e: e.get("ts", 0))
+        t_base = evs[0]["ts"] if evs else 0
+        stages = []
+        for e in evs:
+            entry = {"name": e["name"], "pid": int(e.get("pid", 0)),
+                     "t_rel_ms": round((e["ts"] - t_base) / 1e3, 4),
+                     "dur_ms": round(e["dur"] / 1e3, 4)}
+            for key in ("member", "attempt", "hedge", "shed"):
+                if key in e.get("args", {}):
+                    entry[key] = e["args"][key]
+            stages.append(entry)
+        out.append({"trace_id": tid,
+                    "total_ms": round(info["dur_us"] / 1e3, 4),
+                    "n_spans": info["n_spans"], "pids": info["pids"],
+                    "stages": stages})
+    return out
+
+
+def _trace_smoke(spans, idx) -> dict:
+    """The tier-1 acceptance probes: (a) one sampled request stitched to
+    a single correctly-parented trace spanning >= 3 processes, (b) a
+    hedged request whose duplicate attempts appear as tagged siblings."""
+    best = None
+    for tid, info in idx.items():
+        if not info["parented_ok"]:
+            continue
+        key = (len(info["pids"]), info["n_spans"])
+        if best is None or key > best[0]:
+            best = (key, tid, info)
+    smoke = {"found": best is not None}
+    if best is not None:
+        _, tid, info = best
+        smoke.update({"trace_id": tid, "n_spans": info["n_spans"],
+                      "n_pids": len(info["pids"]),
+                      "parented_ok": info["parented_ok"],
+                      "root_name": info["root_name"]})
+    by_parent: dict = {}
+    for e in spans:
+        if e["name"] == "fleet.attempt":
+            by_parent.setdefault(
+                (e["args"]["trace"], e["args"].get("parent")),
+                []).append(e)
+    hedged = {"found": False}
+    for (tid, _parent), sibs in by_parent.items():
+        if len(sibs) >= 2 and any(s["args"].get("hedge") for s in sibs):
+            hedged = {"found": True, "trace_id": tid,
+                      "n_attempts": len(sibs),
+                      "hedge_tags": sorted(int(s["args"].get("hedge", 0))
+                                           for s in sibs)}
+            break
+    smoke["hedged_siblings"] = hedged
+    return smoke
+
+
+def _trace_report(tdir: str, k: int) -> dict:
+    """Stitch every per-process trace under ``tdir`` and distill the
+    bench-record tracing block."""
+    from multiverso_tpu.telemetry import stitch_traces, trace_index
+    paths = glob.glob(os.path.join(tdir, "trace-*.json"))
+    stitched_path = os.path.join(tdir, "stitched.json")
+    stitched = stitch_traces(paths, out_path=stitched_path)
+    spans = [e for e in stitched["traceEvents"]
+             if e.get("ph") == "X" and e.get("args", {}).get("trace")]
+    idx = trace_index(spans)
+    return {
+        "n_trace_files": len(paths),
+        "n_traces": len(idx),
+        "n_spans": len(spans),
+        "stitched_path": stitched_path,
+        "stage_breakdown": _stage_breakdown(spans),
+        "slowest": _slowest_timelines(spans, idx, k),
+        "trace_smoke": _trace_smoke(spans, idx),
+    }
+
+
+def _export_local_trace(tdir: str) -> None:
+    """Write THIS process's span buffer as trace-<pid>.json beside the
+    replicas' exporter output, so the stitch sees the client half."""
+    from multiverso_tpu.telemetry import export_chrome_trace
+    export_chrome_trace(os.path.join(tdir, f"trace-{os.getpid()}.json"))
+
+
+# ---------------------------------------------------------------------------
 # Single-process mode (PR 5's harness, kept as the no-fleet baseline)
 # ---------------------------------------------------------------------------
 def run_single(args) -> dict:
@@ -205,26 +363,80 @@ def run_single(args) -> dict:
         cli = getattr(local, "cli", None)
         if cli is None:
             with pick_lock:
-                local.cli = cli = clients[next_client[0]]
+                # Modulo: the load now runs in TWO phases (untraced +
+                # traced), each with fresh threads — the second phase's
+                # threads must wrap back onto the same client pool.
+                local.cli = cli = clients[next_client[0] % len(clients)]
                 next_client[0] += 1
         cli.lookup(keys, deadline_ms=args.deadline_ms, timeout=30)
 
-    stats = _LoadStats()
-    elapsed = _run_load(do_request, stats, args.threads, args.qps,
-                        args.duration, args.rows, args.keys_per_req)
+    # Interleaved untraced/traced load windows (A,B,A,B): traced-vs-
+    # untraced QPS measures sampling overhead with slow drift in box
+    # load cancelled out, not baked into one side of the comparison.
+    from multiverso_tpu.telemetry import TraceBuffer, get_trace_buffer
+    get_trace_buffer().set_capacity(TraceBuffer.EXPORT_CAPACITY)
+    stats_un, stats = _LoadStats(), _LoadStats()
+    elapsed_un = elapsed = 0.0
+    for _half in range(2):
+        _set_sample_rate(0.0)
+        elapsed_un += _run_load(do_request, stats_un, args.threads,
+                                args.qps, args.duration / 2, args.rows,
+                                args.keys_per_req)
+        _set_sample_rate(args.sample_rate)
+        elapsed += _run_load(do_request, stats, args.threads, args.qps,
+                             args.duration / 2, args.rows,
+                             args.keys_per_req)
+    qps_untraced = len(stats_un.latencies) / elapsed_un \
+        if elapsed_un > 0 else 0.0
     for cli in clients:
         cli.close()
     service.close()
 
-    return _make_record("serve_lookup", args, stats, elapsed,
-                        _metric_families(("serve.",)))
+    record = _make_record("serve_lookup", args, stats, elapsed,
+                          _metric_families(("serve.",)))
+    tdir = args.telemetry_dir or tempfile.mkdtemp(prefix="serve_trace_")
+    _export_local_trace(tdir)
+    record["tracing"] = _tracing_block(args, tdir, record["achieved_qps"],
+                                       qps_untraced)
+    return record
+
+
+def _tracing_block(args, tdir: str, qps_traced: float,
+                   qps_untraced: float) -> dict:
+    overhead = round(100.0 * (1.0 - qps_traced / qps_untraced), 2) \
+        if qps_untraced > 0 else 0.0
+    return {
+        "sample_rate": args.sample_rate,
+        "qps_traced": round(qps_traced, 1),
+        "qps_untraced": round(qps_untraced, 1),
+        "overhead_pct": overhead,
+        "telemetry_dir": tdir,
+        **_trace_report(tdir, args.slow_k),
+    }
 
 
 # ---------------------------------------------------------------------------
-# Fleet mode: router in-process, replicas as subprocesses
+# Fleet mode: router AND replicas as subprocesses (three distinct pids on
+# the data path — the stitched traces prove client -> router -> replica)
 # ---------------------------------------------------------------------------
-def _spawn_replica(args, router_addr, idx: int) -> subprocess.Popen:
-    lifetime = args.duration + 300      # generous: parent kills at exit
+def _spawn_router(args, tdir: str, addr_file: str) -> subprocess.Popen:
+    lifetime = args.duration * 3 + 300  # three load windows
+    cmd = [sys.executable, "-m", "multiverso_tpu.apps.fleet_main",
+           "-fleet_role=router",
+           f"-fleet_heartbeat_ms={args.heartbeat_ms}",
+           f"-fleet_liveness_misses={args.liveness_misses}",
+           "-fleet_proxy=true",
+           f"-fleet_addr_file={addr_file}",
+           f"-serve_duration={lifetime}",
+           f"-telemetry_dir={tdir}",
+           "-telemetry_interval=2",
+           "-serve_device=cpu"]
+    return subprocess.Popen(cmd, cwd=_REPO)
+
+
+def _spawn_replica(args, router_addr, idx: int,
+                   tdir: str) -> subprocess.Popen:
+    lifetime = args.duration * 3 + 300  # generous: parent stops at exit
     cmd = [sys.executable, "-m", "multiverso_tpu.apps.fleet_main",
            "-fleet_role=replica",
            f"-fleet_router={router_addr[0]}:{router_addr[1]}",
@@ -236,8 +448,43 @@ def _spawn_replica(args, router_addr, idx: int) -> subprocess.Popen:
            f"-serve_admission={args.admission}",
            f"-serve_wire_dtype={args.wire_dtype}",
            f"-serve_duration={lifetime}",
+           f"-telemetry_dir={tdir}",
+           "-telemetry_interval=2",
            "-serve_device=cpu"]
     return subprocess.Popen(cmd, cwd=_REPO)
+
+
+def _wait_addr_file(path: str, procs, timeout_s: float = 120.0):
+    deadline = time.monotonic() + timeout_s
+    while not os.path.exists(path):
+        if any(p.poll() is not None for p in procs):
+            raise RuntimeError("a fleet process exited during bring-up")
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"router never wrote {path}")
+        time.sleep(0.05)
+    host, port = open(path).read().split(":")
+    return (host, int(port))
+
+
+def _shutdown_procs(procs) -> None:
+    """SIGINT first — the graceful path that lets each process write its
+    final telemetry snapshot + trace — then escalate."""
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGINT)
+    deadline = time.monotonic() + 30
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.terminate()
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
 
 def _proc_cpu_s(pid: int) -> float:
@@ -326,37 +573,97 @@ def _parity_check(fleet, table, rows: int, keys_per_req: int) -> bool:
     return True
 
 
+def _wire_rolling_drain(router_addr, fleet, timeout_s: float = 60.0) -> bool:
+    """Operator-path rolling drain: trigger over ``Fleet_Drain`` and poll
+    the routing table's monotonic per-member ``drains_completed`` — the
+    bench drives the fleet exactly the way an operator would."""
+    from multiverso_tpu.fleet import request_drain
+    before = {m["id"]: int(m.get("drains_completed", 0))
+              for m in fleet.routing().members}
+    if not before:
+        return False
+    ack = request_drain(router_addr, timeout_s=timeout_s)
+    if not ack.get("started"):
+        return False
+    deadline = time.monotonic() + timeout_s * (len(before) + 1)
+    while time.monotonic() < deadline:
+        table = {m["id"]: m for m in fleet.refresh().members}
+        pending = [mid for mid in before
+                   if mid in table
+                   and (int(table[mid].get("drains_completed", 0))
+                        <= before[mid] or table[mid].get("draining"))]
+        if not pending:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _trace_smoke_requests(args, fleet, router_addr) -> None:
+    """A few guaranteed-sampled requests for the stitched-trace probes:
+    a ring-SPLIT lookup (fans across both replicas), a forced-hedge
+    lookup (duplicate attempts as tagged siblings), and a PROXIED lookup
+    through the router subprocess (client -> router -> replica: three
+    distinct pids in one trace)."""
+    from multiverso_tpu.fleet import FleetClient
+    from multiverso_tpu.serving import ServingClient
+    _set_sample_rate(1.0)
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, args.rows, args.keys_per_req).astype(np.int32)
+    for _ in range(3):
+        fleet.lookup(keys, deadline_ms=10_000, split=True, timeout=60)
+    hedger = FleetClient(router_addr, hedge=0.0,
+                         refresh_s=args.heartbeat_ms / 1e3)
+    try:
+        for _ in range(4):
+            hedger.lookup(keys, deadline_ms=10_000, timeout=60)
+    finally:
+        hedger.close()
+    proxy_cli = ServingClient(*router_addr)
+    try:
+        for _ in range(3):
+            proxy_cli.lookup(keys, deadline_ms=10_000, timeout=60)
+    finally:
+        proxy_cli.close()
+
+
 def run_fleet(args) -> dict:
-    from multiverso_tpu.fleet import FleetClient, FleetRouter
+    from multiverso_tpu.fleet import FleetClient, fetch_fleet_stats
+    from multiverso_tpu.telemetry import TraceBuffer, get_trace_buffer
 
     rng = np.random.default_rng(0)
     table = rng.normal(size=(args.rows, args.cols)).astype(np.float32)
+    tdir = args.telemetry_dir or tempfile.mkdtemp(prefix="serve_trace_")
+    os.makedirs(tdir, exist_ok=True)
+    addr_file = os.path.join(tdir, "router_addr")
 
-    router = FleetRouter(heartbeat_ms=args.heartbeat_ms,
-                         liveness_misses=args.liveness_misses,
-                         proxy=False)
-    procs = [_spawn_replica(args, router.address, i)
-             for i in range(args.replicas)]
-    drill: dict = {}
+    router_proc = _spawn_router(args, tdir, addr_file)
+    procs: list = []
     fleet = None
+    record = None
     try:
-        deadline = time.monotonic() + 240
-        while len(router.group.member_ids()) < args.replicas:
-            if any(p.poll() is not None for p in procs):
-                raise RuntimeError("a fleet replica exited during "
-                                   "bring-up")
-            if time.monotonic() > deadline:
-                raise RuntimeError("fleet replicas never joined")
-            time.sleep(0.05)
+        router_addr = _wait_addr_file(addr_file, [router_proc])
+        procs = [_spawn_replica(args, router_addr, i, tdir)
+                 for i in range(args.replicas)]
 
         # argparse hands --hedge over as a string; FleetClient only honors
         # a fixed delay when given a NUMBER (a numeric string would
         # silently mean "adaptive").
         hedge = args.hedge if args.hedge in ("adaptive", "off") \
             else float(args.hedge)
-        fleet = FleetClient(router.address, hedge=hedge,
+        fleet = FleetClient(router_addr, hedge=hedge,
                             refresh_s=args.heartbeat_ms / 1e3)
+        deadline = time.monotonic() + 240
+        while len(fleet.refresh().members) < args.replicas:
+            if any(p.poll() is not None for p in procs) \
+                    or router_proc.poll() is not None:
+                raise RuntimeError("a fleet process exited during "
+                                   "bring-up")
+            if time.monotonic() > deadline:
+                raise RuntimeError("fleet replicas never joined")
+            time.sleep(0.05)
+
         # Warm the data-path connections + reply decode before timing.
+        _set_sample_rate(0.0)
         for _ in range(10):
             fleet.lookup(rng.integers(0, args.rows, args.keys_per_req)
                          .astype(np.int32), deadline_ms=10_000, timeout=60)
@@ -364,69 +671,119 @@ def run_fleet(args) -> dict:
         parity_ok = _parity_check(fleet, table, args.rows,
                                   args.keys_per_req)
 
-        stats = _LoadStats()
-        drill_state: dict = {}
-
-        def drills():
-            # Drain drill at 30% of the window: rolling-drain the whole
-            # fleet while load runs; count request errors in the window.
-            if args.drain_drill:
-                time.sleep(args.duration * 0.3)
-                with stats.lock:
-                    e0 = stats.errors
-                t0 = time.monotonic()
-                ok = router.rolling_drain(timeout_s_per_member=60)
-                with stats.lock:
-                    e1 = stats.errors
-                drill_state["drain"] = {
-                    "completed": bool(ok),
-                    "duration_s": round(time.monotonic() - t0, 3),
-                    "failed_requests": e1 - e0,
-                }
-            # Fault drill at 60%: SIGKILL one replica under load.
-            if args.fault_drill and len(procs) > 1:
-                now = time.monotonic()
-                target = args.duration * 0.6 - (now - t_start[0])
-                if target > 0:
-                    time.sleep(target)
-                victim = procs[-1]
-                t_kill = time.monotonic()
-                victim.send_signal(signal.SIGKILL)
-                drill_state["t_kill"] = t_kill
-
-        t_start = [time.monotonic()]
-        driller = threading.Thread(target=drills, daemon=True)
+        # Interleaved untraced/traced load windows (A,B,A,B), all
+        # DRILL-FREE: traced-vs-untraced QPS measures sampling overhead
+        # with slow drift in box load cancelled out — not drain
+        # disruption, not whichever phase drew the noisier seconds. The
+        # drills get their own window below.
+        get_trace_buffer().set_capacity(TraceBuffer.EXPORT_CAPACITY)
+        stats_un, stats = _LoadStats(), _LoadStats()
+        elapsed_un = elapsed = 0.0
         cpu0 = {"bench": _proc_cpu_s(os.getpid()),
+                "router": _proc_cpu_s(router_proc.pid),
                 **{f"replica-{i}": _proc_cpu_s(p.pid)
                    for i, p in enumerate(procs)}}
-        driller.start()
-        t_start[0] = time.monotonic()
-        elapsed = _run_fleet_load(fleet, stats, args.threads, args.qps,
-                                  args.duration, args.rows,
-                                  args.keys_per_req, args.deadline_ms)
+        for _half in range(2):
+            _set_sample_rate(0.0)
+            elapsed_un += _run_fleet_load(
+                fleet, stats_un, args.threads, args.qps,
+                args.duration / 2, args.rows, args.keys_per_req,
+                args.deadline_ms)
+            _set_sample_rate(args.sample_rate)
+            elapsed += _run_fleet_load(
+                fleet, stats, args.threads, args.qps, args.duration / 2,
+                args.rows, args.keys_per_req, args.deadline_ms)
+        qps_untraced = len(stats_un.latencies) / elapsed_un \
+            if elapsed_un > 0 else 0.0
+        wall = elapsed_un + elapsed
         cpu_pct = {"bench": round(100 * (_proc_cpu_s(os.getpid())
-                                         - cpu0["bench"]) / elapsed, 1),
+                                         - cpu0["bench"]) / wall, 1),
+                   "router": round(100 * (_proc_cpu_s(router_proc.pid)
+                                          - cpu0["router"]) / wall, 1),
                    **{f"replica-{i}":
                       round(100 * (_proc_cpu_s(p.pid)
-                                   - cpu0[f"replica-{i}"]) / elapsed, 1)
+                                   - cpu0[f"replica-{i}"]) / wall, 1)
                       for i, p in enumerate(procs)}}
-        driller.join(timeout=120)
 
-        drill = {k: v for k, v in drill_state.items() if k != "t_kill"}
-        if "t_kill" in drill_state:
-            t_kill = drill_state["t_kill"]
-            window_s = (args.liveness_misses * args.heartbeat_ms) / 1e3
-            with stats.lock:
-                in_window = sum(1 for t in stats.error_times
-                                if t_kill <= t <= t_kill + window_s)
-                after = sum(1 for t in stats.error_times if t > t_kill)
-            drill["fault"] = {
-                "killed": "replica-%d" % (len(procs) - 1),
-                "errors_after_kill": after,
-                "errors_in_liveness_window": in_window,
-                "errors_past_window": after - in_window,
-                "liveness_window_s": window_s,
-            }
+        # Phase C — drill window: fresh load with the drain/fault drills
+        # running against it (drained + killed replicas also land in the
+        # traces, since sampling stays on).
+        drill: dict = {}
+        if args.drain_drill or (args.fault_drill and len(procs) > 1):
+            dstats = _LoadStats()
+            drill_state: dict = {}
+
+            def drills():
+                # Drain drill at 30% of the window: rolling-drain the
+                # whole fleet (wire-triggered, the operator path) while
+                # load runs; count request errors in the window.
+                if args.drain_drill:
+                    time.sleep(args.duration * 0.3)
+                    with dstats.lock:
+                        e0 = dstats.errors
+                    t0 = time.monotonic()
+                    ok = _wire_rolling_drain(router_addr, fleet,
+                                             timeout_s=60)
+                    with dstats.lock:
+                        e1 = dstats.errors
+                    drill_state["drain"] = {
+                        "completed": bool(ok),
+                        "duration_s": round(time.monotonic() - t0, 3),
+                        "failed_requests": e1 - e0,
+                    }
+                # Fault drill at 60%: SIGKILL one replica under load.
+                if args.fault_drill and len(procs) > 1:
+                    now = time.monotonic()
+                    target = args.duration * 0.6 - (now - t_start[0])
+                    if target > 0:
+                        time.sleep(target)
+                    victim = procs[-1]
+                    t_kill = time.monotonic()
+                    victim.send_signal(signal.SIGKILL)
+                    drill_state["t_kill"] = t_kill
+
+            t_start = [time.monotonic()]
+            driller = threading.Thread(target=drills, daemon=True)
+            driller.start()
+            t_start[0] = time.monotonic()
+            d_elapsed = _run_fleet_load(fleet, dstats, args.threads,
+                                        args.qps, args.duration,
+                                        args.rows, args.keys_per_req,
+                                        args.deadline_ms)
+            driller.join(timeout=120)
+
+            drill = {k: v for k, v in drill_state.items()
+                     if k != "t_kill"}
+            if "t_kill" in drill_state:
+                t_kill = drill_state["t_kill"]
+                window_s = (args.liveness_misses
+                            * args.heartbeat_ms) / 1e3
+                with dstats.lock:
+                    in_window = sum(1 for t in dstats.error_times
+                                    if t_kill <= t <= t_kill + window_s)
+                    after = sum(1 for t in dstats.error_times
+                                if t > t_kill)
+                drill["fault"] = {
+                    "killed": "replica-%d" % (len(procs) - 1),
+                    "errors_after_kill": after,
+                    "errors_in_liveness_window": in_window,
+                    "errors_past_window": after - in_window,
+                    "liveness_window_s": window_s,
+                }
+            with dstats.lock:
+                drill["window"] = {
+                    "achieved_qps": round(len(dstats.latencies)
+                                          / d_elapsed, 1)
+                    if d_elapsed > 0 else 0.0,
+                    "n_ok": len(dstats.latencies),
+                    "n_shed": dstats.sheds,
+                    "n_error": dstats.errors,
+                }
+
+        # Guaranteed-sampled probes for the stitched-trace acceptance
+        # checks, then the router's cluster-wide rollup.
+        _trace_smoke_requests(args, fleet, router_addr)
+        fleet_stats = fetch_fleet_stats(router_addr)
 
         record = _make_record("serve_fleet_lookup", args, stats, elapsed,
                               _metric_families(("serve.", "fleet.")))
@@ -434,6 +791,7 @@ def run_fleet(args) -> dict:
         record["replicas"] = args.replicas
         record["cpu_cores"] = os.cpu_count()
         record["process_cpu_pct"] = cpu_pct
+        record["fleet_stats"] = fleet_stats
         if drill:
             record["drill"] = drill
         if args.baseline and os.path.exists(args.baseline):
@@ -448,19 +806,16 @@ def run_fleet(args) -> dict:
                     "ratio": round(record["achieved_qps"]
                                    / base["achieved_qps"], 3),
                 }
-        return record
     finally:
         if fleet is not None:
             fleet.close()
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=20)
-            except subprocess.TimeoutExpired:
-                p.kill()
-        router.close()
+        # Graceful stop so every process flushes its final trace — the
+        # stitch below reads what they wrote.
+        _shutdown_procs(procs + [router_proc])
+    _export_local_trace(tdir)
+    record["tracing"] = _tracing_block(args, tdir, record["achieved_qps"],
+                                       qps_untraced)
+    return record
 
 
 def _make_record(benchmark: str, args, stats: _LoadStats,
@@ -470,7 +825,10 @@ def _make_record(benchmark: str, args, stats: _LoadStats,
         n_shed, n_err, total = stats.sheds, stats.errors, stats.sent
     n_ok = len(lat)
     return {
-        "schema": "multiverso_tpu.bench_serve/v2",
+        # v3: + tracing block (sample_rate, traced/untraced QPS,
+        # stage_breakdown, slowest-K stitched timelines, trace_smoke)
+        # and fleet_stats rollup embed in fleet mode.
+        "schema": "multiverso_tpu.bench_serve/v3",
         "benchmark": benchmark,
         "time_unix": time.time(),
         "config": {k: (v if not isinstance(v, tuple) else list(v))
@@ -523,6 +881,15 @@ def main() -> int:
                    help="SIGKILL one replica mid-load")
     p.add_argument("--baseline", default="",
                    help="previous record to compute scaleout ratio against")
+    p.add_argument("--sample-rate", type=float, default=0.05,
+                   help="head-based trace sampling rate for the traced "
+                   "load phase (the untraced reference phase always runs "
+                   "at 0)")
+    p.add_argument("--slow-k", type=int, default=5,
+                   help="record the K slowest stitched request timelines")
+    p.add_argument("--telemetry-dir", default="",
+                   help="trace/snapshot directory shared by every fleet "
+                   "process (default: a fresh temp dir)")
     p.add_argument("--out", default=os.path.join(_REPO, "BENCH_SERVE.json"))
     p.add_argument("--dry-run", action="store_true",
                    help="seconds-on-CPU smoke: tiny table, short run")
@@ -533,6 +900,7 @@ def main() -> int:
         args.threads, args.qps = 2, 300.0
         args.duration = 4.0 if args.replicas else 1.5
         args.deadline_ms = 500.0
+        args.sample_rate = 1.0      # the smoke asserts on stitched traces
         if args.replicas:
             args.drain_drill = True
 

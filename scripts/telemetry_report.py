@@ -17,6 +17,13 @@ Usage:
     # merge per-rank Chrome traces into one Perfetto-loadable file
     python scripts/telemetry_report.py /tmp/t --merge-trace /tmp/merged.json
 
+    # stitch distributed request traces across processes: only spans
+    # carrying a trace context, grouped by trace id, with cross-process
+    # flow arrows (client -> router -> replica)
+    python scripts/telemetry_report.py /tmp/t --stitch /tmp/stitched.json
+    python scripts/telemetry_report.py /tmp/t --stitch /tmp/one.json \\
+        --trace-id 00c0ffee...   # a single request's end-to-end timeline
+
 No jax import: usable on any host, including ones without the TPU tunnel.
 """
 
@@ -159,6 +166,13 @@ def main():
                    help="another run's telemetry dir to diff against")
     p.add_argument("--merge-trace", default="",
                    help="write one merged Chrome trace for all ranks here")
+    p.add_argument("--stitch", default="",
+                   help="write one STITCHED Chrome trace here: only spans "
+                   "carrying a distributed trace context, keyed by trace "
+                   "id, with cross-process flow events on every "
+                   "parent->child hop")
+    p.add_argument("--trace-id", default="",
+                   help="with --stitch: keep only this trace id (hex)")
     args = p.parse_args()
 
     if args.merge_trace:
@@ -171,6 +185,29 @@ def main():
         merged = merge_traces(paths, out_path=args.merge_trace)
         print(f"merged {len(paths)} trace(s), "
               f"{len(merged['traceEvents'])} events -> {args.merge_trace}")
+
+    if args.stitch:
+        from multiverso_tpu.telemetry import stitch_traces, trace_index
+        paths = glob.glob(os.path.join(args.telemetry_dir, "trace-*.json"))
+        if not paths:
+            print(f"no trace-*.json under {args.telemetry_dir}",
+                  file=sys.stderr)
+            return 1
+        stitched = stitch_traces(paths, trace_id=args.trace_id or None,
+                                 out_path=args.stitch)
+        spans = [e for e in stitched["traceEvents"] if e.get("ph") == "X"]
+        idx = trace_index(spans)
+        print(f"stitched {len(paths)} file(s): {len(idx)} trace(s), "
+              f"{len(spans)} spans -> {args.stitch}")
+        # Top traces by total duration: the "where did the slow request
+        # spend its time" entry point without opening Perfetto.
+        by_dur = sorted(idx.items(), key=lambda kv: -kv[1]["dur_us"])[:10]
+        for tid, info in by_dur:
+            print(f"  {tid[:16]}…  {info['dur_us'] / 1e3:9.3f} ms  "
+                  f"{info['n_spans']:3d} spans  "
+                  f"{len(info['pids'])} process(es)  "
+                  f"root={info['root_name']}"
+                  + ("" if info["parented_ok"] else "  [orphaned spans]"))
 
     snapshots = latest_snapshots(args.telemetry_dir)
     if not snapshots:

@@ -1,0 +1,31 @@
+"""Fixture: the idiomatic counterparts — telemetry wraps the CALL SITE
+of traced code from the host, never the traced body."""
+import jax
+
+from multiverso_tpu.telemetry import histogram, span
+
+_H_STEP = histogram("fixture.step")
+
+
+@jax.jit
+def decorated_step(x):
+    return x * 2
+
+
+def host_driver(batches):
+    import time
+    for b in batches:
+        with span("fixture.dispatch"):      # host side: times every call
+            out = decorated_step(b)
+        t0 = time.monotonic()
+        out.block_until_ready()
+        _H_STEP.observe((time.monotonic() - t0) * 1e3)
+    return out
+
+
+def unrelated_observe(sink, value):
+    # .observe on a non-telemetry receiver inside traced code is not ours
+    def step(x):
+        sink.observe(value)
+        return x
+    return jax.jit(step)

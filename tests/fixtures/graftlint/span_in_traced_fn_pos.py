@@ -1,0 +1,22 @@
+"""Fixture: telemetry calls inside traced bodies — trace-time no-ops
+(never imported, only parsed by the lint engine tests)."""
+import jax
+
+from multiverso_tpu.telemetry import histogram, span
+
+_H_STEP = histogram("fixture.step")
+
+
+@jax.jit
+def decorated_step(x):
+    with span("fixture.decorated"):  # expect: span-in-traced-fn
+        y = x * 2
+    histogram("fixture.inner").observe(1.0)  # expect: span-in-traced-fn
+    return y
+
+
+def make_step():
+    def step(w, g):
+        _H_STEP.observe(3.0)  # expect: span-in-traced-fn
+        return w - g
+    return jax.jit(step)

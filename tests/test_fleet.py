@@ -433,3 +433,232 @@ def test_replica_unavailable_error_is_typed(mv_env):
     assert issubclass(ReplicaUnavailableError, OSError)
     with pytest.raises(OSError):
         ServingClient("127.0.0.1", 1, connect_attempts=2)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: server-side cancel for hedged losers + fleet stats rollup
+# ---------------------------------------------------------------------------
+def test_hedged_call_on_settled_reports_winner_and_launched():
+    sched = HedgeScheduler()
+    settled = []
+    try:
+        deliver_1 = []
+
+        def a0(deliver):
+            deliver_1.append(deliver)       # stays outstanding
+
+        def a1(deliver):
+            deliver("second wins")
+
+        HedgedCall([a0, a1], lambda r: None, delay_ms=1.0,
+                   scheduler=sched,
+                   on_settled=lambda w, n: settled.append((w, n))).launch()
+        deadline = time.monotonic() + 5
+        while not settled and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert settled == [(1, 2)]
+        deliver_1[0]("late loser")          # discarded, settled unchanged
+        assert settled == [(1, 2)]
+    finally:
+        sched.close()
+
+
+def test_hedged_call_on_settled_all_failed():
+    sched = HedgeScheduler()
+    settled = []
+    try:
+        def fail(deliver):
+            raise OSError("down")
+
+        HedgedCall([fail, fail], lambda r: None, delay_ms=1.0,
+                   scheduler=sched,
+                   on_settled=lambda w, n: settled.append((w, n))).launch()
+        deadline = time.monotonic() + 5
+        while not settled and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert settled == [(-1, 2)]
+    finally:
+        sched.close()
+
+
+def test_batcher_cancel_drops_queued_request(mv_env):
+    """A queued hedged loser is dropped at admission: on_done gets
+    ShedError('cancelled'), the device never sees it, and
+    serve.cancelled counts it."""
+    from multiverso_tpu.serving.batcher import DynamicBatcher, ShedError
+    from multiverso_tpu.telemetry import get_registry
+
+    ran = []
+
+    class SlowRunner:
+        payload_dtype = np.int32
+        pad_id = 0
+
+        def run(self, mat, lengths):
+            ran.append(mat.copy())
+            time.sleep(0.05)
+            return mat
+
+        def slice_result(self, out, i, n):
+            return out[i, :n]
+
+    b = DynamicBatcher(SlowRunner(), buckets=(4,), max_batch=1,
+                       max_wait_ms=0.0, max_queue=8)
+    try:
+        results = {}
+        done = threading.Event()
+
+        def on_done(key):
+            def cb(result):
+                results[key] = result
+                if key == "cancel_me":
+                    done.set()
+            return cb
+
+        # First request occupies the worker; the second sits queued.
+        b.submit_callback(np.asarray([1], np.int32), 10_000,
+                          on_done("head"))
+        token = b.submit_callback(np.asarray([2], np.int32), 10_000,
+                                  on_done("cancel_me"))
+        assert token is not None
+        before = get_registry().counter("serve.cancelled").value
+        assert b.cancel(token) is True
+        assert done.wait(5)
+        assert isinstance(results["cancel_me"], ShedError)
+        assert results["cancel_me"].reason == "cancelled"
+        assert get_registry().counter("serve.cancelled").value == before + 1
+        # the cancelled payload never reached the runner
+        time.sleep(0.3)
+        assert not any((mat == 2).any() for mat in ran)
+        # cancelling an already-delivered request is a harmless no-op
+        assert b.cancel(token) is False
+    finally:
+        b.close()
+
+
+def test_serve_cancel_over_the_wire(fleet_env):
+    """Serve_Cancel for a queued request answers the ORIGINAL msg_id
+    with Reply_Error('cancelled') — the waiter completes, nothing leaks,
+    and an unknown msg_id is a counted no-op."""
+    from multiverso_tpu.serving import ServingClient, ShedError
+    from multiverso_tpu.telemetry import get_registry
+
+    router, services, members, data = fleet_env
+    svc = services[0]
+    cli = ServingClient(*svc.address)
+    try:
+        # Saturate the batcher briefly so a second request queues.
+        slow = [cli.request_async(np.arange(8, dtype=np.int32), 10_000)
+                for _ in range(8)]
+        victim = cli.request_async(np.arange(4, dtype=np.int32), 10_000)
+        cli.cancel(victim.msg_id)
+        try:
+            victim.wait(timeout=10)
+            outcome = "completed"       # raced past the queue: fine
+        except ShedError as e:
+            # Wire sheds surface as reason "server" with the server's
+            # reason text in the message.
+            outcome = "cancelled" if "cancelled" in str(e) else str(e)
+        assert outcome in ("cancelled", "completed")
+        for r in slow:
+            r.wait(timeout=10)
+        before = get_registry().counter("serve.cancel.miss").value
+        cli.cancel(999_999_999)         # unknown id: counted, harmless
+        deadline = time.monotonic() + 5
+        while get_registry().counter("serve.cancel.miss").value == before \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert get_registry().counter("serve.cancel.miss").value \
+            == before + 1
+    finally:
+        cli.close()
+
+
+def test_fleet_stats_rollup_sums_match_per_replica(fleet_env):
+    from multiverso_tpu.fleet import fetch_fleet_stats
+
+    router, services, members, data = fleet_env
+    cli = FleetClient(router.address, hedge="off", refresh_s=0.05)
+    try:
+        for _ in range(12):
+            cli.lookup(np.arange(6, dtype=np.int32), deadline_ms=10_000,
+                       timeout=30)
+        # Wait until the heartbeat metrics caught up with the traffic.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            stats = fetch_fleet_stats(router.address)
+            if stats["fleet"]["replies"] >= 12:
+                break
+            time.sleep(0.05)
+        assert stats["schema"] == "multiverso_tpu.fleet_stats/v1"
+        assert stats["version"] > 0
+        per = stats["replicas"]
+        assert set(per) == {"r0", "r1"}
+        fleet = stats["fleet"]
+        for key in ("requests", "replies", "shed", "cancelled",
+                    "slo_violations"):
+            assert fleet[key] == sum(r[key] for r in per.values()), key
+        assert fleet["replicas"] == 2
+        # stage percentiles rode along (count-weighted merge is defined
+        # whenever any replica served anything)
+        assert fleet["stages"]["total"]["count"] >= 12
+        # versioned: another metrics-bearing heartbeat bumps it
+        v0 = stats["version"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if fetch_fleet_stats(router.address)["version"] > v0:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("stats version never advanced")
+    finally:
+        cli.close()
+
+
+def test_fleet_top_render_is_stable():
+    from multiverso_tpu.apps.fleet_top import render_stats
+    stats = {
+        "version": 7, "time_unix": 0.0,
+        "fleet": {"replicas": 2, "qps": 123.4, "shed_rate": 0.015,
+                  "queue_depth": 3.0, "inflight": 2.0,
+                  "slo_violations": 9,
+                  "stages": {"total": {"p50": 1.0, "p95": 2.0,
+                                       "p99": 3.0, "count": 10}}},
+        "replicas": {
+            "r0": {"health": 0.9, "qps": 61.7, "shed_rate": 0.01,
+                   "queue_depth": 1.0, "inflight": 1.0,
+                   "slo_violations": 4, "drains_completed": 1,
+                   "draining": False,
+                   "stages": {"total": {"p50": 1.0, "p95": 2.0,
+                                        "p99": 3.0, "count": 5}}},
+            "r1": {"health": 0.0, "qps": 61.7, "shed_rate": 0.02,
+                   "queue_depth": 2.0, "inflight": 1.0,
+                   "slo_violations": 5, "drains_completed": 0,
+                   "draining": True, "stages": {}},
+        },
+    }
+    out = render_stats(stats)
+    lines = out.splitlines()
+    assert lines[0].startswith("fleet_top  v7")
+    assert "qps=123.4" in lines[0]
+    assert any(l.startswith("r0") and "up" in l for l in lines)
+    assert any(l.startswith("r1") and "drain" in l for l in lines)
+    assert lines[-1].startswith("FLEET")
+    # a missing stages dict renders as zeros, never a KeyError
+    assert "0.00" in [l for l in lines if l.startswith("r1")][0]
+
+
+def test_member_rates_survive_sparse_heartbeats():
+    """A heartbeat interval LONGER than the rate window must degrade to
+    rate-over-one-beat, not to permanent zeros (review finding)."""
+    from multiverso_tpu.fleet.membership import MemberInfo
+    info = MemberInfo("r0", "h", 1)
+    t = 1000.0
+    for beat in range(4):
+        info.observe_metrics({"requests": 100.0 * beat,
+                              "replies": 100.0 * beat,
+                              "shed": 0.0}, t + 10.0 * beat)
+    assert len(info.history) >= 2
+    rates = info.rates()
+    assert rates["qps"] > 0.0
+    assert abs(rates["qps"] - 10.0) < 1e-6      # 100 replies / 10 s

@@ -1,17 +1,23 @@
-"""Tier-1 CPU smoke for the fleet serving fabric.
+"""Tier-1 CPU smoke for the fleet serving fabric + distributed tracing.
 
-Drives ``scripts/serve_bench.py --replicas 2 --dry-run`` end to end: an
-in-process FleetRouter, two replica SUBPROCESSES serving the same seeded
-synthetic table, and a hedged FleetClient — asserting the three fleet
-contracts the record carries:
+Drives ``scripts/serve_bench.py --replicas 2 --dry-run`` end to end: a
+router SUBPROCESS (control plane + data proxy), two replica SUBPROCESSES
+serving the same seeded synthetic table, and a hedged FleetClient —
+asserting the contracts the record carries:
 
 * routed lookups (affinity AND ring-split) are bitwise-equal to a direct
   gather of the table (``parity_ok``),
-* a rolling drain of every replica mid-load completes with ZERO failed
-  requests,
+* a wire-triggered rolling drain of every replica mid-load completes
+  with ZERO failed requests,
 * the load window finishes with no request errors and a non-trivial
   achieved QPS, and the record lands in BENCH_SERVE_HISTORY.jsonl so the
-  serving trend file grows with every bench run.
+  serving trend file grows with every bench run,
+* distributed tracing: one sampled request stitches to a SINGLE Chrome
+  trace with correctly-parented spans from >= 3 distinct processes
+  (client, router, replica), hedged attempts appear as siblings tagged
+  ``hedge=1``, the record carries a trace-derived per-stage breakdown
+  plus traced/untraced QPS, and the ``Fleet_Stats`` rollup's fleet sums
+  equal the sum of its per-replica records.
 """
 
 import json
@@ -37,7 +43,7 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert line["replicas"] == 2
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v2"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v3"
     assert record["replicas"] == 2
 
     # Routed lookups bitwise-equal to the direct table gather.
@@ -64,3 +70,41 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert history.exists()
     entries = [json.loads(l) for l in history.read_text().splitlines()]
     assert entries and entries[-1]["benchmark"] == "serve_fleet_lookup"
+
+    # -- distributed tracing acceptance -----------------------------------
+    tracing = record["tracing"]
+    # Both QPS numbers (traced + untraced) so sampling overhead is a
+    # measured fact of the record, not a claim.
+    assert tracing["qps_untraced"] > 0 and tracing["qps_traced"] > 0
+    # One sampled request stitched to ONE trace: >= 5 correctly-parented
+    # spans spanning >= 3 distinct processes (client, router, replica).
+    smoke = tracing["trace_smoke"]
+    assert smoke["found"] is True
+    assert smoke["n_spans"] >= 5
+    assert smoke["n_pids"] >= 3
+    assert smoke["parented_ok"] is True
+    # Hedged duplicates appear as tagged sibling attempts.
+    hedged = smoke["hedged_siblings"]
+    assert hedged["found"] is True
+    assert hedged["n_attempts"] >= 2
+    assert all(tag == 1 for tag in hedged["hedge_tags"])
+    # Trace-derived per-stage breakdown covers the serving pipeline.
+    breakdown = tracing["stage_breakdown"]
+    for stage in ("admit_wait", "batch_form", "device", "reply",
+                  "server_total", "proxy_hop"):
+        assert breakdown[stage]["count"] > 0, stage
+    # K slowest stitched timelines exist and are cross-process.
+    assert tracing["slowest"]
+    assert len(tracing["slowest"][0]["pids"]) >= 2
+
+    # -- Fleet_Stats rollup: fleet sums == sum of per-replica records -----
+    stats = record["fleet_stats"]
+    per = stats["replicas"]
+    assert len(per) == 2
+    fleet = stats["fleet"]
+    for key in ("requests", "replies", "shed", "cancelled",
+                "slo_violations"):
+        assert fleet[key] == sum(r[key] for r in per.values()), key
+    assert abs(fleet["qps"] - sum(r["qps"] for r in per.values())) < 1e-6
+    assert fleet["replies"] > 0
+    assert stats["version"] > 0

@@ -27,7 +27,8 @@ _EXPECT_RE = re.compile(r"#\s*expect:\s*([a-z0-9\-]+)")
 RULES = ("implicit-host-sync", "block-until-ready-in-loop",
          "retrace-hazard", "missing-donation", "host-jnp-in-loop",
          "lock-order-cycle", "unlocked-registry-mutation",
-         "bare-thread-no-join", "bare-print", "unbounded-queue-append")
+         "bare-thread-no-join", "bare-print", "unbounded-queue-append",
+         "span-in-traced-fn")
 
 
 def _expected_lines(path, rule):

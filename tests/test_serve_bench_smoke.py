@@ -28,14 +28,25 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["benchmark"] == "serve_lookup"
     record = json.loads(out.read_text())
-    # v2: adds benchmark/n_error/error_rate + fleet fields (superset of v1)
-    assert record["schema"] == "multiverso_tpu.bench_serve/v2"
+    # v3: + tracing block (stage breakdown, slowest-K, traced/untraced QPS)
+    assert record["schema"] == "multiverso_tpu.bench_serve/v3"
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
     assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
     assert record["n_ok"] > 0
     assert 0.0 <= record["shed_rate"] <= 1.0
     assert record["achieved_qps"] > 0
+    # tracing block: both QPS numbers + a trace-derived stage breakdown
+    tracing = record["tracing"]
+    assert tracing["qps_untraced"] > 0 and tracing["qps_traced"] > 0
+    breakdown = tracing["stage_breakdown"]
+    for stage in ("admit_wait", "batch_form", "device", "reply"):
+        assert breakdown[stage]["count"] > 0, stage
+        assert breakdown[stage]["p50"] <= breakdown[stage]["p95"] \
+            <= breakdown[stage]["p99"]
+    assert tracing["slowest"], "no slow-request timelines recorded"
+    slow = tracing["slowest"][0]
+    assert slow["n_spans"] >= 3 and slow["stages"]
     # the serve.* metric family rides along with the record
     assert any(k.startswith("serve.latency.")
                for k in record["serve_metrics"]["histograms"])

@@ -1,0 +1,337 @@
+"""Distributed-tracing unit tests: TraceContext semantics, span
+parenting, wire codec, head-based sampling + tail exemplars, cross-
+process stitching (including a hedged duplicate-span request), and the
+exporter/registry under CONCURRENT mutation — the single-threaded-only
+coverage gap called out in ISSUE 7.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.telemetry import (TraceBuffer, activate,
+                                      build_chrome_trace, child_of,
+                                      current_context, emit_span,
+                                      get_registry, get_trace_buffer,
+                                      new_root, span, stitch_traces,
+                                      trace_index, validate_chrome_trace)
+from multiverso_tpu.telemetry.context import (TraceContext, from_wire,
+                                              to_wire)
+
+
+# ---------------------------------------------------------------------------
+# Context mechanics
+# ---------------------------------------------------------------------------
+def test_current_context_is_thread_local():
+    root = new_root(sampled=True)
+    seen = {}
+
+    def other():
+        seen["other"] = current_context()
+
+    with activate(root):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert current_context() is root
+    assert seen["other"] is None        # contexts never leak across threads
+    assert current_context() is None    # ...and the stack pops cleanly
+
+
+def test_child_of_links_trace_and_parent():
+    root = new_root(sampled=True)
+    child = child_of(root)
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id != root.span_id
+    assert child.sampled == root.sampled
+    hedged = child_of(root, hedge=2)
+    assert hedged.hedge == 2
+
+
+def test_wire_roundtrip_and_malformed_blob():
+    ctx = TraceContext(trace_id=(123 << 64) | 456, span_id=789,
+                      parent_id=99, sampled=True, hedge=3)
+    back = from_wire(to_wire(ctx))
+    assert back == ctx
+    unsampled = TraceContext(trace_id=1, span_id=2, sampled=False)
+    assert from_wire(to_wire(unsampled)).sampled is False
+    # Malformed blobs mean "no context", never an exception.
+    assert from_wire(np.asarray([1, 2, 3])) is None
+    assert from_wire(np.zeros(5, dtype=np.uint64)) is None  # span id 0
+    assert from_wire("garbage") is None
+
+
+def test_span_parents_under_active_context():
+    buf = get_trace_buffer()
+    buf.clear()
+    root = new_root(sampled=True)
+    with activate(root):
+        with span("outer"):
+            with span("inner"):
+                pass
+    inner, outer = buf.events()
+    assert outer["args"]["trace"] == root.trace_hex
+    assert outer["args"]["parent"] == root.span_hex
+    assert inner["args"]["parent"] == outer["args"]["span"]
+    assert inner["args"]["trace"] == outer["args"]["trace"]
+
+
+def test_span_without_context_has_no_trace_fields():
+    buf = get_trace_buffer()
+    buf.clear()
+    with span("legacy"):
+        pass
+    (ev,) = buf.events()
+    assert "trace" not in ev["args"]
+
+
+def test_unsampled_context_skips_buffer_but_times_histogram():
+    buf = get_trace_buffer()
+    buf.clear()
+    root = new_root(sampled=False)
+    h = get_registry().histogram("span.quiet")
+    before = h.count
+    with activate(root):
+        with span("quiet"):
+            pass
+    assert buf.events() == []
+    assert h.count == before + 1
+
+
+def test_emit_span_force_records_tail_exemplar():
+    buf = get_trace_buffer()
+    buf.clear()
+    root = new_root(sampled=False)
+    emit_span("not.recorded", root, time.monotonic(), 1.0)
+    assert buf.events() == []
+    emit_span("tail.recorded", root, time.monotonic() - 0.2, 200.0,
+              force=True, shed="deadline")
+    (ev,) = buf.events()
+    assert ev["args"]["tail"] == 1
+    assert ev["args"]["shed"] == "deadline"
+    assert ev["dur"] == 200_000      # microseconds
+
+
+def test_sampling_rate_zero_means_no_root(monkeypatch):
+    from multiverso_tpu.telemetry import maybe_new_root
+    from multiverso_tpu.utils.configure import set_flag
+    old = None
+    try:
+        from multiverso_tpu.utils.configure import get_flag
+        old = float(get_flag("telemetry_sample_rate"))
+        set_flag("telemetry_sample_rate", 0.0)
+        assert maybe_new_root() is None
+        set_flag("telemetry_sample_rate", 1.0)
+        root = maybe_new_root()
+        assert root is not None and root.sampled
+    finally:
+        if old is not None:
+            set_flag("telemetry_sample_rate", old)
+
+
+# ---------------------------------------------------------------------------
+# Stitching
+# ---------------------------------------------------------------------------
+def _ev(name, trace, spanid, parent, pid, ts, dur, **extra):
+    args = {"trace": trace, "span": spanid, "rank": 0}
+    if parent:
+        args["parent"] = parent
+    args.update(extra)
+    return {"name": name, "ph": "X", "ts": ts, "dur": dur, "pid": pid,
+            "tid": 1, "cat": "multiverso_tpu", "args": args}
+
+
+def test_stitch_interleaved_multiprocess_traces_with_hedge(tmp_path):
+    """Three per-process trace files, two interleaved requests, one of
+    them hedged (duplicate sibling attempts answered by different
+    replicas): the stitch must yield one trace per request, correct
+    parent links, both hedge tags, and flow events for each hop."""
+    t1, t2 = "a" * 32, "b" * 32
+    client = [  # pid 100: both roots + three attempts, interleaved
+        _ev("fleet.request", t1, "0001", None, 100, 1000, 5000),
+        _ev("fleet.request", t2, "0002", None, 100, 1200, 9000),
+        _ev("fleet.attempt", t1, "0011", "0001", 100, 1100, 4000),
+        _ev("fleet.attempt", t2, "0021", "0002", 100, 1300, 8000,
+            hedge=1, attempt=0),
+        _ev("fleet.attempt", t2, "0022", "0002", 100, 4000, 5000,
+            hedge=1, attempt=1),
+    ]
+    replica_a = [  # pid 200 answers t1's attempt and t2's primary
+        _ev("serve.request", t1, "0111", "0011", 200, 1500, 3000),
+        _ev("serve.device", t1, "0112", "0111", 200, 2000, 1000),
+        _ev("serve.request", t2, "0121", "0021", 200, 1800, 7000),
+    ]
+    replica_b = [  # pid 300 answers t2's hedged duplicate
+        _ev("serve.request", t2, "0131", "0022", 300, 4500, 4000),
+    ]
+    for i, events in enumerate((client, replica_a, replica_b)):
+        (tmp_path / f"trace-{i}.json").write_text(
+            json.dumps({"traceEvents": events}))
+    paths = [str(tmp_path / f"trace-{i}.json") for i in range(3)]
+
+    stitched = stitch_traces(paths, out_path=str(tmp_path / "out.json"))
+    validate_chrome_trace(stitched)
+    spans = [e for e in stitched["traceEvents"] if e["ph"] == "X"]
+    idx = trace_index(spans)
+    assert set(idx) == {t1, t2}
+    assert idx[t1]["n_spans"] == 4 and idx[t1]["parented_ok"]
+    assert idx[t1]["pids"] == [100, 200]
+    assert idx[t2]["n_spans"] == 5 and idx[t2]["parented_ok"]
+    assert idx[t2]["pids"] == [100, 200, 300]
+    assert idx[t2]["dur_us"] == 9000        # root duration, not max child
+    # Hedged duplicates: sibling attempts under one parent, tagged.
+    attempts = [e for e in spans if e["name"] == "fleet.attempt"
+                and e["args"]["trace"] == t2]
+    assert len(attempts) == 2
+    assert {e["args"]["parent"] for e in attempts} == {"0002"}
+    assert all(e["args"]["hedge"] == 1 for e in attempts)
+    # Flow events: one s/f pair per cross-process parent->child edge
+    # (t1: attempt->serve.request; t2: two attempts -> two replicas).
+    flows = [e for e in stitched["traceEvents"] if e["ph"] in "sf"]
+    assert len(flows) == 2 * 3
+    # Filtering to one trace id keeps only that request.
+    only_t2 = stitch_traces(paths, trace_id=t2)
+    only_spans = [e for e in only_t2["traceEvents"] if e["ph"] == "X"]
+    assert {e["args"]["trace"] for e in only_spans} == {t2}
+
+
+def test_trace_index_flags_orphans(tmp_path):
+    t = "c" * 32
+    events = [_ev("child", t, "0201", "dead", 100, 1000, 10)]
+    (tmp_path / "trace-0.json").write_text(
+        json.dumps({"traceEvents": events}))
+    stitched = stitch_traces([str(tmp_path / "trace-0.json")])
+    idx = trace_index([e for e in stitched["traceEvents"]
+                       if e["ph"] == "X"])
+    assert idx[t]["parented_ok"] is False
+    assert idx[t]["n_orphans"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Registry / exporter under concurrent mutation
+# ---------------------------------------------------------------------------
+def test_registry_snapshot_under_concurrent_mutation():
+    """snapshot() while other threads register NEW metrics and observe
+    existing ones: no exception, and every snapshot is internally
+    consistent (the single-threaded-only coverage gap)."""
+    reg = get_registry()
+    stop = threading.Event()
+    errors = []
+
+    def mutator(tid):
+        i = 0
+        try:
+            while not stop.is_set():
+                reg.histogram(f"conc.h{tid}.{i % 37}").observe(i % 11)
+                reg.counter(f"conc.c{tid}.{i % 29}").inc()
+                reg.gauge(f"conc.g{tid}.{i % 23}").set(i)
+                i += 1
+        except Exception as e:  # noqa: BLE001 - reported below
+            errors.append(e)
+
+    def snapshotter():
+        try:
+            while not stop.is_set():
+                snap = reg.snapshot()
+                for h in snap["histograms"].values():
+                    assert h["count"] == sum(h["bucket_counts"])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=mutator, args=(i,))
+               for i in range(3)] + \
+        [threading.Thread(target=snapshotter) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.8)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors[:2]
+
+
+def test_exporter_write_once_under_concurrent_spans(tmp_path):
+    """The exporter writing snapshots + traces while other threads emit
+    spans and register metrics: every written file stays valid JSON and
+    schema-clean."""
+    from multiverso_tpu.telemetry import (TelemetryExporter,
+                                          validate_snapshot)
+    stop = threading.Event()
+    errors = []
+
+    def spanner(tid):
+        root = new_root(sampled=True)
+        try:
+            with activate(root):
+                i = 0
+                while not stop.is_set():
+                    with span(f"conc.span{tid}", i=i):
+                        pass
+                    i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    exporter = TelemetryExporter(str(tmp_path), interval=0.05)
+    threads = [threading.Thread(target=spanner, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(5):
+            exporter.write_once()
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        exporter.stop()
+    assert not errors, errors[:2]
+    snaps = [f for f in os.listdir(tmp_path) if f.startswith("metrics-")]
+    traces = [f for f in os.listdir(tmp_path) if f.startswith("trace-")]
+    assert snaps and traces
+    for f in snaps:
+        validate_snapshot(json.load(open(tmp_path / f)))
+    for f in traces:
+        validate_chrome_trace(json.load(open(tmp_path / f)))
+
+
+def test_trace_buffer_record_during_events_iteration():
+    buf = TraceBuffer(capacity=256)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        try:
+            while not stop.is_set():
+                buf.record({"name": "x", "ph": "X", "ts": i, "dur": 1,
+                            "pid": 1, "tid": 1, "args": {}})
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(200):
+            events = buf.events()
+            assert len(events) <= 256
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not errors
+    assert buf.dropped > 0      # the ring evicted, never grew
+
+
+def test_build_chrome_trace_validates_with_trace_fields():
+    get_trace_buffer().clear()
+    root = new_root(sampled=True)
+    with activate(root):
+        with span("v", runner="x"):
+            pass
+    validate_chrome_trace(build_chrome_trace())
