@@ -67,6 +67,14 @@ def serve_config() -> dict:
                          "(want e.g. '8,16,32,64')") from None
     if not buckets:
         raise FatalError("-serve_buckets must name at least one bucket")
+    depth_raw = str(get_flag("serve_pipeline_depth")).strip().lower()
+    if depth_raw not in ("", "auto"):
+        try:
+            int(depth_raw)
+        except ValueError:
+            raise FatalError(f"bad -serve_pipeline_depth value "
+                             f"'{depth_raw}' (want an int or 'auto')") \
+                from None
     return {
         "host": str(get_flag("serve_host")),
         "port": int(get_flag("serve_port")),
@@ -74,6 +82,10 @@ def serve_config() -> dict:
         "max_batch": int(get_flag("serve_max_batch")),
         "max_wait_ms": float(get_flag("serve_max_wait_ms")),
         "max_queue": int(get_flag("serve_admission")),
+        "pipeline_depth": depth_raw or "auto",
+        "cache_rows": int(get_flag("serve_cache_rows")),
+        "cache_staleness": int(get_flag("serve_cache_staleness")),
+        "continuous": bool(get_flag("serve_continuous")),
     }
 
 

@@ -86,18 +86,25 @@ def _build_synthetic_runner(rows: int, cols: int, seed: int):
         "fleet_synthetic", (rows, cols), np.float32,
         get_updater(np.float32, "default"), mesh, num_workers=1,
         init_array=rng.normal(size=(rows, cols)).astype(np.float32))
-    return SparseLookupRunner(store), None
+    from multiverso_tpu.serving.cache import cache_from_flags
+    # The synthetic table is immutable: a constant clock is its honest
+    # version (live tables without a real clock refuse to cache —
+    # runners.try_cached).
+    return SparseLookupRunner(store, clock_fn=lambda: (0.0, 0.0),
+                              cache=cache_from_flags()), None
 
 
 def _build_checkpoint_runner(ckpt_dir: str):
     from multiverso_tpu.serving import CheckpointReplica, ReplicaLookupRunner
+    from multiverso_tpu.serving.cache import cache_from_flags
 
     replica = CheckpointReplica(ckpt_dir)
     snap = replica.snapshot()
     table = str(get_flag("serve_table")) or snap.names[0]
     check(table in snap.names,
           f"-serve_table={table!r} not in checkpoint (has {snap.names})")
-    return ReplicaLookupRunner(replica, table), replica
+    return ReplicaLookupRunner(replica, table,
+                               cache=cache_from_flags()), replica
 
 
 def _replica_body(cfg: dict) -> int:
@@ -119,7 +126,9 @@ def _replica_body(cfg: dict) -> int:
     service.register_runner(runner, buckets=scfg["buckets"],
                             max_batch=scfg["max_batch"],
                             max_wait_ms=scfg["max_wait_ms"],
-                            max_queue=scfg["max_queue"])
+                            max_queue=scfg["max_queue"],
+                            pipeline_depth=scfg["pipeline_depth"],
+                            continuous=scfg["continuous"])
     # Warm BEFORE joining the ring: the first routed request must never
     # pay a trace.
     warmed = service.warmup()

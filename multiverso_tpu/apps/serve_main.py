@@ -42,7 +42,8 @@ define_double("serve_refresh_s", 5.0, "seconds between checkpoint "
 def _body(remaining: List[str]) -> int:
     del remaining
     from multiverso_tpu.serving import (CheckpointReplica,
-                                        ReplicaLookupRunner, ServingService)
+                                        ReplicaLookupRunner, ServingService,
+                                        cache_from_flags)
 
     ckpt_dir = str(get_flag("checkpoint_dir"))
     check(bool(ckpt_dir), "-checkpoint_dir is required")
@@ -55,11 +56,14 @@ def _body(remaining: List[str]) -> int:
     replica.start_auto_refresh(float(get_flag("serve_refresh_s")))
 
     service = ServingService(host=cfg["host"], port=cfg["port"])
-    service.register_runner(ReplicaLookupRunner(replica, table),
+    service.register_runner(ReplicaLookupRunner(replica, table,
+                                                cache=cache_from_flags()),
                             buckets=cfg["buckets"],
                             max_batch=cfg["max_batch"],
                             max_wait_ms=cfg["max_wait_ms"],
-                            max_queue=cfg["max_queue"])
+                            max_queue=cfg["max_queue"],
+                            pipeline_depth=cfg["pipeline_depth"],
+                            continuous=cfg["continuous"])
     host, port = service.address
     log.info("serving table '%s' (step %d) at %s:%d", table, snap.step,
              host, port)

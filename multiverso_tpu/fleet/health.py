@@ -6,7 +6,9 @@ exports (``serve.queue_depth``, ``serve.inflight``, ``serve.replica_step``
 the same numbers an operator graphs. The router folds them into one scalar
 in ``(0, 1]``:
 
-    load   = queue_depth / max_queue  +  inflight / max_batch
+    busy   = pipeline_inflight / pipeline_depth     (pipelined members)
+           = inflight / max_batch                   (serialized members)
+    load   = queue_depth / max_queue + busy
              + staleness_steps * STALENESS_WEIGHT
     health = 1 / (1 + load)          (0.0 when draining or dead)
 
@@ -16,6 +18,14 @@ checkpoint steps the replica lags the freshest member — a soft penalty so
 traffic drifts toward replicas serving newer parameters without starving
 a refresh-lagged one outright. Draining or dead pins the score to 0.0,
 which removes the replica from every candidate list.
+
+``pipeline_inflight`` exists because the dispatch pipeline (PR 9,
+serving/pipeline.py) moved the place work queues: a pipelined replica
+runs with a near-EMPTY admission queue while up to ``pipeline_depth``
+whole batches ride the device window. Scoring only the queue would make
+a saturated pipelined replica look idle to the router; window occupancy
+over window depth is the same normalized load the queue term expresses,
+one stage later.
 """
 
 from __future__ import annotations
@@ -27,8 +37,11 @@ STALENESS_WEIGHT = 0.25     # one checkpoint step behind ~ 25% extra load
 #: Heartbeat stat fields. ``drains_completed`` is a per-member monotonic
 #: count — the router's drain driver watches it instead of trying to
 #: catch the (possibly sub-heartbeat) draining=1 window in flight.
+#: ``pipeline_inflight``/``pipeline_depth`` carry the dispatch-window
+#: occupancy (0/0 from pre-pipeline members: the score term vanishes).
 STAT_FIELDS = ("queue_depth", "inflight", "replica_step", "draining",
-               "max_queue", "max_batch", "drains_completed")
+               "max_queue", "max_batch", "drains_completed",
+               "pipeline_inflight", "pipeline_depth")
 
 
 def health_score(stats: Mapping[str, float], fleet_max_step: float) -> float:
@@ -37,15 +50,28 @@ def health_score(stats: Mapping[str, float], fleet_max_step: float) -> float:
         return 0.0
     q_bound = max(1.0, float(stats.get("max_queue", 1.0)))
     b_width = max(1.0, float(stats.get("max_batch", 1.0)))
-    load = (float(stats.get("queue_depth", 0.0)) / q_bound
-            + float(stats.get("inflight", 0.0)) / b_width)
+    p_depth = float(stats.get("pipeline_depth", 0.0))
+    # ONE device-busy term, not two: serve.inflight and the window
+    # occupancy measure the SAME work in pipelined mode (the batcher
+    # sets serve.inflight to the window's request count), so a pipelined
+    # member uses occupancy/depth and a serialized member inflight/
+    # max_batch — both normalize a saturated device to +1.0 load.
+    # Summing both would score a saturated pipelined replica half the
+    # health of an equally saturated serialized one and route the fleet
+    # AWAY from its faster members.
+    if p_depth > 0.0:
+        busy = float(stats.get("pipeline_inflight", 0.0)) / p_depth
+    else:
+        busy = float(stats.get("inflight", 0.0)) / b_width
+    load = float(stats.get("queue_depth", 0.0)) / q_bound + busy
     step = float(stats.get("replica_step", -1.0))
     if step >= 0.0 and fleet_max_step > step:
         load += (fleet_max_step - step) * STALENESS_WEIGHT
     return 1.0 / (1.0 + load)
 
 
-def local_stats(max_queue: int, max_batch: int) -> Dict[str, float]:
+def local_stats(max_queue: int, max_batch: int,
+                pipeline_depth: int = 0) -> Dict[str, float]:
     """A replica's own heartbeat payload, read from the process-local
     telemetry registry — the exported gauges ARE the health feed. The
     member overlays its instance-local drain state on top (the registry
@@ -60,6 +86,8 @@ def local_stats(max_queue: int, max_batch: int) -> Dict[str, float]:
         "max_queue": float(max_queue),
         "max_batch": float(max_batch),
         "drains_completed": 0.0,
+        "pipeline_inflight": float(gauge("serve.pipeline.inflight").last),
+        "pipeline_depth": float(pipeline_depth),
     }
 
 
@@ -121,6 +149,14 @@ def metrics_payload() -> Dict:
         "cancelled": reg.counter("serve.cancelled").value,
         "queue_depth": float(reg.gauge("serve.queue_depth").last),
         "inflight": float(reg.gauge("serve.inflight").last),
+        "pipeline_inflight": float(
+            reg.gauge("serve.pipeline.inflight").last),
+        # Lifetime window-occupancy peak: the bench's "overlap actually
+        # happened" witness (a last-value gauge almost always reads 0
+        # between batches).
+        "pipeline_inflight_max": float(
+            reg.gauge("serve.pipeline.inflight").snapshot()["max"] or 0.0),
+        "cache_hits": reg.counter("serve.cache.hit").value,
         "slo_ms": slo_ms,
         "slo_violations": slo_violations(
             reg.histogram("serve.latency.total"), slo_ms),

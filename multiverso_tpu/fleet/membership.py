@@ -290,6 +290,11 @@ class ReplicaGroup:
                 "cancelled": int(met.get("cancelled", 0)),
                 "queue_depth": float(met.get("queue_depth", 0.0)),
                 "inflight": float(met.get("inflight", 0.0)),
+                "pipeline_inflight": float(met.get("pipeline_inflight",
+                                                   0.0)),
+                "pipeline_inflight_max": float(
+                    met.get("pipeline_inflight_max", 0.0)),
+                "cache_hits": int(met.get("cache_hits", 0)),
                 "slo_ms": float(met.get("slo_ms", 0.0)),
                 "slo_violations": int(met.get("slo_violations", 0)),
                 "stages": dict(met.get("stages", {})),
@@ -306,6 +311,9 @@ class ReplicaGroup:
             "queue_depth": round(sum(p["queue_depth"]
                                      for p in per.values()), 3),
             "inflight": round(sum(p["inflight"] for p in per.values()), 3),
+            "pipeline_inflight": round(sum(p["pipeline_inflight"]
+                                           for p in per.values()), 3),
+            "cache_hits": sum(p["cache_hits"] for p in per.values()),
             "slo_violations": sum(p["slo_violations"]
                                   for p in per.values()),
         }
@@ -414,7 +422,8 @@ class FleetMember:
                 if self._stop.is_set():
                     return
                 b = self.service.batcher(0)
-                stats = local_stats(b.max_queue, b.max_batch)
+                stats = local_stats(b.max_queue, b.max_batch,
+                                    getattr(b, "pipeline_depth", 0))
                 stats["draining"] = 1.0 if self._drain_active else 0.0
                 stats["drains_completed"] = float(self._drains_done)
                 reply = self._rpc(MsgType.Fleet_Heartbeat, {

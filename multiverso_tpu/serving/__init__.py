@@ -16,10 +16,14 @@ See docs/SERVING.md for architecture and tuning.
 
 from multiverso_tpu.serving.batcher import (BucketLadder, DynamicBatcher,
                                             ServeRequest, ShedError)
+from multiverso_tpu.serving.cache import HotRowCache, cache_from_flags
 from multiverso_tpu.serving.client import (ReplicaUnavailableError,
                                            RoutedLookupClient, ServeResult,
                                            ServingClient,
                                            connect_with_backoff)
+from multiverso_tpu.serving.continuous import ContinuousBatcher
+from multiverso_tpu.serving.pipeline import (DispatchPipeline,
+                                             resolve_pipeline_depth)
 from multiverso_tpu.serving.replica import (CheckpointReplica,
                                             ReplicaSnapshot,
                                             load_checkpoint_tables)
@@ -31,9 +35,11 @@ from multiverso_tpu.serving.service import ServingService
 
 __all__ = [
     "AttentionLMRunner", "BucketLadder", "CheckpointReplica",
-    "DynamicBatcher", "ReplicaLookupRunner", "ReplicaSnapshot",
+    "ContinuousBatcher", "DispatchPipeline", "DynamicBatcher",
+    "HotRowCache", "ReplicaLookupRunner", "ReplicaSnapshot",
     "ReplicaUnavailableError", "RoutedLookupClient", "ServeRequest",
     "ServeResult", "ServingClient", "ServingRunner", "ServingService",
-    "ShedError", "SparseLookupRunner", "connect_with_backoff",
-    "load_checkpoint_tables",
+    "ShedError", "SparseLookupRunner", "cache_from_flags",
+    "connect_with_backoff", "load_checkpoint_tables",
+    "resolve_pipeline_depth",
 ]

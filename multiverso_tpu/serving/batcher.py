@@ -16,6 +16,22 @@ most aggregate slack. Requests that expire while queued are shed at batch
 formation instead of wasting device time. Overload therefore degrades to
 a bounded queue + rising shed counters, never an unbounded backlog
 (``serve.shed.*`` counters + ``serve.queue_depth`` gauge tell the story).
+
+Dispatch is PIPELINED when the runner speaks the two-phase contract
+(``dispatch``/``collect`` — serving/pipeline.py): the worker gathers,
+pads, and launches batch ``k+1`` while batch ``k`` is still on device,
+and a collector thread syncs + delivers in FIFO order. Batching turns
+adaptive with it: the head request waits for company ONLY while the
+dispatch window is full (the device is the bottleneck and waiting is
+free); with a free slot it dispatches immediately, so an idle service
+adds zero artificial batching latency instead of the fixed
+``max_wait_ms``. A runner without the contract (or
+``pipeline_depth<2``) keeps the serialized gather->run->deliver loop
+bit-for-bit.
+
+A runner may also answer a request host-side at ADMISSION via
+``try_cached`` (the hot-row cache, serving/cache.py): a fully-hot
+request skips the queue, the batch, and the device entirely.
 """
 
 from __future__ import annotations
@@ -116,7 +132,9 @@ class DynamicBatcher:
 
     def __init__(self, runner, buckets: Sequence[int],
                  max_batch: int = 8, max_wait_ms: float = 2.0,
-                 max_queue: int = 64):
+                 max_queue: int = 64, pipeline_depth=0):
+        from multiverso_tpu.serving.pipeline import make_pipeline
+
         self.runner = runner
         self.ladder = BucketLadder(buckets)
         self.max_batch = max(1, int(max_batch))
@@ -126,6 +144,9 @@ class DynamicBatcher:
         self._queue: "collections.deque[ServeRequest]" = collections.deque()
         self._running = True
         self._busy = False      # a batch is mid-dispatch (quiesce barrier)
+        # Depth-N double-buffered dispatch (serving/pipeline.py); None =
+        # the serialized path (runner lacks dispatch/collect, or depth<2).
+        self._pipeline = make_pipeline(runner, pipeline_depth)
         # Telemetry (docs/OBSERVABILITY.md catalog, serve.* family).
         self._g_depth = gauge("serve.queue_depth")
         self._g_inflight = gauge("serve.inflight")
@@ -141,6 +162,12 @@ class DynamicBatcher:
         self._worker = threading.Thread(target=self._loop,
                                         name="serve-batcher", daemon=True)
         self._worker.start()
+
+    @property
+    def pipeline_depth(self) -> int:
+        """Resolved dispatch-window depth (0 = serialized path) — what
+        the fleet heartbeat reports next to the occupancy gauge."""
+        return self._pipeline.depth if self._pipeline is not None else 0
 
     # -- submission ---------------------------------------------------------
     def submit(self, payload: np.ndarray,
@@ -174,6 +201,20 @@ class DynamicBatcher:
                               f"payload length {payload.shape[0]} exceeds "
                               f"largest bucket {self.ladder.max}"))
             return None
+        if deadline_ms > 0.0:
+            # Hot-row cache fast path: a fully-hot request is answered on
+            # the submit thread — no queue, no batch, no device. Already-
+            # expired requests (deadline_ms<=0) keep the shed semantics.
+            hit = self._try_cached(payload)
+            if hit is not None:
+                self._c_requests.inc()
+                ctx = current_context()
+                if ctx is not None and ctx.sampled:
+                    emit_span("serve.cache_hit", child_of(ctx), now,
+                              (time.monotonic() - now) * 1e3,
+                              keys=int(payload.shape[0]))
+                on_done(hit)
+                return None
         req = ServeRequest(payload=payload,
                            deadline=now + max(deadline_ms, 0.0) / 1e3,
                            t_submit=now, on_done=on_done,
@@ -189,6 +230,16 @@ class DynamicBatcher:
         for victim, err in shed:
             victim.on_done(err)
         return None if any(v is req for v, _ in shed) else req
+
+    def _try_cached(self, payload: np.ndarray) -> Optional[np.ndarray]:
+        fn = getattr(self.runner, "try_cached", None)
+        if fn is None:
+            return None
+        try:
+            return fn(payload)
+        except Exception as e:  # noqa: BLE001 - a hostile payload falls
+            log.error("serve batcher: cache probe failed: %s", e)  # back
+            return None                          # to the guarded device path
 
     def cancel(self, req: ServeRequest) -> bool:
         """Server-side hedged-loser cancel: drop ``req`` at admission if
@@ -245,11 +296,19 @@ class DynamicBatcher:
         while True:
             batch = self._gather_batch()
             if batch is None:
+                if self._pipeline is not None:
+                    self._pipeline.close()
                 return
             if not batch:
                 self._busy = False      # popped entries all expired
                 continue
             self._c_requests.inc(len(batch))
+            if self._pipeline is not None:
+                try:
+                    self._dispatch_batch(batch)
+                finally:
+                    self._busy = False
+                continue
             self._g_inflight.set(len(batch))
             try:
                 self._run_batch(batch)
@@ -258,8 +317,9 @@ class DynamicBatcher:
             self._g_inflight.set(0)
 
     def quiesce(self, timeout_s: float = 30.0) -> bool:
-        """Block until the queue is empty AND no batch is mid-dispatch —
-        the drain barrier a rolling checkpoint swap needs before touching
+        """Block until the queue is empty AND no batch is mid-dispatch
+        (including every batch still riding the dispatch pipeline) — the
+        drain barrier a rolling checkpoint swap needs before touching
         the runner's weights. New submissions are NOT blocked (a draining
         fleet replica keeps serving; it just waits for a quiet instant),
         so under sustained load this can time out: returns False then."""
@@ -267,7 +327,7 @@ class DynamicBatcher:
         while time.monotonic() < deadline:
             with self._cv:
                 idle = not self._queue and not self._busy
-            if idle:
+            if idle and (self._pipeline is None or self._pipeline.empty()):
                 return True
             time.sleep(0.002)
         return False
@@ -275,7 +335,10 @@ class DynamicBatcher:
     def _gather_batch(self) -> Optional[List[ServeRequest]]:
         """Blocks for the head request, then waits up to ``max_wait_ms``
         (from the head's submit) for company; sheds expired entries.
-        Returns None on shutdown with an empty queue."""
+        PIPELINED mode waits only while the dispatch window is full
+        (waiting is free when the device is busy; with a free slot an
+        immediate dispatch beats any amount of coalescing). Returns None
+        on shutdown with an empty queue."""
         with self._cv:
             while self._running and not self._queue:
                 self._cv.wait(0.2)
@@ -285,6 +348,8 @@ class DynamicBatcher:
             flush_at = head.t_submit + self.max_wait_s
             while (self._running and len(self._queue) < self.max_batch
                    and time.monotonic() < flush_at):
+                if self._pipeline is not None and not self._pipeline.full():
+                    break           # free dispatch slot: go now
                 self._cv.wait(max(flush_at - time.monotonic(), 1e-4))
             batch = [self._queue.popleft()
                      for _ in range(min(self.max_batch, len(self._queue)))]
@@ -313,6 +378,22 @@ class DynamicBatcher:
                 live.append(r)
         return live
 
+    def _form_batch(self, batch: List[ServeRequest], t0: float):
+        """Pad the batch into its bucket-shaped matrix — the ONE
+        formation path shared by the serialized and pipelined loops
+        (padding/dtype/bucket fixes must never diverge between them)."""
+        bucket = self.ladder.pick(max(r.payload.shape[0] for r in batch))
+        dtype = getattr(self.runner, "payload_dtype", np.int32)
+        pad_id = getattr(self.runner, "pad_id", 0)
+        mat = np.full((self.max_batch, bucket), pad_id, dtype=dtype)
+        lengths = np.zeros(self.max_batch, dtype=np.int32)
+        for i, r in enumerate(batch):
+            n = r.payload.shape[0]
+            mat[i, :n] = r.payload
+            lengths[i] = n
+        self._h_batch.observe((time.monotonic() - t0) * 1e3)
+        return mat, lengths, bucket
+
     def _run_batch(self, batch: List[ServeRequest]) -> None:
         """Exactly-once delivery: each request's ``on_done`` runs once no
         matter where a failure lands — a runner error sheds the whole
@@ -325,17 +406,7 @@ class DynamicBatcher:
             # payload rank, but a dtype a runner can't cast must shed the
             # batch, never kill the worker thread (one hostile client
             # would otherwise wedge the service for everyone).
-            bucket = self.ladder.pick(max(r.payload.shape[0]
-                                          for r in batch))
-            dtype = getattr(self.runner, "payload_dtype", np.int32)
-            pad_id = getattr(self.runner, "pad_id", 0)
-            mat = np.full((self.max_batch, bucket), pad_id, dtype=dtype)
-            lengths = np.zeros(self.max_batch, dtype=np.int32)
-            for i, r in enumerate(batch):
-                n = r.payload.shape[0]
-                mat[i, :n] = r.payload
-                lengths[i] = n
-            self._h_batch.observe((time.monotonic() - t0) * 1e3)
+            mat, lengths, bucket = self._form_batch(batch, t0)
             t1 = time.monotonic()
             with span("serve.batch",
                       runner=getattr(self.runner, "name", "?"),
@@ -369,6 +440,85 @@ class DynamicBatcher:
                 log.error("serve batcher: result slice failed: %s", e)
                 result = ShedError("closed", f"runner error: {e}")
             self._safe_done(r, result)
+
+    # -- pipelined dispatch (serving/pipeline.py) ---------------------------
+    def _dispatch_batch(self, batch: List[ServeRequest]) -> None:
+        """Form + LAUNCH the batch without waiting for the device, then
+        hand it to the pipeline window; delivery happens on the collector
+        thread in FIFO order. Formation/dispatch failures shed the whole
+        batch (nothing delivered yet) — the same exactly-once contract
+        as the serialized path."""
+        from multiverso_tpu.serving.pipeline import InflightBatch
+
+        t0 = time.monotonic()
+        # Reserve the window slot BEFORE launching: the bound is on
+        # device in-flight work, so dispatching first would let depth+1
+        # batches ride the device while the producer blocks. Formation
+        # below still overlaps the device (the wait is the backpressure).
+        if not self._pipeline.wait_for_slot():
+            for r in batch:
+                self._safe_done(r, ShedError("closed",
+                                             "batcher is closed"))
+            return
+        try:
+            mat, lengths, bucket = self._form_batch(batch, t0)
+            t1 = time.monotonic()
+            handle = self.runner.dispatch(mat, lengths)
+        except Exception as e:  # noqa: BLE001 - a poisoned batch must not
+            log.error("serve batcher: dispatch failed: %s", e)  # kill the
+            for r in batch:                                     # worker
+                self._safe_done(r, ShedError("closed",
+                                             f"runner error: {e}"))
+            return
+        item = InflightBatch(handle, self.runner.collect,
+                             self._deliver_collected, len(batch),
+                             meta=(batch, lengths, bucket, t0, t1))
+        if not self._pipeline.submit(item):      # pipeline closed
+            for r in batch:
+                self._safe_done(r, ShedError("closed",
+                                             "batcher is closed"))
+            return
+        self._g_inflight.set(self._pipeline.inflight_requests())
+
+    def _deliver_collected(self, item, result) -> None:
+        """Collector-thread delivery for one pipelined batch: the result
+        is the synced batch output, or the exception that killed
+        collection (shed the whole batch — none delivered yet)."""
+        batch, lengths, bucket, t0, t1 = item.meta
+        t2 = time.monotonic()
+        if isinstance(result, BaseException):
+            for r in batch:
+                self._safe_done(r, ShedError("closed",
+                                             f"runner error: {result}"))
+            self._g_inflight.set(max(0, self._pipeline.inflight_requests()
+                                     - item.n_requests))
+            return
+        self._c_batches.inc()
+        # In pipelined mode "device" spans dispatch -> collected: launch,
+        # window queueing, execution, and the sync — the whole stretch the
+        # request is owned by the device side.
+        self._h_device.observe((t2 - t1) * 1e3)
+        for r in batch:
+            if r.ctx is not None and r.ctx.sampled:
+                emit_span("serve.admit_wait", child_of(r.ctx), r.t_submit,
+                          (t0 - r.t_submit) * 1e3)
+                emit_span("serve.batch_form", child_of(r.ctx), t0,
+                          (t1 - t0) * 1e3, bucket=bucket, size=len(batch))
+                emit_span("serve.device", child_of(r.ctx), t1,
+                          (t2 - t1) * 1e3, bucket=bucket, pipelined=1)
+        for i, r in enumerate(batch):
+            try:
+                sliced = self.runner.slice_result(result, i,
+                                                  int(lengths[i]))
+            except Exception as e:  # noqa: BLE001 - contain to request i
+                log.error("serve batcher: result slice failed: %s", e)
+                sliced = ShedError("closed", f"runner error: {e}")
+            self._safe_done(r, sliced)
+        # This batch still counts in inflight_requests() until the
+        # collector loop's post-deliver decrement; subtract it so the
+        # gauge reads 0 at true idle.
+        self._g_inflight.set(max(0, self._pipeline.inflight_requests()
+                                 - item.n_requests))
 
     @staticmethod
     def _safe_done(req: ServeRequest, result: object) -> None:

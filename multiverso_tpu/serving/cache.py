@@ -1,0 +1,127 @@
+"""Hot-row LRU cache with bounded staleness for the lookup runners.
+
+Serving traffic is zipfian: a handful of hot embedding rows (head
+vocabulary words, trending items) absorb most lookups. Those rows do not
+need a device dispatch per request — the previous batch already fetched
+them. This cache sits IN FRONT of :class:`SparseLookupRunner`: a request
+whose every key is cached fresh is answered straight from host memory at
+admission time (no queue, no batch, no device), everything else takes the
+normal batched path and repopulates the cache on the way out.
+
+Freshness is defined by the BSP clock stamp the serving plane already
+carries (``SyncCoordinator.clock()`` — the same version number stamped
+into every ``Serve_Reply``): a row cached at clock ``c`` is served while
+``now_clock - c <= staleness``. With ``staleness=0`` under BSP semantics
+(writes commit before the clock advances) a hit is bitwise-equal to a
+direct ``table.get_rows`` at the same clock — the parity the tests
+assert. A training write that advances the clock therefore invalidates
+every older entry *by arithmetic*, with no write-path hook into the
+trainer: the clock IS the invalidation broadcast. Checkpoint replicas
+(no clock) call :meth:`invalidate` on hot-swap instead.
+
+Telemetry: ``serve.cache.hit`` / ``serve.cache.miss`` / ``serve.cache.stale``
+counters + ``serve.cache.rows`` gauge (docs/OBSERVABILITY.md catalog).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu.telemetry import counter, gauge
+
+
+class HotRowCache:
+    """Bounded LRU of ``row id -> (clock stamp, value row)``.
+
+    ``capacity`` bounds resident rows (LRU eviction); ``staleness`` is
+    the maximum clock-tick age a hit may serve. All-or-nothing at the
+    request level: a request with ANY cold/stale key takes the device
+    path whole, so a reply never mixes cache generations."""
+
+    def __init__(self, capacity: int, staleness: int = 0):
+        self.capacity = max(1, int(capacity))
+        self.staleness = max(0, int(staleness))
+        self._lock = threading.Lock()
+        self._rows: "collections.OrderedDict[int, Tuple[float, np.ndarray]]" \
+            = collections.OrderedDict()
+        self._c_hit = counter("serve.cache.hit")
+        self._c_miss = counter("serve.cache.miss")
+        self._c_stale = counter("serve.cache.stale")
+        self._g_rows = gauge("serve.cache.rows")
+
+    def _fresh(self, stamp: float, now_clock: float) -> bool:
+        # No clock (static table / frozen replica): entries live until
+        # an explicit invalidate() — the hot-swap path calls it.
+        if now_clock < 0:
+            return True
+        return (now_clock - stamp) <= self.staleness
+
+    def get_rows(self, keys: np.ndarray,
+                 now_clock: float) -> Optional[np.ndarray]:
+        """The full value matrix for ``keys`` iff EVERY key is cached
+        within the staleness bound; None otherwise (counts one miss or
+        stale per request, one hit per fully-served request)."""
+        out = []
+        with self._lock:
+            for k in keys:
+                entry = self._rows.get(int(k))
+                if entry is None:
+                    self._c_miss.inc()
+                    return None
+                if not self._fresh(entry[0], now_clock):
+                    self._c_stale.inc()
+                    return None
+                out.append(entry[1])
+            for k in keys:                    # LRU touch only on full hits
+                self._rows.move_to_end(int(k))
+        self._c_hit.inc()
+        if not out:
+            return None                       # empty request: device path
+        return np.stack(out)
+
+    def put_rows(self, keys: np.ndarray, rows: np.ndarray,
+                 clock: float) -> None:
+        """Stamp + insert the rows a device batch just fetched. Rows are
+        copied (the batch result matrix is sliced per-request afterwards;
+        the cache must own stable bytes) — OUTSIDE the lock, so the
+        admission fast path's ``get_rows`` never waits on a batch-sized
+        memcpy."""
+        stamped = [(int(k), (float(clock), np.array(row, copy=True)))
+                   for k, row in zip(keys, rows)]
+        with self._lock:
+            for k, entry in stamped:
+                self._rows[k] = entry
+                self._rows.move_to_end(k)
+            while len(self._rows) > self.capacity:
+                self._rows.popitem(last=False)
+            self._g_rows.set(len(self._rows))
+
+    def invalidate(self) -> None:
+        """Drop everything — the checkpoint hot-swap hook for clockless
+        (frozen replica) tables."""
+        with self._lock:
+            self._rows.clear()
+            self._g_rows.set(0)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+
+def cache_from_flags() -> Optional[HotRowCache]:
+    """Build the cache the ``-serve_cache_rows`` / ``-serve_cache_staleness``
+    flags describe (None when disabled — the default: live-table serving
+    opts into staleness, it never inherits it silently)."""
+    from multiverso_tpu.utils.configure import get_flag
+    try:
+        capacity = int(get_flag("serve_cache_rows"))
+        staleness = int(get_flag("serve_cache_staleness"))
+    except Exception:  # noqa: BLE001 - flags not parsed (bare library use)
+        return None
+    if capacity <= 0:
+        return None
+    return HotRowCache(capacity, staleness)

@@ -1,0 +1,429 @@
+"""Iteration-level continuous batching for attention-LM decode.
+
+The drain-first path (:class:`~multiverso_tpu.serving.runners.
+AttentionLMRunner` behind the plain :class:`DynamicBatcher`) coalesces
+prompts into a batch, then runs prefill + the FULL ``max_new``-step decode
+as one dispatch: a request arriving one step after a batch launched waits
+out the whole bucket before its own decode begins. That is the decisive
+serving inefficiency the Gemma-on-TPU comparison names (PAPERS.md, arXiv
+2605.25645): decode batches should admit at *iteration* granularity.
+
+This module decodes step-by-step from the host instead: one jitted
+``prefill`` (a single prompt into one KV-cache slot) and one jitted
+``step`` (one cached-attention token step for ALL slots at once, with a
+per-slot step counter). New requests claim free KV-cache slots at step
+boundaries and ride along with whatever is mid-decode; a finished slot
+frees at the next boundary. Because every slot's computation depends only
+on its own row — its own cache rows, its own mask ``key_slot < len`` or
+``bucket <= key_slot <= bucket + t_slot``, its own position ``len +
+t_slot`` (slot/position decoupling, exactly the drain path's layout) —
+a late joiner's tokens are BIT-IDENTICAL to decoding it alone through
+the drain path (``tests/test_serving_continuous.py`` asserts it).
+
+The host-stepped loop is the same trade PR 2 made for training: a
+de-optimized in-graph loop (here: ``lax.scan`` that forces bucket-drain
+batching) loses to host dispatch once the launch is cheap, and the
+per-step dispatches pipeline through jax's async queue (each step donates
+the caches forward, so steady state allocates nothing and the chain
+serializes on data flow, not host syncs — the only sync is one
+row-read per COMPLETED request).
+
+Telemetry: ``serve.continuous.active`` gauge (occupied slots),
+``serve.continuous.joins`` / ``serve.continuous.steps`` counters
+(docs/OBSERVABILITY.md catalog).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from multiverso_tpu.serving.batcher import (DynamicBatcher, ServeRequest,
+                                            ShedError)
+from multiverso_tpu.telemetry import child_of, counter, emit_span, gauge
+from multiverso_tpu.utils.log import check, log
+
+
+class _SlotEngine:
+    """Per-bucket decode state: B cache slots sharing one KV-cache of
+    shape ``[layers, B, heads, bucket+max_new, dh]`` plus the device-side
+    carry (current token per slot, token output buffer) and the
+    host-side slot table (which request owns which slot, its prompt
+    length and step counter)."""
+
+    __slots__ = ("bucket", "ck", "cv", "out", "tok", "lengths", "t",
+                 "reqs", "t_join")
+
+    def __init__(self, bucket: int, max_batch: int, max_new: int,
+                 cache_shape):
+        import jax.numpy as jnp
+
+        self.bucket = bucket
+        self.ck = jnp.zeros(cache_shape, jnp.float32)
+        self.cv = jnp.zeros(cache_shape, jnp.float32)
+        self.out = jnp.zeros((max_batch, max_new), jnp.int32)
+        self.tok = jnp.zeros((max_batch,), jnp.int32)
+        self.lengths = np.ones(max_batch, dtype=np.int32)
+        self.t = np.zeros(max_batch, dtype=np.int32)
+        self.reqs: List[Optional[ServeRequest]] = [None] * max_batch
+        self.t_join = [0.0] * max_batch
+
+    def free_slot(self) -> int:
+        for i, r in enumerate(self.reqs):
+            if r is None:
+                return i
+        return -1
+
+    def n_active(self) -> int:
+        return sum(1 for r in self.reqs if r is not None)
+
+
+class ContinuousBatcher(DynamicBatcher):
+    """Drop-in batcher for :class:`AttentionLMRunner` decode with
+    iteration-level admission.
+
+    Reuses the :class:`DynamicBatcher` surface whole — deadline-aware
+    admission, cancel tokens, quiesce barrier, close semantics — and
+    replaces the worker loop: instead of gather->run->deliver it claims
+    free KV-cache slots for queued requests, prefills them, and advances
+    every engine one decode step per iteration. ``max_wait_ms`` is
+    irrelevant here (admission happens at every step boundary; nothing
+    ever waits for company) and is pinned to 0."""
+
+    def __init__(self, runner, buckets: Sequence[int],
+                 max_batch: int = 8, max_queue: int = 64):
+        import jax
+
+        cfg = runner.cfg
+        check(cfg.moe_experts == 0 and cfg.pipeline_stages == 0,
+              "continuous decode supports the flat dense attention_lm "
+              "layout")
+        self.runner_ref = runner
+        self.cfg = cfg
+        self.max_new = int(runner.max_new)
+        # Engines + slot accounting exist BEFORE super().__init__ starts
+        # the worker thread (which immediately enters our _loop).
+        self._engines: Dict[int, _SlotEngine] = {}
+        self._active: "collections.Counter" = collections.Counter()
+        self._g_active = gauge("serve.continuous.active")
+        self._c_joins = counter("serve.continuous.joins")
+        self._c_steps = counter("serve.continuous.steps")
+        self._prefill = jax.jit(self._prefill_fn,
+                                donate_argnums=(4, 5, 6, 7))
+        self._step = jax.jit(self._step_fn, donate_argnums=(3, 4, 5, 6))
+        super().__init__(runner, buckets, max_batch=max_batch,
+                         max_wait_ms=0.0, max_queue=max_queue,
+                         pipeline_depth=0)
+
+    # -- jitted kernels ------------------------------------------------------
+    # The math is the drain path's (_decode_fn) verbatim per row: same
+    # _ln/_posenc, same einsum strings, same mask formula, same
+    # slot/position decoupling. Only the batching topology differs — one
+    # prompt per prefill, a per-slot step counter vector in step.
+    def _prefill_fn(self, params, tokens, length, slot, ck, cv, out, tok):
+        """tokens [1, S] right-padded, length [1], slot scalar -> writes
+        the prompt's K/V into cache row ``slot``, the first greedy token
+        into ``out[slot, 0]`` and ``tok[slot]``."""
+        import jax
+        import jax.numpy as jnp
+
+        from multiverso_tpu.models.attention_lm import _ln, _posenc
+
+        cfg = self.cfg
+        S = tokens.shape[1]
+        H, D = cfg.heads, cfg.dim
+        dh = D // H
+        scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(dh))
+        length = jnp.maximum(length, 1)
+        pe = _posenc(S + self.max_new, D)
+
+        x = jnp.take(params["embed"], tokens, axis=0) + pe[None, :S]
+        causal = jnp.tril(jnp.ones((S, S), bool))[None, None]
+        for i in range(cfg.layers):
+            h = _ln(x)
+            q, k, v = jnp.split(h @ params[f"qkv_{i}"], 3, axis=-1)
+            q = q.reshape(1, S, H, dh).transpose(0, 2, 1, 3)
+            k = k.reshape(1, S, H, dh).transpose(0, 2, 1, 3)
+            v = v.reshape(1, S, H, dh).transpose(0, 2, 1, 3)
+            ck = jax.lax.dynamic_update_slice(ck, k[None],
+                                              (i, slot, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v[None],
+                                              (i, slot, 0, 0, 0))
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            probs = jax.nn.softmax(
+                jnp.where(causal, scores, -jnp.inf), axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            x = x + o.transpose(0, 2, 1, 3).reshape(1, S, D) \
+                @ params[f"attn_out_{i}"]
+            h = _ln(x)
+            x = x + jax.nn.gelu(h @ params[f"mlp_in_{i}"]) \
+                @ params[f"mlp_out_{i}"]
+        logits = _ln(x) @ params["out"]                       # [1, S, V]
+        first = jnp.argmax(logits[0, length[0] - 1], axis=-1) \
+            .astype(jnp.int32)                                # scalar
+        out = jax.lax.dynamic_update_slice(out, first[None, None],
+                                           (slot, 0))
+        tok = jax.lax.dynamic_update_slice(tok, first[None], (slot,))
+        return ck, cv, out, tok
+
+    def _step_fn(self, params, lengths, t, ck, cv, out, tok):
+        """One cached-attention step for EVERY slot at once; ``t`` is the
+        per-slot step counter (generated token ``t`` is on deck: its K/V
+        lands in cache slot ``S+t_row``, its position is ``len_row +
+        t_row``, and the emitted token writes ``out[row, t_row+1]``).
+        Idle slots compute garbage confined to their own rows — their
+        next prefill overwrites everything a future occupant can see."""
+        import jax.numpy as jnp
+        from jax import nn as jnn
+
+        from multiverso_tpu.models.attention_lm import _ln, _posenc
+
+        cfg = self.cfg
+        B = tok.shape[0]
+        H, D = cfg.heads, cfg.dim
+        dh = D // H
+        S = ck.shape[3] - self.max_new
+        N = self.max_new
+        scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(dh))
+        pe = _posenc(S + N, D)
+        barange = jnp.arange(B)
+        harange = jnp.arange(H)
+        key_slot = jnp.arange(S + N)[None, :]                  # [1, S+N]
+
+        pos = lengths + t                                      # [B]
+        x = jnp.take(params["embed"], tok, axis=0) + pe[pos]
+        mask = (key_slot < lengths[:, None]) | \
+            ((key_slot >= S) & (key_slot <= (S + t)[:, None]))  # [B, S+N]
+        for i in range(cfg.layers):
+            h = _ln(x)
+            q, k, v = jnp.split(h @ params[f"qkv_{i}"], 3, axis=-1)
+            q = q.reshape(B, H, dh)
+            k = k.reshape(B, H, dh)
+            v = v.reshape(B, H, dh)
+            ck = ck.at[i, barange[:, None], harange[None, :],
+                       (S + t)[:, None]].set(k)
+            cv = cv.at[i, barange[:, None], harange[None, :],
+                       (S + t)[:, None]].set(v)
+            scores = jnp.einsum("bhd,bhkd->bhk", q, ck[i]) * scale
+            probs = jnn.softmax(
+                jnp.where(mask[:, None], scores, -jnp.inf), axis=-1)
+            o = jnp.einsum("bhk,bhkd->bhd", probs, cv[i])
+            x = x + o.reshape(B, D) @ params[f"attn_out_{i}"]
+            h = _ln(x)
+            x = x + jnn.gelu(h @ params[f"mlp_in_{i}"]) \
+                @ params[f"mlp_out_{i}"]
+        logits = _ln(x) @ params["out"]                        # [B, V]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = out.at[barange, jnp.clip(t + 1, 0, N - 1)].set(nxt)
+        return ck, cv, out, nxt
+
+    # -- engine management ---------------------------------------------------
+    def _engine_for(self, bucket: int) -> _SlotEngine:
+        eng = self._engines.get(bucket)
+        if eng is None:
+            cfg = self.cfg
+            shape = (cfg.layers, self.max_batch, cfg.heads,
+                     bucket + self.max_new, cfg.dim // cfg.heads)
+            eng = _SlotEngine(bucket, self.max_batch, self.max_new, shape)
+            self._engines[bucket] = eng
+        return eng
+
+    def warmup(self) -> int:
+        """Compile prefill + step for every ladder bucket (the service
+        warmup hook — first real request never pays a trace)."""
+        import jax.numpy as jnp
+
+        params = self.runner_ref.params_ref()
+        one = jnp.ones((1,), jnp.int32)
+        slot0 = jnp.int32(0)
+        warmed = 0
+        for bucket in self.ladder.buckets:
+            eng = self._engine_for(bucket)
+            # One prompt buffer per bucket — warmup runs once at
+            # bring-up, and the shape is the thing being compiled.
+            # graftlint: disable=host-jnp-in-loop
+            zeros = jnp.zeros((1, bucket), jnp.int32)
+            eng.ck, eng.cv, eng.out, eng.tok = self._prefill(
+                params, zeros, one, slot0, eng.ck, eng.cv, eng.out,
+                eng.tok)
+            eng.ck, eng.cv, eng.out, eng.tok = self._step(
+                params, jnp.asarray(eng.lengths), jnp.asarray(eng.t),
+                eng.ck, eng.cv, eng.out, eng.tok)
+            warmed += 2
+        return warmed
+
+    def jit_cache_size(self) -> int:
+        """Prefill executables == buckets exercised (step compiles in
+        lockstep; the unit test asserts the two caches agree)."""
+        return int(self._prefill._cache_size())
+
+    # -- the iteration loop --------------------------------------------------
+    def _loop(self) -> None:  # overrides DynamicBatcher._loop
+        while True:
+            with self._cv:
+                while self._running and not self._queue \
+                        and not self._n_active_locked():
+                    self._cv.wait(0.05)
+                if not self._running and not self._queue \
+                        and not self._n_active_locked():
+                    return
+                claims = self._claim_locked()
+                if claims or self._n_active_locked():
+                    self._busy = True
+                self._g_depth.set(len(self._queue))
+            self._admit_claims(claims)
+            # Deliver BEFORE stepping: a slot that completed on the
+            # previous step — or straight out of prefill when max_new==1
+            # — must hand its tokens over before another step can write
+            # into its out row (stepping a complete slot would overwrite
+            # token out[slot, clip(t+1)] with an extra greedy token).
+            self._deliver_finished()
+            self._step_engines()
+            self._deliver_finished()
+            with self._cv:
+                if not self._n_active_locked() and not self._queue:
+                    self._busy = False
+
+    def _n_active_locked(self) -> int:
+        return sum(self._active.values())
+
+    def _claim_locked(self) -> List[ServeRequest]:
+        """FIFO claim of queued requests into free slots, per bucket —
+        the step-boundary admission. Requests whose bucket is full stay
+        queued in order (a later small-bucket request may still claim)."""
+        claims: List[ServeRequest] = []
+        remaining: List[ServeRequest] = []
+        claimed: "collections.Counter" = collections.Counter()
+        for req in self._queue:
+            b = self.ladder.pick(req.payload.shape[0])
+            if self._active[b] + claimed[b] < self.max_batch:
+                claimed[b] += 1
+                claims.append(req)
+            else:
+                remaining.append(req)
+        self._queue.clear()
+        self._queue.extend(remaining)
+        for b, n in claimed.items():
+            self._active[b] += n
+        return claims
+
+    def _unclaim(self, bucket: int) -> None:
+        with self._cv:
+            self._active[bucket] -= 1
+
+    def _admit_claims(self, claims: List[ServeRequest]) -> None:
+        now = time.monotonic()
+        for req in claims:
+            bucket = self.ladder.pick(req.payload.shape[0])
+            if req.cancelled:
+                self._c_cancelled.inc()
+                self._unclaim(bucket)
+                self._safe_done(req, ShedError("cancelled",
+                                               "hedged loser cancelled"))
+            elif req.deadline < now:
+                self._c_shed_deadline.inc()
+                self._unclaim(bucket)
+                self._safe_done(req, ShedError("deadline",
+                                               "expired while queued"))
+            else:
+                self._h_admit.observe((now - req.t_submit) * 1e3)
+                self._join(req, bucket)
+
+    def _join(self, req: ServeRequest, bucket: int) -> None:
+        """Prefill one prompt into a free KV-cache slot — the join is a
+        device dispatch like any step, so it lands exactly at a step
+        boundary of everything already decoding in this engine."""
+        import jax.numpy as jnp
+
+        eng = self._engine_for(bucket)
+        slot = eng.free_slot()
+        try:
+            check(slot >= 0, "claim accounting out of slots")
+            n = req.payload.shape[0]
+            tokens = np.zeros((1, bucket), dtype=np.int32)
+            tokens[0, :n] = req.payload
+            params = self.runner_ref.params_ref()
+            eng.ck, eng.cv, eng.out, eng.tok = self._prefill(
+                params, jnp.asarray(tokens),
+                jnp.asarray([max(n, 1)], np.int32), jnp.int32(slot),
+                eng.ck, eng.cv, eng.out, eng.tok)
+        except Exception as e:  # noqa: BLE001 - a poisoned prompt sheds
+            log.error("continuous decode: prefill failed: %s", e)  # alone
+            self._unclaim(bucket)
+            self._safe_done(req, ShedError("closed", f"runner error: {e}"))
+            return
+        eng.reqs[slot] = req
+        eng.lengths[slot] = max(n, 1)
+        eng.t[slot] = 0
+        eng.t_join[slot] = time.monotonic()
+        self._c_joins.inc()
+        self._c_requests.inc()
+        self._g_active.set(self._total_active())
+        self._g_inflight.set(self._total_active())
+
+    def _total_active(self) -> int:
+        return sum(e.n_active() for e in self._engines.values())
+
+    def _step_engines(self) -> None:
+        import jax.numpy as jnp
+
+        params = None
+        for eng in self._engines.values():
+            if eng.n_active() == 0:
+                continue
+            if params is None:
+                params = self.runner_ref.params_ref()
+            try:
+                eng.ck, eng.cv, eng.out, eng.tok = self._step(
+                    params, jnp.asarray(eng.lengths), jnp.asarray(eng.t),
+                    eng.ck, eng.cv, eng.out, eng.tok)
+            except Exception as e:  # noqa: BLE001 - shed this engine's
+                log.error("continuous decode: step failed: %s", e)  # slots
+                self._fail_engine(eng, e)
+                continue
+            self._c_steps.inc()
+            for i, r in enumerate(eng.reqs):
+                if r is not None:
+                    eng.t[i] += 1
+
+    def _fail_engine(self, eng: _SlotEngine, err: Exception) -> None:
+        for i, r in enumerate(eng.reqs):
+            if r is None:
+                continue
+            eng.reqs[i] = None
+            eng.lengths[i] = 1
+            eng.t[i] = 0
+            self._unclaim(eng.bucket)
+            self._safe_done(r, ShedError("closed", f"runner error: {err}"))
+        self._g_active.set(self._total_active())
+        self._g_inflight.set(self._total_active())
+
+    def _deliver_finished(self) -> None:
+        """A slot with all ``max_new`` tokens emitted delivers (the one
+        host sync per request) and frees at this step boundary."""
+        now = time.monotonic()
+        for eng in self._engines.values():
+            for i, r in enumerate(eng.reqs):
+                if r is None or eng.t[i] < self.max_new - 1:
+                    continue
+                try:
+                    row = np.asarray(eng.out[i])
+                except Exception as e:  # noqa: BLE001 - contain to slot
+                    log.error("continuous decode: readback failed: %s", e)
+                    row = ShedError("closed", f"runner error: {e}")
+                eng.reqs[i] = None
+                eng.lengths[i] = 1
+                eng.t[i] = 0
+                self._unclaim(eng.bucket)
+                if r.ctx is not None and r.ctx.sampled:
+                    emit_span("serve.device", child_of(r.ctx),
+                              eng.t_join[i], (now - eng.t_join[i]) * 1e3,
+                              bucket=eng.bucket, continuous=1)
+                self._c_batches.inc()
+                self._h_device.observe((now - eng.t_join[i]) * 1e3)
+                self._safe_done(r, row)
+        self._g_active.set(self._total_active())
+        self._g_inflight.set(self._total_active())

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from multiverso_tpu.core.table import ServerStore
+from multiverso_tpu.serving.cache import HotRowCache
 from multiverso_tpu.utils.log import check
 
 try:                     # 3.8+ typing.Protocol
@@ -64,6 +65,15 @@ class ServingRunner(Protocol):
         (== number of distinct buckets exercised)."""
         ...
 
+    # Optional two-phase contract (serving/pipeline.py): ``dispatch``
+    # launches the device work WITHOUT syncing and returns an opaque
+    # handle; ``collect(handle)`` blocks and returns what ``run`` would
+    # have. Runners that implement both ride the depth-N dispatch
+    # pipeline; ``run`` stays as dispatch+collect for warmup and the
+    # serialized fallback. ``try_cached(payload)`` (optional) may answer
+    # a request host-side at admission (hot-row cache) — None means
+    # "take the device path".
+
 
 def _make_gather():
     """A fresh jitted gather per runner. The closure matters: jax's jit
@@ -91,25 +101,69 @@ class SparseLookupRunner:
 
     def __init__(self, store: ServerStore, row_offset: int = 0,
                  clock_fn: Optional[Callable[[], Tuple[float, float]]]
-                 = None):
+                 = None, cache: Optional[HotRowCache] = None):
         check(len(store.padded_shape) == 2,
               "SparseLookupRunner serves 2-D row tables")
         self.store = store
         self.row_offset = int(row_offset)
         self._clock_fn = clock_fn
+        self.cache = cache
         self._gather = _make_gather()
         self.last_clock: float = -1.0
 
-    def run(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
-        del lengths
+    def current_clock(self) -> float:
+        """The live BSP clock (host read, no device work) — what stamps
+        cache entries and decides cache freshness."""
+        if self._clock_fn is None:
+            return -1.0
+        return float(self._clock_fn()[0])
+
+    def try_cached(self, payload: np.ndarray) -> Optional[np.ndarray]:
+        """Host-side answer for a fully-hot request (every key cached
+        within the staleness bound); None sends it down the device path.
+
+        A LIVE table without a clock (async mode, no SyncCoordinator)
+        never serves from cache: with no version to age entries by,
+        training writes would be masked forever — the staleness bound
+        is only meaningful against the BSP clock."""
+        if self.cache is None or payload.size == 0 \
+                or self._clock_fn is None:
+            return None
+        return self.cache.get_rows(payload, self.current_clock())
+
+    # -- two-phase dispatch (serving/pipeline.py contract) -----------------
+    def dispatch(self, batch: np.ndarray, lengths: np.ndarray):
+        # Stamp BEFORE the gather: the snapshot the guarded gather
+        # captures is at-or-after this clock, so a cache entry is never
+        # stamped NEWER than its data (reading after would let a tick
+        # landing mid-dispatch relabel clock-c rows as c+1, and a
+        # staleness-0 hit would then serve stale bytes as fresh). The
+        # conservative stamp only costs an early refetch.
+        clock = self.current_clock()
         flat = (batch.astype(np.int64) - self.row_offset).reshape(-1)
         # Negative ids (pad rows under a nonzero offset) clip to row 0.
         flat = np.maximum(flat, 0).astype(np.int32)
-        values = np.asarray(
-            self.store.read_rows_with(self._gather, flat))
-        if self._clock_fn is not None:
-            self.last_clock = float(self._clock_fn()[0])
-        return values.reshape(batch.shape[0], batch.shape[1], -1)
+        values = self.store.read_rows_with(self._gather, flat)
+        return values, clock, batch, lengths.copy()
+
+    def collect(self, handle) -> np.ndarray:
+        values, clock, batch, lengths = handle
+        values = np.asarray(values)         # the device sync
+        out = values.reshape(batch.shape[0], batch.shape[1], -1)
+        # FIFO collection order (pipeline contract) keeps last_clock
+        # monotone with delivery order.
+        self.last_clock = clock
+        # Populate only under a clock: clockless live entries could
+        # never be aged out (see try_cached) so caching them is waste.
+        if self.cache is not None and self._clock_fn is not None:
+            for i in range(len(lengths)):
+                n = int(lengths[i])
+                if n:
+                    self.cache.put_rows(batch[i, :n], out[i, :n], clock)
+        return out
+
+    def run(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        return self.collect(self.dispatch(batch, lengths))
 
     def slice_result(self, out: np.ndarray, i: int, length: int):
         return out[i, :length]
@@ -132,20 +186,46 @@ class ReplicaLookupRunner:
     payload_dtype = np.int32
     pad_id = 0
 
-    def __init__(self, replica, table: str):
+    def __init__(self, replica, table: str,
+                 cache: Optional[HotRowCache] = None):
         self.replica = replica
         self.table = table
+        self.cache = cache
         self._gather = _make_gather()
         self.last_clock: float = -1.0
 
-    def run(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
-        del lengths
+    def current_clock(self) -> float:
+        """The replica's checkpoint step: advancing on hot-swap, so a
+        swap invalidates older cache entries by arithmetic."""
+        return float(self.replica.snapshot().step)
+
+    def try_cached(self, payload: np.ndarray) -> Optional[np.ndarray]:
+        if self.cache is None or payload.size == 0:
+            return None
+        return self.cache.get_rows(payload, self.current_clock())
+
+    # -- two-phase dispatch (serving/pipeline.py contract) -----------------
+    def dispatch(self, batch: np.ndarray, lengths: np.ndarray):
         snap = self.replica.snapshot()
         data = snap.table(self.table)
-        self.last_clock = float(snap.step)
         flat = np.clip(batch.reshape(-1), 0, data.shape[0] - 1)
-        values = np.asarray(self._gather(data, flat.astype(np.int32)))
-        return values.reshape(batch.shape[0], batch.shape[1], -1)
+        values = self._gather(data, flat.astype(np.int32))
+        return values, float(snap.step), batch, lengths.copy()
+
+    def collect(self, handle) -> np.ndarray:
+        values, step, batch, lengths = handle
+        values = np.asarray(values)         # the device sync
+        out = values.reshape(batch.shape[0], batch.shape[1], -1)
+        self.last_clock = step
+        if self.cache is not None:
+            for i in range(len(lengths)):
+                n = int(lengths[i])
+                if n:
+                    self.cache.put_rows(batch[i, :n], out[i, :n], step)
+        return out
+
+    def run(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        return self.collect(self.dispatch(batch, lengths))
 
     def slice_result(self, out: np.ndarray, i: int, length: int):
         return out[i, :length]
@@ -193,6 +273,13 @@ class AttentionLMRunner:
         new = jax.tree.map(jnp.asarray, params)
         with self._params_lock:
             self._params = new
+
+    def params_ref(self):
+        """The current weight pytree under the swap lock — what the
+        continuous-batching engine binds per dispatch (a hot-swap lands
+        at the next step boundary, never mid-step)."""
+        with self._params_lock:
+            return self._params
 
     def _cache_for(self, bucket: int) -> Tuple[jax.Array, jax.Array]:
         cached = self._caches.get(bucket)
@@ -287,7 +374,12 @@ class AttentionLMRunner:
         out = jnp.concatenate([first[None], rest], axis=0).T   # [B, N]
         return out, ck, cv
 
-    def run(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    # -- two-phase dispatch (serving/pipeline.py contract) -----------------
+    def dispatch(self, batch: np.ndarray, lengths: np.ndarray):
+        """Launch the decode WITHOUT syncing. Back-to-back dispatches of
+        the same bucket serialize on the donated KV-cache chain (batch
+        k+1's prefill consumes the arrays batch k returns) — jax orders
+        them; the pipeline only overlaps host work with device work."""
         bucket = batch.shape[1]
         ck, cv = self._cache_for(bucket)
         with self._params_lock:
@@ -295,7 +387,13 @@ class AttentionLMRunner:
         out, ck, cv = self._decode(params, jnp.asarray(batch),
                                    jnp.asarray(lengths), ck, cv)
         self._caches[bucket] = (ck, cv)
-        return np.asarray(out)
+        return out
+
+    def collect(self, handle) -> np.ndarray:
+        return np.asarray(handle)           # the device sync
+
+    def run(self, batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+        return self.collect(self.dispatch(batch, lengths))
 
     def slice_result(self, out: np.ndarray, i: int, length: int):
         del length                     # every request gets max_new tokens

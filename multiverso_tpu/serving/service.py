@@ -41,6 +41,16 @@ def _wire_dtype() -> str:
     return get_flag("serve_wire_dtype")
 
 
+def _flag_or(name: str, default):
+    """Flag value, or ``default`` when flags are unparsed (bare library
+    use — unit tests construct services without ``mv.init``)."""
+    from multiverso_tpu.utils.configure import get_flag
+    try:
+        return get_flag(name)
+    except Exception:  # noqa: BLE001 - unparsed flag registry
+        return default
+
+
 class ServingService:
     """Owns runners + their batchers; serves framed requests over TCP."""
 
@@ -78,14 +88,33 @@ class ServingService:
     def register_runner(self, runner, runner_id: int = 0,
                         buckets: Sequence[int] = (8, 16, 32, 64),
                         max_batch: int = 8, max_wait_ms: float = 2.0,
-                        max_queue: int = 64) -> None:
+                        max_queue: int = 64, pipeline_depth=None,
+                        continuous: Optional[bool] = None) -> None:
+        """``pipeline_depth``: in-flight dispatch window (int, or "auto"
+        for the measured-latency decision table; None reads the
+        ``-serve_pipeline_depth`` flag). ``continuous``: iteration-level
+        continuous batching for decode runners that support it (None
+        reads ``-serve_continuous``); ignored for runners without the
+        per-step contract."""
+        if pipeline_depth is None:
+            pipeline_depth = _flag_or("serve_pipeline_depth", "auto")
+        if continuous is None:
+            continuous = bool(_flag_or("serve_continuous", False))
         with self._lock:
             check(runner_id not in self._batchers,
                   f"runner id {runner_id} already registered")
             self._runners[runner_id] = runner
-            self._batchers[runner_id] = DynamicBatcher(
-                runner, buckets, max_batch=max_batch,
-                max_wait_ms=max_wait_ms, max_queue=max_queue)
+            if continuous and hasattr(runner, "params_ref"):
+                from multiverso_tpu.serving.continuous import \
+                    ContinuousBatcher
+                self._batchers[runner_id] = ContinuousBatcher(
+                    runner, buckets, max_batch=max_batch,
+                    max_queue=max_queue)
+            else:
+                self._batchers[runner_id] = DynamicBatcher(
+                    runner, buckets, max_batch=max_batch,
+                    max_wait_ms=max_wait_ms, max_queue=max_queue,
+                    pipeline_depth=pipeline_depth)
 
     def batcher(self, runner_id: int = 0) -> DynamicBatcher:
         return self._batchers[runner_id]
@@ -115,6 +144,11 @@ class ServingService:
                      for rid, b in self._batchers.items()]
         warmed = 0
         for runner, b in pairs:
+            if hasattr(b, "warmup"):
+                # Continuous decode owns its own executables (prefill +
+                # step per bucket) — warm those, not the drain decode.
+                warmed += b.warmup()
+                continue
             dtype = getattr(runner, "payload_dtype", np.int32)
             pad_id = getattr(runner, "pad_id", 0)
             for bucket in b.ladder.buckets:

@@ -116,15 +116,21 @@ class MatrixTable(WorkerTable):
         self.add_rows([row_id], np.asarray(delta)[None, :], option)
 
     # -- serving hook (multiverso_tpu/serving; docs/SERVING.md) ------------
-    def serving_runner(self):
+    def serving_runner(self, cache=None):
         """A :class:`~multiverso_tpu.serving.SparseLookupRunner` over this
         table's LIVE store. Reads dispatch under the store's donation
         guard, so served values are bitwise-equal to :meth:`get_rows` of
         the same rows; in sync mode the batch is stamped with the BSP add
-        clock it was served at."""
+        clock it was served at. ``cache`` (a
+        :class:`~multiverso_tpu.serving.HotRowCache`) answers fully-hot
+        lookups host-side within its staleness bound — SYNC mode only:
+        without the BSP clock there is no version to age entries by, so
+        an async-mode live table ignores the cache rather than mask
+        training writes forever."""
         from multiverso_tpu.serving.runners import SparseLookupRunner
         clock_fn = self._sync.clock if self._sync is not None else None
-        return SparseLookupRunner(self.store, clock_fn=clock_fn)
+        return SparseLookupRunner(self.store, clock_fn=clock_fn,
+                                  cache=cache)
 
     # -- parity helper (ref matrix_table.cpp:235-313) ----------------------
     def partition(self, row_ids: Sequence[int]

@@ -232,6 +232,21 @@ define_string("serve_addr_file", "", "write 'host:port' here once the "
               "serving listener is bound (rendezvous for clients/tests)")
 define_double("serve_duration", 0.0, "serve for N seconds then exit "
               "(0 = until killed) — CI and smoke hooks")
+define_string("serve_pipeline_depth", "auto", "device dispatch pipeline "
+              "depth: batch k+1 is gathered/launched while batch k is on "
+              "device, up to N in flight (bounded backpressure beyond). "
+              "auto = measured-dispatch-latency decision table "
+              "(docs/SERVING.md); 0/1 = serialized dispatch")
+define_int("serve_cache_rows", 0, "hot-row LRU cache capacity in rows "
+           "(0 = off): a lookup whose every key is cached within the "
+           "staleness bound answers host-side with no device dispatch")
+define_int("serve_cache_staleness", 0, "max BSP-clock-tick age a cached "
+           "row may serve (0 = current tick only — bitwise-fresh under "
+           "BSP; replica tables age by checkpoint step)")
+define_bool("serve_continuous", False, "iteration-level continuous "
+            "batching for LM decode: new requests claim free KV-cache "
+            "slots at step boundaries instead of waiting for the "
+            "running batch to drain (tokens bit-identical either way)")
 # Fleet layer (multiverso_tpu/fleet; docs/SERVING.md "Fleet").
 define_string("fleet_role", "local", "local|router|replica|drain: local "
               "spawns a router + -fleet_replicas replica processes; "

@@ -20,6 +20,16 @@ ring-routed FleetClient over the wire APIs only. Extras:
   compared bitwise against the same seeded table computed locally.
 * ``--baseline``     — path to a previous record; the new record embeds
   ``scaleout_vs_baseline`` (aggregate-QPS ratio at equal offered load).
+* ``--qps-sweep A:B:STEP`` — one untraced load window per offered-QPS
+  point, recorded as ``qps_sweep`` in the SAME record (one
+  BENCH_SERVE_HISTORY.jsonl line carries the whole achieved-vs-offered
+  knee), with per-point bench-client CPU%% and a WARNING when the knee
+  is the bench box, not the server (client CPU-bound).
+* ``--pipeline-depth/--cache-rows/--hot-frac`` — the PR-9 serving
+  optimizations: device dispatch pipeline depth (auto = measured-latency
+  table), hot-row LRU cache size, and a zipf-ish hot-key fraction so the
+  cache has something to hit (0 keeps the uniform workload for
+  record-to-record comparability).
 * distributed tracing — the load runs in INTERLEAVED untraced/traced
   windows (A,B,A,B — drift in box load cancels out of the comparison);
   the record carries both QPS numbers (sampling overhead measured, not
@@ -133,19 +143,38 @@ class _LoadStats:
             self.sent += 1
 
 
+def _key_sampler(rows: int, keys_per_req: int, hot_frac: float,
+                 hot_keys: int):
+    """Per-request key draw: uniform over the table, except a
+    ``hot_frac`` fraction of requests draws all its keys from a fixed
+    ``hot_keys``-row hot set (the workload skew a hot-row cache exists
+    for; 0.0 = the original uniform workload, bitwise-comparable with
+    older records)."""
+    hot = min(max(int(hot_keys), 1), rows)
+
+    def sample(r: np.random.Generator) -> np.ndarray:
+        if hot_frac > 0.0 and r.random() < hot_frac:
+            return r.integers(0, hot, keys_per_req).astype(np.int32)
+        return r.integers(0, rows, keys_per_req).astype(np.int32)
+    return sample
+
+
 def _run_load(do_request, stats: _LoadStats, threads: int, qps: float,
-              duration_s: float, rows: int, keys_per_req: int) -> float:
+              duration_s: float, rows: int, keys_per_req: int,
+              sample_keys=None) -> float:
     """Closed-loop pacing: each thread owns qps/threads; a slow reply
     eats into that thread's budget. Returns the measured elapsed time."""
     from multiverso_tpu.serving import ShedError
 
+    if sample_keys is None:
+        sample_keys = _key_sampler(rows, keys_per_req, 0.0, 1)
     interval = threads / max(qps, 1e-6)
     stop_at = [0.0]
 
     def client_loop(seed: int) -> None:
         r = np.random.default_rng(seed)
         while time.monotonic() < stop_at[0]:
-            keys = r.integers(0, rows, keys_per_req).astype(np.int32)
+            keys = sample_keys(r)
             t0 = time.monotonic()
             try:
                 do_request(keys)
@@ -321,8 +350,8 @@ def _export_local_trace(tdir: str) -> None:
 # Single-process mode (PR 5's harness, kept as the no-fleet baseline)
 # ---------------------------------------------------------------------------
 def run_single(args) -> dict:
-    from multiverso_tpu.serving import (ServingClient, ServingService,
-                                        SparseLookupRunner)
+    from multiverso_tpu.serving import (HotRowCache, ServingClient,
+                                        ServingService, SparseLookupRunner)
     from multiverso_tpu.core.table import ServerStore
     from multiverso_tpu.core.updater import get_updater
     from multiverso_tpu.utils.configure import set_flag
@@ -343,11 +372,19 @@ def run_single(args) -> dict:
         .astype(np.float32))
     buckets = tuple(int(b) for b in args.buckets.split(","))
 
+    cache = HotRowCache(args.cache_rows, args.cache_staleness) \
+        if args.cache_rows > 0 else None
     service = ServingService()
-    service.register_runner(SparseLookupRunner(store), buckets=buckets,
+    # Constant clock: the bench table is immutable, so every cached row
+    # is eternally fresh by construction (a live training table would
+    # carry the real BSP clock here).
+    service.register_runner(SparseLookupRunner(
+        store, clock_fn=lambda: (0.0, 0.0), cache=cache),
+                            buckets=buckets,
                             max_batch=args.max_batch,
                             max_wait_ms=args.max_wait_ms,
-                            max_queue=args.admission)
+                            max_queue=args.admission,
+                            pipeline_depth=args.pipeline_depth)
 
     warm = ServingClient(*service.address)
     warm.lookup(rng.integers(0, args.rows, args.keys_per_req)
@@ -375,30 +412,161 @@ def run_single(args) -> dict:
     # load cancelled out, not baked into one side of the comparison.
     from multiverso_tpu.telemetry import TraceBuffer, get_trace_buffer
     get_trace_buffer().set_capacity(TraceBuffer.EXPORT_CAPACITY)
+    sampler = _key_sampler(args.rows, args.keys_per_req, args.hot_frac,
+                           args.hot_keys)
     stats_un, stats = _LoadStats(), _LoadStats()
     elapsed_un = elapsed = 0.0
+    cpu0 = _proc_cpu_s(os.getpid())
     for _half in range(2):
         _set_sample_rate(0.0)
         elapsed_un += _run_load(do_request, stats_un, args.threads,
                                 args.qps, args.duration / 2, args.rows,
-                                args.keys_per_req)
+                                args.keys_per_req, sampler)
         _set_sample_rate(args.sample_rate)
         elapsed += _run_load(do_request, stats, args.threads, args.qps,
                              args.duration / 2, args.rows,
-                             args.keys_per_req)
+                             args.keys_per_req, sampler)
     qps_untraced = len(stats_un.latencies) / elapsed_un \
         if elapsed_un > 0 else 0.0
+    cpu_pct = round(100 * (_proc_cpu_s(os.getpid()) - cpu0)
+                    / max(elapsed_un + elapsed, 1e-6), 1)
+    _set_sample_rate(0.0)
+
+    # Pipeline-overlap + cache-hit probes (the tier-1 smoke's acceptance
+    # witnesses): a concurrent burst that must reach window depth >= 2,
+    # and a repeated-key pair whose second lookup must answer host-side.
+    probe = _overlap_probe(args, clients[0], rng)
+
+    sweep = None
+    if args.qps_sweep:
+        def at_qps(q, stats_s, dur):
+            return _run_load(do_request, stats_s, args.threads, q, dur,
+                             args.rows, args.keys_per_req, sampler)
+        sweep = _run_qps_sweep(args, at_qps,
+                               lambda: {"bench": _proc_cpu_s(os.getpid())},
+                               cores=os.cpu_count())
+
     for cli in clients:
         cli.close()
     service.close()
 
     record = _make_record("serve_lookup", args, stats, elapsed,
                           _metric_families(("serve.",)))
+    record["process_cpu_pct"] = {"bench": cpu_pct}
+    record["pipeline"] = probe
+    if sweep is not None:
+        record["qps_sweep"] = sweep
     tdir = args.telemetry_dir or tempfile.mkdtemp(prefix="serve_trace_")
     _export_local_trace(tdir)
     record["tracing"] = _tracing_block(args, tdir, record["achieved_qps"],
                                        qps_untraced)
     return record
+
+
+def _overlap_probe(args, client, rng) -> dict:
+    """Drive the service hard enough to PROVE the optimizations engaged:
+    a 4x-max_batch concurrent burst (the dispatch window must reach
+    occupancy >= 2 — pipelining, not the serialized path) and a repeated
+    identical lookup (the second must count a cache hit when the cache
+    is on). The smoke asserts on this block so neither can silently
+    regress."""
+    from multiverso_tpu.telemetry import get_registry
+
+    from multiverso_tpu.serving import ShedError
+
+    keys = rng.integers(0, args.rows, args.keys_per_req).astype(np.int32)
+    results = [client.request_async(keys, deadline_ms=10_000)
+               for _ in range(max(4 * args.max_batch, 16))]
+    for res in results:
+        try:
+            res.wait(60)
+        except ShedError:
+            pass    # a burst past the admission bound sheds by design
+    # Same keys twice back-to-back: miss-populate, then a pure host hit.
+    client.lookup(keys, deadline_ms=10_000, timeout=60)
+    client.lookup(keys, deadline_ms=10_000, timeout=60)
+    reg = get_registry()
+    g = reg.gauge("serve.pipeline.inflight").snapshot()
+    return {
+        "depth": float(reg.gauge("serve.pipeline.depth").last),
+        "max_inflight": float(g["max"]),
+        "backpressure": reg.counter("serve.pipeline.backpressure").value,
+        "cache_hits": reg.counter("serve.cache.hit").value,
+        "cache_misses": reg.counter("serve.cache.miss").value,
+        "overlap_ok": bool(g["max"] >= 2.0),
+        "cache_hit_ok": bool(reg.counter("serve.cache.hit").value >= 1
+                             or args.cache_rows <= 0),
+    }
+
+
+def _parse_sweep(spec: str):
+    try:
+        lo, hi, step = (int(x) for x in spec.split(":"))
+        ok = lo > 0 and hi >= lo and step > 0
+    except ValueError:
+        ok = False
+    if not ok:
+        raise SystemExit(f"bad --qps-sweep '{spec}' (want A:B:STEP, e.g. "
+                         "100:700:100)")
+    return list(range(lo, hi + 1, step))
+
+
+def _run_qps_sweep(args, run_at_qps, cpu_probe, cores: int) -> dict:
+    """One short untraced load window per offered-QPS point; the whole
+    achieved-vs-offered curve lands in ONE history record. Each point
+    carries the bench client's CPU%% so the record can say when the KNEE
+    is the bench box, not the server (ROADMAP 2(a): on a small host the
+    client saturates first and the curve measures the box)."""
+    points = []
+    dur = max(2.0, args.duration / 2) if not args.dry_run else 1.0
+    for offered in _parse_sweep(args.qps_sweep):
+        stats = _LoadStats()
+        c0 = cpu_probe()
+        elapsed = run_at_qps(float(offered), stats, dur)
+        c1 = cpu_probe()
+        cpu_pct = {k: round(100 * (c1[k] - c0[k]) / max(elapsed, 1e-6), 1)
+                   for k in c1}
+        with stats.lock:
+            lat = list(stats.latencies)
+            sheds, errs = stats.sheds, stats.errors
+        pct = _percentiles(lat)
+        achieved = len(lat) / elapsed if elapsed > 0 else 0.0
+        points.append({
+            "offered_qps": offered,
+            "achieved_qps": round(achieved, 1),
+            "ratio": round(achieved / offered, 3) if offered else 0.0,
+            "p50_ms": round(pct["p50"], 3),
+            "p99_ms": round(pct["p99"], 3),
+            "n_shed": sheds, "n_error": errs,
+            "cpu_pct": cpu_pct,
+        })
+    # Knee = end of the CONTIGUOUS passing prefix: a noisy recovery
+    # after the first failing point must not inflate the record.
+    knee = None
+    for p in points:
+        if p["ratio"] < 0.9:
+            break
+        knee = p["offered_qps"]
+    out = {"points": points, "knee_qps": knee,
+           "knee_ratio_threshold": 0.9}
+    # Client-bound warning: at the first point past the knee, the bench
+    # process is pinned (>= 85% of one core) while every server-side
+    # process still has headroom — the measured ceiling is the load
+    # generator/box, not the serving plane.
+    past = [p for p in points if knee is None
+            or p["offered_qps"] > knee] or points[-1:]
+    if past:
+        p = past[0]
+        bench = p["cpu_pct"].get("bench", 0.0)
+        servers = [v for k, v in p["cpu_pct"].items() if k != "bench"]
+        if bench >= 85.0 and (not servers or max(servers) < 80.0):
+            out["warning"] = (
+                f"bench client CPU-bound at {p['offered_qps']} offered "
+                f"QPS (client {bench}%, max server "
+                f"{max(servers) if servers else 'n/a'}% of one core, "
+                f"{cores} cores): the knee measures the bench box, not "
+                "the serving plane")
+    return out
 
 
 def _tracing_block(args, tdir: str, qps_traced: float,
@@ -447,6 +615,9 @@ def _spawn_replica(args, router_addr, idx: int,
            f"-serve_max_wait_ms={args.max_wait_ms}",
            f"-serve_admission={args.admission}",
            f"-serve_wire_dtype={args.wire_dtype}",
+           f"-serve_pipeline_depth={args.pipeline_depth}",
+           f"-serve_cache_rows={args.cache_rows}",
+           f"-serve_cache_staleness={args.cache_staleness}",
            f"-serve_duration={lifetime}",
            f"-telemetry_dir={tdir}",
            "-telemetry_interval=2",
@@ -499,7 +670,7 @@ def _proc_cpu_s(pid: int) -> float:
 
 def _run_fleet_load(fleet, stats: _LoadStats, slots: int, qps: float,
                     duration_s: float, rows: int, keys_per_req: int,
-                    deadline_ms: float) -> float:
+                    deadline_ms: float, sample_keys=None) -> float:
     """Slot-based closed loop: ``slots`` virtual clients, each firing its
     next request when the previous completes (or after its pacing slack).
     Initiation work spreads across the reply reader threads instead of a
@@ -510,6 +681,8 @@ def _run_fleet_load(fleet, stats: _LoadStats, slots: int, qps: float,
     from multiverso_tpu.fleet.hedge import default_scheduler
     from multiverso_tpu.serving import ShedError
 
+    if sample_keys is None:
+        sample_keys = _key_sampler(rows, keys_per_req, 0.0, 1)
     sched = default_scheduler()
     interval = slots / max(qps, 1e-6)
     lock = threading.Lock()
@@ -529,7 +702,7 @@ def _run_fleet_load(fleet, stats: _LoadStats, slots: int, qps: float,
         if time.monotonic() >= end_at:
             retire()
             return
-        keys = rngs[slot].integers(0, rows, keys_per_req).astype(np.int32)
+        keys = sample_keys(rngs[slot])
         ts = time.monotonic()
 
         def cb(result, _t=ts, _s=slot):
@@ -670,6 +843,8 @@ def run_fleet(args) -> dict:
 
         parity_ok = _parity_check(fleet, table, args.rows,
                                   args.keys_per_req)
+        sampler = _key_sampler(args.rows, args.keys_per_req,
+                               args.hot_frac, args.hot_keys)
 
         # Interleaved untraced/traced load windows (A,B,A,B), all
         # DRILL-FREE: traced-vs-untraced QPS measures sampling overhead
@@ -688,11 +863,11 @@ def run_fleet(args) -> dict:
             elapsed_un += _run_fleet_load(
                 fleet, stats_un, args.threads, args.qps,
                 args.duration / 2, args.rows, args.keys_per_req,
-                args.deadline_ms)
+                args.deadline_ms, sampler)
             _set_sample_rate(args.sample_rate)
             elapsed += _run_fleet_load(
                 fleet, stats, args.threads, args.qps, args.duration / 2,
-                args.rows, args.keys_per_req, args.deadline_ms)
+                args.rows, args.keys_per_req, args.deadline_ms, sampler)
         qps_untraced = len(stats_un.latencies) / elapsed_un \
             if elapsed_un > 0 else 0.0
         wall = elapsed_un + elapsed
@@ -780,6 +955,38 @@ def run_fleet(args) -> dict:
                     "n_error": dstats.errors,
                 }
 
+        # Offered-QPS sweep (one curve, one history record) — untraced,
+        # after the headline windows so it cannot contaminate them.
+        sweep = None
+        if args.qps_sweep:
+            def fleet_at_qps(q, stats_s, dur):
+                return _run_fleet_load(fleet, stats_s, args.threads, q,
+                                       dur, args.rows, args.keys_per_req,
+                                       args.deadline_ms, sampler)
+
+            def fleet_cpu():
+                return {"bench": _proc_cpu_s(os.getpid()),
+                        "router": _proc_cpu_s(router_proc.pid),
+                        **{f"replica-{i}": _proc_cpu_s(p.pid)
+                           for i, p in enumerate(procs)
+                           if p.poll() is None}}
+            sweep = _run_qps_sweep(args, fleet_at_qps, fleet_cpu,
+                                   cores=os.cpu_count())
+
+        # Cache-hit witness for the fleet smoke: the same keys twice in a
+        # row land on the same replica (ring affinity), so the second
+        # lookup must answer from its hot-row cache when enabled.
+        if args.cache_rows > 0:
+            from multiverso_tpu.serving import ShedError
+            hot = rng.integers(0, args.rows, args.keys_per_req) \
+                .astype(np.int32)
+            for _ in range(3):
+                try:
+                    fleet.lookup(hot, deadline_ms=10_000, timeout=60)
+                except ShedError:
+                    pass    # a drain-lagged replica may shed one; the
+                            # witness only needs one hit to land
+
         # Guaranteed-sampled probes for the stitched-trace acceptance
         # checks, then the router's cluster-wide rollup.
         _trace_smoke_requests(args, fleet, router_addr)
@@ -792,6 +999,29 @@ def run_fleet(args) -> dict:
         record["cpu_cores"] = os.cpu_count()
         record["process_cpu_pct"] = cpu_pct
         record["fleet_stats"] = fleet_stats
+        per = fleet_stats.get("replicas", {})
+        record["pipeline"] = {
+            "depth_flag": args.pipeline_depth,
+            "max_inflight": max(
+                [p.get("pipeline_inflight_max", 0.0)
+                 for p in per.values()], default=0.0),
+            "cache_hits": int(fleet_stats.get("fleet", {})
+                              .get("cache_hits", 0)),
+        }
+        if sweep is not None:
+            record["qps_sweep"] = sweep
+        # Box-constraint honesty: when the bench client is pinned while
+        # every replica has headroom, the achieved number measures the
+        # bench box (ROADMAP 2(a)), and the record says so.
+        replica_cpu = [v for k, v in cpu_pct.items()
+                       if k.startswith("replica")]
+        if cpu_pct.get("bench", 0.0) >= 85.0 and replica_cpu \
+                and max(replica_cpu) < 80.0:
+            record["warning"] = (
+                f"bench client CPU-bound (client {cpu_pct['bench']}%, "
+                f"max replica {max(replica_cpu)}% of one core): achieved "
+                "QPS is capped by the load generator/box, not the "
+                "serving plane")
         if drill:
             record["drill"] = drill
         if args.baseline and os.path.exists(args.baseline):
@@ -828,7 +1058,10 @@ def _make_record(benchmark: str, args, stats: _LoadStats,
         # v3: + tracing block (sample_rate, traced/untraced QPS,
         # stage_breakdown, slowest-K stitched timelines, trace_smoke)
         # and fleet_stats rollup embed in fleet mode.
-        "schema": "multiverso_tpu.bench_serve/v3",
+        # v4: + pipeline block (window depth/occupancy + cache hit
+        # witnesses), optional qps_sweep (achieved-vs-offered knee with
+        # per-point CPU%) and client-CPU-bound warning.
+        "schema": "multiverso_tpu.bench_serve/v4",
         "benchmark": benchmark,
         "time_unix": time.time(),
         "config": {k: (v if not isinstance(v, tuple) else list(v))
@@ -865,6 +1098,23 @@ def main() -> int:
     p.add_argument("--duration", type=float, default=5.0)
     p.add_argument("--deadline-ms", type=float, default=100.0)
     p.add_argument("--wire-dtype", default="f32", choices=("f32", "bf16"))
+    p.add_argument("--pipeline-depth", default="auto",
+                   help="device dispatch pipeline depth: int, or 'auto' "
+                   "for the measured-latency decision table; 0 = "
+                   "serialized dispatch (the pre-PR-9 path)")
+    p.add_argument("--cache-rows", type=int, default=0,
+                   help="hot-row LRU cache capacity in rows (0 = off)")
+    p.add_argument("--cache-staleness", type=int, default=0,
+                   help="max clock-tick age a cached row may serve")
+    p.add_argument("--hot-frac", type=float, default=0.0,
+                   help="fraction of requests drawing all keys from a "
+                   "fixed hot set (cache workload skew; 0 keeps the "
+                   "uniform workload for record comparability)")
+    p.add_argument("--hot-keys", type=int, default=64,
+                   help="size of the hot key set --hot-frac draws from")
+    p.add_argument("--qps-sweep", default="",
+                   help="A:B:STEP offered-QPS sweep recorded as the "
+                   "achieved-vs-offered knee in one history record")
     p.add_argument("--overload", action="store_true",
                    help="drive QPS past capacity with tight deadlines to "
                    "exercise the shed path (single-process mode)")
@@ -901,6 +1151,10 @@ def main() -> int:
         args.duration = 4.0 if args.replicas else 1.5
         args.deadline_ms = 500.0
         args.sample_rate = 1.0      # the smoke asserts on stitched traces
+        # The smoke also asserts the optimizations ENGAGED: pipeline
+        # overlap (inflight >= 2) and a recorded cache hit.
+        if args.cache_rows <= 0:
+            args.cache_rows = 1024
         if args.replicas:
             args.drain_drill = True
 

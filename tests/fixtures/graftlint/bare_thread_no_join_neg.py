@@ -51,3 +51,16 @@ class FleetAgent:
 
     def close(self):
         self._hb.join(timeout=5)
+
+
+class OwnedPipeline:
+    """The DispatchPipeline collector shape: daemonized (a wedged device
+    must not block interpreter exit) and joined on the close path."""
+
+    def start(self, collect_loop):
+        self._collector = threading.Thread(target=collect_loop,
+                                           daemon=True)
+        self._collector.start()
+
+    def close(self):
+        self._collector.join(timeout=10)

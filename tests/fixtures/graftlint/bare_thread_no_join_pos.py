@@ -25,3 +25,13 @@ class FleetAgent:
     def start_heartbeat(self, beat):
         self._hb = threading.Thread(target=beat)  # expect: bare-thread-no-join
         self._hb.start()
+
+
+class LeakyPipeline:
+    """A dispatch-pipeline collector on a non-daemon thread with no join
+    anywhere: a wedged collect() (device hang) blocks interpreter exit
+    forever — the pipeline-module hazard the rule scope covers."""
+
+    def start(self, collect_loop):
+        self._collector = threading.Thread(target=collect_loop)  # expect: bare-thread-no-join
+        self._collector.start()

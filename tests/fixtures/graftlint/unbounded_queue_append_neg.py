@@ -65,3 +65,30 @@ class HeartbeatDaemonBounded:
     def heartbeat_loop(self, router, stop):
         while not stop.is_set():
             self._beats.append(router.heartbeat())
+
+
+class DepthBoundedDispatchPipeline:
+    """The real dispatch-pipeline shape (serving/pipeline.py): the
+    producer blocks behind a len() check against the window depth before
+    appending, and the collector popleft()s — both bound AND drain
+    evidence in scope."""
+
+    def __init__(self, depth):
+        self.depth = depth
+        self._fifo = collections.deque()
+
+    def producer_loop(self, batches, cv):
+        while True:
+            batch = batches.get_next()
+            if batch is None:
+                break
+            with cv:
+                while len(self._fifo) >= self.depth:    # backpressure
+                    cv.wait(0.2)
+                self._fifo.append(batch.dispatch())
+
+    def collector_loop(self, cv):
+        while True:
+            with cv:
+                if self._fifo:
+                    self._fifo.popleft()                # drain evidence

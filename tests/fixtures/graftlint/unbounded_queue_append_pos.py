@@ -69,3 +69,21 @@ class HeartbeatDaemon:
         while not stop.is_set():
             stats = router.heartbeat()
             self._beats.append(stats)  # expect: unbounded-queue-append
+
+
+class BrokenDispatchPipeline:
+    """The serving dispatch-pipeline idiom gone wrong: a producer that
+    enqueues in-flight batches with no depth bound and no drain in scope
+    — a stalled collector turns the device queue into an OOM (the exact
+    hazard DispatchPipeline's backpressure wait exists to kill)."""
+
+    def __init__(self):
+        self._fifo = collections.deque()
+
+    def producer_loop(self, batches):
+        while True:
+            batch = batches.get_next()
+            if batch is None:
+                break
+            handle = batch.dispatch()
+            self._fifo.append(handle)  # expect: unbounded-queue-append

@@ -200,6 +200,40 @@ def test_health_score_shape():
     assert draining == 0.0
 
 
+def test_health_score_sees_pipeline_occupancy():
+    """A pipelined replica with an EMPTY admission queue but a full
+    dispatch window must not look idle to the router — window occupancy
+    is load one stage past the queue (ISSUE 9 satellite)."""
+    base = {"queue_depth": 0, "inflight": 0, "max_queue": 64,
+            "max_batch": 8, "replica_step": 5}
+    idle = health_score({**base, "pipeline_inflight": 0,
+                         "pipeline_depth": 3}, fleet_max_step=5)
+    full_window = health_score({**base, "pipeline_inflight": 3,
+                                "pipeline_depth": 3}, fleet_max_step=5)
+    half_window = health_score({**base, "pipeline_inflight": 1.5,
+                                "pipeline_depth": 3}, fleet_max_step=5)
+    assert idle == 1.0
+    assert 0.0 < full_window < half_window < idle
+    # a full window weighs like a full admission queue (both normalize
+    # to load 1.0)
+    full_queue = health_score({**base, "queue_depth": 64},
+                              fleet_max_step=5)
+    assert abs(full_window - full_queue) < 1e-9
+    # NO double counting: in pipelined mode serve.inflight counts the
+    # SAME window requests, so a realistic saturated pipelined member
+    # (inflight = depth * max_batch AND occupancy = depth) must score
+    # exactly like a saturated serialized one (inflight = max_batch) —
+    # otherwise the router drifts away from the faster path.
+    pipelined_sat = health_score({**base, "inflight": 24,
+                                  "pipeline_inflight": 3,
+                                  "pipeline_depth": 3}, fleet_max_step=5)
+    serial_sat = health_score({**base, "inflight": 8}, fleet_max_step=5)
+    assert abs(pipelined_sat - serial_sat) < 1e-9
+    # pre-pipeline members (no depth field) are unaffected
+    legacy = health_score(base, fleet_max_step=5)
+    assert legacy == 1.0
+
+
 def test_replica_group_join_heartbeat_sweep():
     group = ReplicaGroup(heartbeat_ms=20.0, liveness_misses=3)
     reply = group.join("a", "127.0.0.1", 1111)

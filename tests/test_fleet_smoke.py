@@ -43,7 +43,7 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert line["replicas"] == 2
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v3"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v4"
     assert record["replicas"] == 2
 
     # Routed lookups bitwise-equal to the direct table gather.
@@ -103,8 +103,18 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert len(per) == 2
     fleet = stats["fleet"]
     for key in ("requests", "replies", "shed", "cancelled",
-                "slo_violations"):
+                "slo_violations", "cache_hits"):
         assert fleet[key] == sum(r[key] for r in per.values()), key
     assert abs(fleet["qps"] - sum(r["qps"] for r in per.values())) < 1e-6
     assert fleet["replies"] > 0
     assert stats["version"] > 0
+
+    # -- PR-9 serving optimizations engaged across the fleet --------------
+    # Replica heartbeats carry dispatch-window occupancy; the dry run's
+    # load must have overlapped batches on at least one replica, and the
+    # repeated-key witness must have landed a hot-row cache hit.
+    pipe = record["pipeline"]
+    assert pipe["max_inflight"] >= 2, pipe
+    assert pipe["cache_hits"] >= 1, pipe
+    for r in per.values():
+        assert "pipeline_inflight" in r and "cache_hits" in r

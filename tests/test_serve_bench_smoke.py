@@ -28,8 +28,8 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["benchmark"] == "serve_lookup"
     record = json.loads(out.read_text())
-    # v3: + tracing block (stage breakdown, slowest-K, traced/untraced QPS)
-    assert record["schema"] == "multiverso_tpu.bench_serve/v3"
+    # v4: + pipeline/cache witnesses and optional qps_sweep block
+    assert record["schema"] == "multiverso_tpu.bench_serve/v4"
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
     assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
@@ -51,6 +51,17 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     assert any(k.startswith("serve.latency.")
                for k in record["serve_metrics"]["histograms"])
     assert "serve.queue_depth" in record["serve_metrics"]["gauges"]
+    # PR-9 acceptance witnesses: the dispatch pipeline genuinely
+    # OVERLAPPED (window occupancy reached >= 2 — not the serialized
+    # path) and the hot-row cache recorded a hit. Either silently
+    # regressing to the old path fails tier-1 here.
+    pipe = record["pipeline"]
+    assert pipe["depth"] >= 2, pipe
+    assert pipe["max_inflight"] >= 2, pipe
+    assert pipe["overlap_ok"] is True, pipe
+    assert pipe["cache_hits"] >= 1, pipe
+    assert pipe["cache_hit_ok"] is True, pipe
+    assert "serve.pipeline.inflight" in record["serve_metrics"]["gauges"]
 
 
 def test_serve_main_cli_end_to_end(tmp_path):

@@ -1,0 +1,238 @@
+"""Continuous-batching decode: bitwise parity + iteration-level joining.
+
+The headline contract (ISSUE 9): a request that JOINS a running decode
+batch at a step boundary produces tokens BIT-IDENTICAL to decoding it
+through the drain-first path (``AttentionLMRunner.run``) — slot/position
+decoupling means pad slots and neighbors are never attended, so which
+slots happen to be busy when you arrive cannot change your tokens."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def _lm(max_new=6, max_batch=3):
+    import jax
+
+    from multiverso_tpu.models.attention_lm import LMConfig, init_params
+    from multiverso_tpu.serving import AttentionLMRunner
+
+    cfg = LMConfig(vocab=61, dim=32, heads=4, layers=2, seq=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    runner = AttentionLMRunner({k: np.asarray(v) for k, v in
+                                params.items()}, cfg, max_new=max_new,
+                               max_batch=max_batch)
+    return runner, params, cfg
+
+
+def _solo_drain_tokens(runner, prompt, bucket):
+    """The drain-first reference: this prompt alone through
+    AttentionLMRunner.run at the same bucket."""
+    mat = np.zeros((runner.max_batch, bucket), np.int32)
+    mat[0, :len(prompt)] = prompt
+    lens = np.zeros(runner.max_batch, np.int32)
+    lens[0] = len(prompt)
+    return runner.run(mat, lens)[0].tolist()
+
+
+def test_late_join_tokens_bitwise_equal_drain_path(mv_env):
+    """Submit A; while A decodes, submit B and C (late joiners claiming
+    free KV slots). All three must match their solo drain-path tokens
+    exactly, and the engine must have had >1 slot active at once."""
+    from multiverso_tpu.serving import ContinuousBatcher
+    from multiverso_tpu.telemetry import get_registry
+
+    runner, _, _ = _lm(max_new=8, max_batch=3)
+    prompts = [[5, 9, 2], [1], [7, 3, 3, 3, 8, 2, 40]]
+    solo = {tuple(p): _solo_drain_tokens(runner, p, bucket=8)
+            for p in prompts}
+
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=3,
+                           max_queue=16)
+    try:
+        f1 = cb.submit(np.asarray(prompts[0], np.int32),
+                       deadline_ms=60_000)
+        # Wait until A is genuinely mid-decode before the others join.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            eng = cb._engines.get(8)
+            if eng is not None and eng.n_active() and eng.t.max() >= 1:
+                break
+            time.sleep(0.001)
+        f2 = cb.submit(np.asarray(prompts[1], np.int32),
+                       deadline_ms=60_000)
+        f3 = cb.submit(np.asarray(prompts[2], np.int32),
+                       deadline_ms=60_000)
+        for p, f in zip(prompts, (f1, f2, f3)):
+            assert f.wait(60).tolist() == solo[tuple(p)], p
+        snap = get_registry().snapshot(buckets=False)
+        assert snap["gauges"]["serve.continuous.active"]["max"] >= 2, \
+            "requests never shared the decode batch"
+        assert snap["counters"]["serve.continuous.joins"]["value"] == 3
+    finally:
+        cb.close()
+
+
+def test_slot_reuse_after_completion_stays_bitwise(mv_env):
+    """A slot freed by a finished request is re-prefilled by the next —
+    stale K/V in the generated region must never leak into the new
+    occupant's tokens (the mask contract). Drive 3x max_batch requests
+    through 2 slots worth of churn."""
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, _ = _lm(max_new=4, max_batch=2)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 60, int(n)).tolist()
+               for n in rng.integers(1, 8, 6)]
+    solo = [_solo_drain_tokens(runner, p, bucket=8) for p in prompts]
+
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=2,
+                           max_queue=16)
+    try:
+        futs = [cb.submit(np.asarray(p, np.int32), deadline_ms=60_000)
+                for p in prompts]
+        for p, want, f in zip(prompts, solo, futs):
+            assert f.wait(60).tolist() == want, p
+    finally:
+        cb.close()
+
+
+def test_max_new_one_parity(mv_env):
+    """max_new=1: the request completes straight out of prefill. A step
+    must never overwrite its only token before delivery (regression:
+    the loop once stepped freshly-joined slots before delivering)."""
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, _ = _lm(max_new=1, max_batch=2)
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=2,
+                           max_queue=8)
+    try:
+        for p in ([5, 9, 2], [1], [7, 3, 3]):
+            want = _solo_drain_tokens(runner, p, bucket=8)
+            got = cb.submit(np.asarray(p, np.int32),
+                            deadline_ms=60_000).wait(60)
+            assert got.tolist() == want, p
+    finally:
+        cb.close()
+
+
+def test_multi_bucket_engines_and_jit_accounting(mv_env):
+    """One prefill + one step executable per exercised bucket (the
+    no-retrace witness, continuous flavor)."""
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, _ = _lm(max_new=3, max_batch=2)
+    cb = ContinuousBatcher(runner, buckets=(4, 8), max_batch=2,
+                           max_queue=16)
+    try:
+        s4 = _solo_drain_tokens(runner, [5, 9], bucket=4)
+        assert cb.submit(np.asarray([5, 9], np.int32),
+                         deadline_ms=60_000).wait(60).tolist() == s4
+        assert cb.jit_cache_size() == 1
+        s8 = _solo_drain_tokens(runner, [7, 3, 3, 3, 8], bucket=8)
+        assert cb.submit(np.asarray([7, 3, 3, 3, 8], np.int32),
+                         deadline_ms=60_000).wait(60).tolist() == s8
+        assert cb.jit_cache_size() == 2
+        # step compiles in lockstep with prefill: same bucket count
+        assert int(cb._step._cache_size()) == 2
+        # re-serving an old bucket never retraces
+        assert cb.submit(np.asarray([5, 9], np.int32),
+                         deadline_ms=60_000).wait(60).tolist() == s4
+        assert cb.jit_cache_size() == 2
+    finally:
+        cb.close()
+
+
+def test_continuous_through_service_with_swap(mv_env):
+    """Full plane: register with continuous=True, serve decodes over the
+    wire, hot-swap params mid-life (swap lands at a step boundary; the
+    NEXT request serves the new weights, tokens again == solo drain)."""
+    import jax
+
+    from multiverso_tpu.models.attention_lm import init_params
+    from multiverso_tpu.serving import ServingClient, ServingService
+
+    runner, _, cfg = _lm(max_new=5, max_batch=2)
+    svc = ServingService()
+    svc.register_runner(runner, buckets=(8,), max_batch=2,
+                        max_wait_ms=1.0, continuous=True)
+    assert svc.warmup() == 2                       # prefill + step
+    cli = ServingClient(*svc.address)
+    try:
+        prompt = [5, 9, 2]
+        want = _solo_drain_tokens(runner, prompt, bucket=8)
+        got = cli.generate(np.asarray(prompt, np.int32),
+                           deadline_ms=60_000, timeout=120)
+        assert got.tolist() == want
+
+        new_params = {k: np.asarray(v) for k, v in init_params(
+            cfg, jax.random.PRNGKey(9)).items()}
+        runner.swap_params(new_params)
+        want2 = _solo_drain_tokens(runner, prompt, bucket=8)
+        assert want2 != want                       # weights really moved
+        got2 = cli.generate(np.asarray(prompt, np.int32),
+                            deadline_ms=60_000, timeout=120)
+        assert got2.tolist() == want2
+    finally:
+        cli.close()
+        svc.close()
+
+
+def test_continuous_admission_sheds_and_cancels(mv_env):
+    """The DynamicBatcher admission surface carries over: oversize sheds
+    immediately, an expired deadline sheds at the claim boundary, and a
+    queued cancel never reaches a KV slot."""
+    from multiverso_tpu.serving import ContinuousBatcher, ShedError
+
+    runner, _, _ = _lm(max_new=4, max_batch=1)
+    cb = ContinuousBatcher(runner, buckets=(4,), max_batch=1,
+                           max_queue=8)
+    try:
+        with pytest.raises(ShedError) as e:
+            cb.submit(np.arange(9, dtype=np.int32) + 1,
+                      deadline_ms=60_000).wait(30)
+        assert e.value.reason == "oversize"
+
+        with pytest.raises(ShedError) as e:
+            cb.submit(np.asarray([3], np.int32), deadline_ms=0.0).wait(30)
+        assert e.value.reason == "deadline"
+
+        # occupy the single slot, then cancel a queued request
+        running = cb.submit(np.asarray([5, 9], np.int32),
+                            deadline_ms=60_000)
+        done = threading.Event()
+        outcome = []
+
+        def on_done(result):
+            outcome.append(result)
+            done.set()
+
+        token = cb.submit_callback(np.asarray([7], np.int32), 60_000.0,
+                                   on_done)
+        if token is not None and cb.cancel(token):
+            assert done.wait(30)
+            assert isinstance(outcome[0], ShedError)
+            assert outcome[0].reason == "cancelled"
+        running.wait(60)
+    finally:
+        cb.close()
+
+
+def test_continuous_quiesce_barrier(mv_env):
+    """quiesce() returns only once every slot drained — the checkpoint
+    swap barrier, continuous flavor."""
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, _ = _lm(max_new=12, max_batch=2)
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=2,
+                           max_queue=8)
+    try:
+        f = cb.submit(np.asarray([5, 9, 2], np.int32), deadline_ms=60_000)
+        assert cb.quiesce(timeout_s=60)
+        # the request finished before quiesce reported idle
+        assert f.event.is_set()
+        f.wait(5)
+    finally:
+        cb.close()

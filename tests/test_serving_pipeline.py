@@ -1,0 +1,387 @@
+"""Dispatch-pipeline + hot-row-cache tests (PR 9's serving perf work).
+
+The contracts under test:
+
+* the depth-N pipeline actually OVERLAPS — a slow collect lets multiple
+  dispatched batches ride in flight, bounded by depth (backpressure);
+* delivery stays exactly-once and FIFO through the pipelined path, and
+  quiesce still means "nothing queued, nothing mid-flight" (the rolling
+  checkpoint-swap barrier);
+* pipelined serving is BITWISE-equal to the serialized path against a
+  live table (same gather, same snapshot discipline);
+* cache hits are bitwise-equal to a direct ``table.get_rows`` and the
+  staleness bound is respected under concurrent training writes: a
+  clock advance past the bound forces the device path, a within-bound
+  age serves the stamped snapshot.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.serving import (DispatchPipeline, DynamicBatcher,
+                                    HotRowCache, ShedError,
+                                    resolve_pipeline_depth)
+from multiverso_tpu.serving.pipeline import InflightBatch
+
+
+class TwoPhaseRunner:
+    """Runner double speaking the dispatch/collect contract: dispatch is
+    instant (records the call), collect blocks ``collect_s`` to simulate
+    device execution so the window can fill."""
+
+    name = "two_phase"
+    payload_dtype = np.int32
+    pad_id = 0
+
+    def __init__(self, collect_s: float = 0.0):
+        self.collect_s = collect_s
+        self.dispatches = []
+        self.collected = []
+        self.max_concurrent = 0
+        self._outstanding = 0
+        self._lock = threading.Lock()
+
+    def dispatch(self, batch, lengths):
+        with self._lock:
+            self._outstanding += 1
+            self.max_concurrent = max(self.max_concurrent,
+                                      self._outstanding)
+            self.dispatches.append((batch.copy(), lengths.copy()))
+        return (batch.copy(), lengths.copy())
+
+    def collect(self, handle):
+        if self.collect_s:
+            time.sleep(self.collect_s)
+        batch, lengths = handle
+        with self._lock:
+            self._outstanding -= 1
+            self.collected.append(lengths.copy())
+        return batch
+
+    def run(self, batch, lengths):
+        return self.collect(self.dispatch(batch, lengths))
+
+    def slice_result(self, out, i, length):
+        return out[i, :length]
+
+    def jit_cache_size(self):
+        return 1
+
+
+def test_resolve_pipeline_depth_values():
+    assert resolve_pipeline_depth(0) == 0
+    assert resolve_pipeline_depth(1) == 1
+    assert resolve_pipeline_depth(5) == 5
+    assert resolve_pipeline_depth("3") == 3
+    # auto probes the (CPU) dispatch latency: fast launch -> small depth,
+    # always within the documented window
+    assert 2 <= resolve_pipeline_depth("auto") <= 4
+    assert 2 <= resolve_pipeline_depth(None) <= 4
+    with pytest.raises(Exception):
+        resolve_pipeline_depth("fast")
+
+
+def test_pipeline_overlaps_and_bounds_inflight(mv_env):
+    """With collect slower than dispatch, the window fills to depth (and
+    NEVER past it), proving batches genuinely overlap."""
+    from multiverso_tpu.telemetry import get_registry
+
+    runner = TwoPhaseRunner(collect_s=0.05)
+    b = DynamicBatcher(runner, buckets=(4,), max_batch=1, max_wait_ms=0.0,
+                       max_queue=64, pipeline_depth=3)
+    try:
+        futs = [b.submit(np.asarray([i], np.int32), deadline_ms=30_000)
+                for i in range(8)]
+        results = [f.wait(30) for f in futs]
+        for i, r in enumerate(results):
+            np.testing.assert_array_equal(r, [i])
+        assert runner.max_concurrent >= 2, "dispatches never overlapped"
+        snap = get_registry().snapshot(buckets=False)
+        g = snap["gauges"]["serve.pipeline.inflight"]
+        assert g["max"] >= 2
+        assert g["max"] <= 3 + 1        # window + the one mid-collect
+        assert snap["counters"]["serve.pipeline.backpressure"]["value"] > 0
+        # FIFO delivery: collected lengths in dispatch order
+        assert [int(l[0]) for l in runner.collected] == [1] * 8
+    finally:
+        b.close()
+
+
+def test_pipelined_delivery_order_and_parity(mv_env):
+    """Every request's payload comes back exactly-once and intact (the
+    parrot runner) through the pipelined path."""
+    runner = TwoPhaseRunner(collect_s=0.002)
+    b = DynamicBatcher(runner, buckets=(4, 8), max_batch=4,
+                       max_wait_ms=0.5, pipeline_depth=2)
+    seen = []
+    lock = threading.Lock()
+
+    def on_done(i):
+        def cb(result):
+            with lock:
+                seen.append((i, result))
+        return cb
+
+    try:
+        for i in range(20):
+            b.submit_callback(np.asarray([i, i + 1], np.int32), 30_000.0,
+                              on_done(i))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with lock:
+                if len(seen) == 20:
+                    break
+            time.sleep(0.01)
+        with lock:
+            assert len(seen) == 20
+            for i, result in seen:
+                assert not isinstance(result, BaseException), result
+                np.testing.assert_array_equal(result, [i, i + 1])
+    finally:
+        b.close()
+
+
+def test_pipelined_quiesce_waits_for_inflight(mv_env):
+    """quiesce() must not report idle while a dispatched batch is still
+    riding the window — the straddling batch IS what the checkpoint-swap
+    barrier exists to stop."""
+    runner = TwoPhaseRunner(collect_s=0.15)
+    b = DynamicBatcher(runner, buckets=(4,), max_batch=1, max_wait_ms=0.0,
+                       pipeline_depth=2)
+    try:
+        futs = [b.submit(np.asarray([1], np.int32), deadline_ms=30_000)
+                for _ in range(3)]
+        t0 = time.monotonic()
+        assert b.quiesce(timeout_s=30)
+        # idle only after every batch collected: >= 1 collect period
+        assert time.monotonic() - t0 >= 0.05
+        assert len(runner.collected) == 3
+        for f in futs:
+            f.wait(5)
+        assert b._pipeline.empty()
+    finally:
+        b.close()
+
+
+def test_pipelined_collect_error_sheds_batch_only(mv_env):
+    """A collect() blow-up sheds THAT batch exactly-once and the worker
+    + collector survive for the next request."""
+    class Exploding(TwoPhaseRunner):
+        def collect(self, handle):
+            batch, lengths = handle
+            if int(batch[0, 0]) == 13:
+                with self._lock:
+                    self._outstanding -= 1
+                raise RuntimeError("boom")
+            return super().collect(handle)
+
+    runner = Exploding()
+    b = DynamicBatcher(runner, buckets=(4,), max_batch=1, max_wait_ms=0.0,
+                       pipeline_depth=2)
+    try:
+        bad = b.submit(np.asarray([13], np.int32), deadline_ms=30_000)
+        with pytest.raises(ShedError):
+            bad.wait(20)
+        good = b.submit(np.asarray([2], np.int32), deadline_ms=30_000)
+        np.testing.assert_array_equal(good.wait(20), [2])
+    finally:
+        b.close()
+
+
+def test_pipelined_live_table_bitwise_parity(mv_env):
+    """Pipelined serving over a live table == direct get_rows, and the
+    one-executable-per-bucket contract holds through the new path."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.serving import ServingClient, ServingService
+
+    t = mv.create_table(mv.MatrixTableOption(num_row=128, num_col=8))
+    rng = np.random.default_rng(3)
+    t.add_rows(np.arange(128, dtype=np.int32),
+               rng.normal(size=(128, 8)).astype(np.float32))
+    runner = t.serving_runner()
+    svc = ServingService()
+    svc.register_runner(runner, buckets=(4, 8), max_batch=4,
+                        max_wait_ms=1.0, pipeline_depth=2)
+    cli = ServingClient(*svc.address)
+    try:
+        for n in (2, 4, 7, 8, 3):
+            q = rng.integers(0, 128, n).astype(np.int32)
+            np.testing.assert_array_equal(
+                cli.lookup(q, deadline_ms=10_000), t.get_rows(q))
+        assert runner.jit_cache_size() == 2         # buckets 4 and 8
+        assert svc.batcher(0).pipeline_depth == 2
+    finally:
+        cli.close()
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Hot-row cache
+# ---------------------------------------------------------------------------
+def test_cache_lru_eviction_and_capacity():
+    c = HotRowCache(capacity=2, staleness=0)
+    c.put_rows(np.asarray([1]), np.ones((1, 4), np.float32), 0)
+    c.put_rows(np.asarray([2]), np.ones((1, 4), np.float32) * 2, 0)
+    assert len(c) == 2
+    # touch 1 (full hit), then insert 3: LRU victim must be 2
+    assert c.get_rows(np.asarray([1]), 0) is not None
+    c.put_rows(np.asarray([3]), np.ones((1, 4), np.float32) * 3, 0)
+    assert len(c) == 2
+    assert c.get_rows(np.asarray([2]), 0) is None
+    assert c.get_rows(np.asarray([1]), 0) is not None
+    # all-or-nothing: one cold key fails the whole request
+    assert c.get_rows(np.asarray([1, 9]), 0) is None
+
+
+def test_cache_hits_bitwise_equal_under_training_writes(mv_env):
+    """The headline parity: cached lookups == direct ``table.get_rows``
+    while a concurrent writer mutates the table, with the staleness
+    bound deciding exactly when the cache must refetch.
+
+    Clock discipline (BSP): writes land, THEN the clock ticks. With
+    staleness=1 an entry stamped at clock c serves through c+1 and must
+    refetch at c+2."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.serving import ServingClient, ServingService
+    from multiverso_tpu.serving.runners import SparseLookupRunner
+    from multiverso_tpu.telemetry import get_registry
+
+    t = mv.create_table(mv.MatrixTableOption(num_row=64, num_col=4))
+    rng = np.random.default_rng(0)
+    t.add_rows(np.arange(64, dtype=np.int32),
+               rng.normal(size=(64, 4)).astype(np.float32))
+    clock = [0.0]
+    cache = HotRowCache(capacity=64, staleness=1)
+    runner = SparseLookupRunner(t.store, clock_fn=lambda: (clock[0], 0.0),
+                                cache=cache)
+    svc = ServingService()
+    svc.register_runner(runner, buckets=(8,), max_batch=2,
+                        max_wait_ms=0.5, pipeline_depth=0)
+    cli = ServingClient(*svc.address)
+    reg = get_registry()
+    q = np.asarray([5, 17, 30], np.int32)
+    try:
+        v0 = cli.lookup(q, deadline_ms=10_000)      # miss: populate @0
+        np.testing.assert_array_equal(v0, t.get_rows(q))
+        hits0 = reg.counter("serve.cache.hit").value
+        v1 = cli.lookup(q, deadline_ms=10_000)      # hit @0
+        assert reg.counter("serve.cache.hit").value == hits0 + 1
+        np.testing.assert_array_equal(v1, t.get_rows(q))
+
+        # Training write + clock tick: age 1 <= staleness -> still a
+        # hit, serving the STAMPED snapshot (the documented bound).
+        old = t.get_rows(q)
+        t.add_rows(q, np.ones((3, 4), np.float32))
+        clock[0] = 1.0
+        v2 = cli.lookup(q, deadline_ms=10_000)
+        assert reg.counter("serve.cache.hit").value == hits0 + 2
+        np.testing.assert_array_equal(v2, old)      # bounded staleness
+
+        # Second tick: age 2 > staleness -> stale, device refetch, and
+        # the refetched rows are bitwise the CURRENT table rows.
+        clock[0] = 2.0
+        stale0 = reg.counter("serve.cache.stale").value
+        v3 = cli.lookup(q, deadline_ms=10_000)
+        assert reg.counter("serve.cache.stale").value == stale0 + 1
+        np.testing.assert_array_equal(v3, t.get_rows(q))
+
+        # The refetch restamped @2: an immediate repeat hits again,
+        # bitwise-fresh.
+        v4 = cli.lookup(q, deadline_ms=10_000)
+        np.testing.assert_array_equal(v4, t.get_rows(q))
+        assert reg.counter("serve.cache.hit").value == hits0 + 3
+    finally:
+        cli.close()
+        svc.close()
+
+
+def test_cache_staleness_zero_always_fresh_under_writes(mv_env):
+    """staleness=0: every clock tick invalidates — cached serving is
+    indistinguishable (bitwise) from direct reads at every step."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.serving import ServingClient, ServingService
+    from multiverso_tpu.serving.runners import SparseLookupRunner
+
+    t = mv.create_table(mv.MatrixTableOption(num_row=32, num_col=4))
+    t.add_rows(np.arange(32, dtype=np.int32),
+               np.arange(128, dtype=np.float32).reshape(32, 4))
+    clock = [0.0]
+    runner = SparseLookupRunner(t.store, clock_fn=lambda: (clock[0], 0.0),
+                                cache=HotRowCache(32, staleness=0))
+    svc = ServingService()
+    svc.register_runner(runner, buckets=(8,), max_batch=2,
+                        max_wait_ms=0.5)
+    cli = ServingClient(*svc.address)
+    q = np.asarray([1, 2, 3], np.int32)
+    try:
+        for step in range(4):
+            direct = t.get_rows(q)
+            for _ in range(2):      # miss-then-hit at each step
+                np.testing.assert_array_equal(
+                    cli.lookup(q, deadline_ms=10_000), direct)
+            t.add_rows(q, np.full((3, 4), float(step + 1), np.float32))
+            clock[0] += 1.0
+        # final state also bitwise
+        np.testing.assert_array_equal(
+            cli.lookup(q, deadline_ms=10_000), t.get_rows(q))
+    finally:
+        cli.close()
+        svc.close()
+
+
+def test_clockless_live_table_never_serves_from_cache(mv_env):
+    """A LIVE table without a BSP clock (async mode) must ignore the
+    cache entirely: with no version to age entries by, a cached row
+    would mask training writes forever (regression guard)."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.serving.runners import SparseLookupRunner
+
+    t = mv.create_table(mv.MatrixTableOption(num_row=16, num_col=4))
+    t.add_rows(np.arange(16, dtype=np.int32),
+               np.arange(64, dtype=np.float32).reshape(16, 4))
+    cache = HotRowCache(16, staleness=0)
+    runner = SparseLookupRunner(t.store, clock_fn=None, cache=cache)
+    q = np.asarray([1, 2], np.int32)
+    mat = np.zeros((2, 4), np.int32)
+    mat[0, :2] = q
+    lens = np.asarray([2, 0], np.int32)
+    runner.run(mat, lens)
+    assert len(cache) == 0                  # never populated
+    assert runner.try_cached(q) is None     # never served
+    # training write is immediately visible (no cache in the way)
+    t.add_rows(q, np.ones((2, 4), np.float32))
+    out = runner.run(mat, lens)
+    np.testing.assert_array_equal(out[0, :2], t.get_rows(q))
+
+
+def test_pipeline_close_delivers_everything(mv_env):
+    """close() with batches mid-flight: every future completes (served
+    or shed) — nothing hangs, nothing double-delivers."""
+    runner = TwoPhaseRunner(collect_s=0.03)
+    b = DynamicBatcher(runner, buckets=(4,), max_batch=1, max_wait_ms=0.0,
+                       pipeline_depth=2)
+    futs = [b.submit(np.asarray([i], np.int32), deadline_ms=30_000)
+            for i in range(6)]
+    b.close()
+    outcomes = 0
+    for f in futs:
+        try:
+            f.wait(10)
+            outcomes += 1
+        except ShedError:
+            outcomes += 1
+    assert outcomes == 6
+
+
+def test_bare_pipeline_submit_after_close():
+    p = DispatchPipeline(depth=2)
+    p.close()
+    delivered = []
+    item = InflightBatch(handle=None, collect=lambda h: h,
+                         deliver=lambda i, r: delivered.append(r),
+                         n_requests=1)
+    assert p.submit(item) is False
+    assert not delivered
