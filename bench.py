@@ -615,6 +615,22 @@ def main() -> None:
     # headline number, so regressions diff via scripts/telemetry_report.py
     # against any -telemetry_dir run (docs/OBSERVABILITY.md).
     from multiverso_tpu.telemetry import metrics_snapshot
+    telemetry = metrics_snapshot(buckets=False)
+    # Three-way CommPolicy legs (scripts/comm_bench.py; docs/DESIGN.md
+    # "CommPolicy") — captured AFTER the snapshot because each leg runs
+    # under a reset telemetry registry. Best-effort: a failing leg must
+    # not cost the headline record.
+    comm_block = {}
+    try:
+        from scripts.comm_bench import (auto_evidence,
+                                        bench_logreg_policies,
+                                        bench_word2vec_policies)
+        comm_block = {"word2vec": bench_word2vec_policies(False),
+                      "logreg": bench_logreg_policies(False)}
+        comm_block["auto"] = auto_evidence(comm_block["word2vec"],
+                                           comm_block["logreg"])
+    except Exception as e:  # noqa: BLE001 - policy leg is best-effort
+        _log(f"comm-policy leg skipped: {e}")
     print(json.dumps({
         "metric": "w2v_words_per_sec",
         "value": round(words_per_sec, 1),
@@ -625,7 +641,8 @@ def main() -> None:
         "secondary": {"matrix_param_updates_per_sec": round(updates_per_sec),
                       "serve_lookup_qps": round(serve_qps, 1),
                       **roofline, **_virtual_trend(here),
-                      "telemetry": metrics_snapshot(buckets=False)},
+                      "comm_policy": comm_block,
+                      "telemetry": telemetry},
     }))
 
 

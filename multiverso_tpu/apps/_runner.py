@@ -89,6 +89,36 @@ def serve_config() -> dict:
     }
 
 
+def comm_config() -> dict:
+    """Resolve the ``-comm_policy`` / ``-comm_policy_overrides`` flags
+    (utils/configure.py) into the model-config fields — one parse shared
+    by word2vec_main and logreg_main (README documents the table)."""
+    from multiverso_tpu.utils.configure import get_flag
+    from multiverso_tpu.utils.log import FatalError
+
+    policy = str(get_flag("comm_policy")).strip().lower()
+    valid = ("", "auto", "hybrid", "ps", "allreduce", "model_average")
+    if policy not in valid:
+        raise FatalError(f"bad -comm_policy value '{policy}' "
+                         f"(want one of {'|'.join(v for v in valid if v)})")
+    raw = str(get_flag("comm_policy_overrides")).strip()
+    overrides = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        table, sep, pol = part.partition("=")
+        pol = pol.strip().lower()
+        if not sep or not table.strip() or pol not in (
+                "ps", "allreduce", "model_average"):
+            raise FatalError(
+                f"bad -comm_policy_overrides entry '{part}' (want "
+                "'table=ps|allreduce|model_average')")
+        overrides[table.strip()] = pol
+    return {"comm_policy": policy or None, "comm_policy_overrides":
+            overrides or None}
+
+
 def fleet_config() -> dict:
     """Resolve the ``-fleet_*`` flags into router/member/client kwargs
     (one parse, like :func:`serve_config` — README documents the table)."""

@@ -35,11 +35,17 @@ _DIST_TABLE_ID = 60
 
 
 def _load_config() -> tuple:
+    from multiverso_tpu.apps._runner import comm_config
     from multiverso_tpu.models.logreg import LogRegConfig
 
     config_file = configure.get_flag("config_file")
     cfg = (LogRegConfig.from_file(config_file) if config_file
            else LogRegConfig())
+    # -comm_policy routes the weight table onto its plane (docs/DESIGN.md
+    # "CommPolicy"); the config-file key of the same name also works.
+    policy = comm_config()["comm_policy"]
+    if policy:
+        cfg.comm_policy = policy
     train_file = configure.get_flag("lr_train_file") or cfg.train_file
     test_file = configure.get_flag("lr_test_file") or cfg.test_file
     return cfg, train_file, test_file

@@ -68,10 +68,12 @@ def _cfg_from_flags(device_pipeline: bool) -> "Word2VecConfig":
     bodies. ``device_pipeline=False`` for distributed ranks: the pull-
     train-push DistributedWord2Vec path generates pairs host-side to know
     its touched-row sets up front."""
+    from multiverso_tpu.apps._runner import comm_config
     from multiverso_tpu.models.word2vec import Word2VecConfig
 
     sg = not configure.get_flag("cbow")
     hs = configure.get_flag("hs")
+    comm = comm_config()
     return Word2VecConfig(
         embedding_size=configure.get_flag("size"),
         window=configure.get_flag("window"),
@@ -92,6 +94,8 @@ def _cfg_from_flags(device_pipeline: bool) -> "Word2VecConfig":
         pad_sentence_length=configure.get_flag("pad_sentence_length"),
         dispatch_mode=configure.get_flag("dispatch_mode"),
         dispatch_depth=configure.get_flag("dispatch_depth"),
+        comm_policy=comm["comm_policy"],
+        comm_policy_overrides=comm["comm_policy_overrides"],
     )
 
 

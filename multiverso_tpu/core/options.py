@@ -50,6 +50,11 @@ class TableOption:
     """Base for all table-creation options."""
     updater: Optional[str] = None   # None -> '-updater_type' flag
     name: Optional[str] = None
+    # Per-table communication policy (parallel/comm_policy.py):
+    # ps|allreduce|model_average, "auto" = resolve_comm_policy's decision
+    # table (probes once per byte bucket), None = ps (the existing plane,
+    # resolved without probing so table creation stays free).
+    comm_policy: Optional[str] = None
 
 
 @dataclasses.dataclass

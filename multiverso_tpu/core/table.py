@@ -251,6 +251,26 @@ class ServerStore:
         """Wait until all previously dispatched updates have executed."""
         jax.block_until_ready(self.read())
 
+    def write_dense(self, values) -> None:
+        """Overwrite the logical table contents — the whole-replica
+        publish the comm-policy planes need (an allreduce/model-average
+        worker replaces the stored params at a sync point; the Add API
+        deliberately only ships deltas). Pads to the physical shape and
+        lays the buffer out with the store's sharding. Concurrent readers
+        keep the references they already hold (the same swap discipline
+        as :meth:`load_state`); the store lock orders the swap against
+        in-flight updater dispatches."""
+        values = np.asarray(values, dtype=self.dtype)
+        check(tuple(values.shape) == self.logical_shape,
+              f"publish shape {values.shape} != {self.logical_shape}")
+        if self._pad:
+            host = np.zeros(self.padded_shape, dtype=self.dtype)
+            host[tuple(slice(0, s) for s in self.logical_shape)] = values
+        else:
+            host = values
+        with self._dispatch_scope():
+            self.data = jax.device_put(host, self.sharding)
+
     # -- checkpointing (ref table_interface.h:61-75) -----------------------
     def store_state(self) -> Dict[str, np.ndarray]:
         out = {"data": np.asarray(self.read())}

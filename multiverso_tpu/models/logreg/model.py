@@ -42,6 +42,12 @@ class LogRegConfig:
     sync_frequency: int = 1
     pipeline: bool = False
     use_ps: bool = True
+    # Weight-table communication policy (parallel/comm_policy.py):
+    # "" -> ps (the PSModel path, unchanged default); "auto" -> the
+    # decision table (small dense weights -> allreduce wherever the
+    # probe says the in-graph plane wins); ps|allreduce|model_average
+    # explicit. FTRL is pinned to ps (server-side {z, n} state).
+    comm_policy: str = ""
     regular: str = "none"               # none|l1|l2
     regular_coef: float = 0.0
     bias: bool = True
@@ -102,7 +108,10 @@ class LogRegConfig:
         return cfg
 
 
-def _make_step(cfg: LogRegConfig):
+def _raw_step(cfg: LogRegConfig):
+    """Unjitted ``(weights, X, y) -> (loss, grad)`` — the one objective
+    math both the PS path and the in-graph comm-policy steps share, so
+    policy parity is structural (same ops, same order)."""
     loss_grad, _ = get_objective(cfg.objective)
     coef = cfg.regular_coef
     regular = cfg.regular
@@ -115,12 +124,16 @@ def _make_step(cfg: LogRegConfig):
             grad = grad + coef * jnp.sign(weights)
         return loss, grad
 
+    return step
+
+
+def _make_step(cfg: LogRegConfig):
     # grad has exactly the weights' shape/dtype: donating lets XLA write
     # it into the uploaded weights buffer instead of allocating a second
     # [width, num_class] array per minibatch (PSModel uploads fresh
     # weights every call; LocalModel traces through this jit inside its
     # own donating sgd jit, where the inner annotation is a no-op).
-    return jax.jit(step, donate_argnums=(0,))
+    return jax.jit(_raw_step(cfg), donate_argnums=(0,))
 
 
 class LocalModel:
@@ -268,5 +281,162 @@ class PSModel:
         self.local_weights = w.copy()
 
 
+class AllreduceModel:
+    """``comm_policy=allreduce``: weights stay device-resident and the
+    gradient is merged IN-GRAPH inside one jitted, donated step — no PS
+    round trip per minibatch. With a data-parallel mesh axis the merge is
+    a real ``jax.lax.psum`` of per-shard gradients (the MXNET-MPI hybrid:
+    collectives embedded in the PS task model, PAPERS.md 1801.03855);
+    with a single contributor it degenerates to the fused local update.
+    The PS table remains the checkpoint/serving surface: :meth:`sync`
+    publishes the replica once, instead of pushing a delta every
+    minibatch (``table.publish`` counts under ``comm.allreduce.*``)."""
+
+    def __init__(self, cfg: LogRegConfig, table=None, dp_mesh=None,
+                 dp_axis: Optional[str] = None):
+        from multiverso_tpu.parallel import comm_policy as cp
+        from multiverso_tpu.parallel.mesh import shard_map
+        from multiverso_tpu.utils.log import check
+        from jax.sharding import PartitionSpec as P
+
+        check(cfg.objective != "ftrl",
+              "ftrl keeps server-side {z, n} state — comm_policy=allreduce "
+              "cannot reconstruct it; use ps")
+        self.cfg = cfg
+        self.table = table if table is not None else mv.create_table(
+            ArrayTableOption(size=cfg.width * cfg.num_class, updater="sgd",
+                             name="logreg_weights",
+                             comm_policy="allreduce"))
+        raw = _raw_step(cfg)
+        lr = cfg.learning_rate
+        n_axis = (dp_mesh.shape.get(dp_axis, 1)
+                  if dp_mesh is not None and dp_axis else 1)
+        barrier = getattr(jax.lax, "optimization_barrier", lambda x: x)
+
+        # Bitwise parity with the PS path needs its exact rounding
+        # points: there grad is a jit OUTPUT, lr*grad rounds as its own
+        # op, and the server subtract is its own kernel. One fused
+        # program drifts an ulp per step — the HLO simplifier folds
+        # grad's /batch into *lr (the barrier pins that), and XLA:CPU's
+        # LLVM backend then contracts mul+sub into an fma BELOW the HLO
+        # barrier. So the delta program and the donated subtract stay
+        # two dispatches: both device-side and async-chained (zero host
+        # round trips — the plane's whole point), with no mul feeding a
+        # sub inside either kernel.
+        if n_axis > 1:
+            axis = dp_axis
+
+            def delta_step(w, X, y):
+                loss, grad = raw(w, X, y)
+                # Per-shard batch means -> global mean: the in-graph
+                # allreduce this policy exists for.
+                grad = jax.lax.psum(grad, axis) / n_axis
+                loss = jax.lax.psum(loss, axis) / n_axis
+                return lr * barrier(grad), loss
+
+            fn = shard_map(delta_step, mesh=dp_mesh,
+                           in_specs=(P(), P(axis), P(axis)),
+                           out_specs=(P(), P()), check_vma=False)
+            # No donation by design: w must SURVIVE this program for the
+            # separate donated apply kernel (the bitwise-parity split
+            # above); grad/loss don't alias any input shape worth reusing.
+            self._delta = jax.jit(fn)  # graftlint: disable=missing-donation
+        else:
+            def delta_step(w, X, y):
+                loss, grad = raw(w, X, y)
+                return lr * barrier(grad), loss
+
+            # Same deliberate non-donation as the dp branch above.
+            self._delta = jax.jit(delta_step)  # graftlint: disable=missing-donation
+        self._apply = jax.jit(lambda w, d: w - d, donate_argnums=0)
+        self._n_axis = n_axis
+        self._grad_bytes = cfg.width * cfg.num_class * 4
+        self._cp = cp
+        self.weights = jnp.asarray(
+            np.asarray(self.table.raw()).reshape(cfg.width, cfg.num_class))
+
+    def update(self, X: np.ndarray, y: np.ndarray):
+        """Returns the loss as a device scalar (no host sync)."""
+        delta, loss = self._delta(self.weights, jnp.asarray(X),
+                                  jnp.asarray(y))
+        self.weights = self._apply(self.weights, delta)
+        self._cp.record(self._cp.ALLREDUCE, self._grad_bytes)
+        return loss
+
+    def sync(self) -> None:
+        """Publish the device replica to the PS table (epoch boundaries /
+        before test) — ONE dense write where PSModel pushed a delta per
+        minibatch."""
+        self.table.publish(np.asarray(self.weights).reshape(-1))
+
+    def get_weights(self) -> np.ndarray:
+        return np.asarray(self.weights)
+
+    def set_weights(self, w: np.ndarray) -> None:
+        self.weights = jnp.asarray(
+            np.asarray(w, dtype=np.float32).reshape(self.cfg.width,
+                                                    self.cfg.num_class))
+        self.sync()
+
+
+class ModelAverageModel(LocalModel):
+    """``comm_policy=model_average`` — the reference's "ma" mode for LR
+    (``-ma``, src/zoo.cpp:24): each worker trains a local replica with the
+    fully fused donated step; :meth:`sync` averages replicas across
+    processes over the collective plane
+    (:func:`~multiverso_tpu.parallel.comm_policy.model_average_arrays`)
+    and publishes the merged weights to the PS table. Convergence trades a
+    staleness window (the averaging period) for zero per-step
+    communication — loss-trajectory parity with PS, not bitwise parity."""
+
+    def __init__(self, cfg: LogRegConfig, table=None):
+        from multiverso_tpu.parallel import comm_policy as cp
+        super().__init__(cfg)
+        self.table = table if table is not None else mv.create_table(
+            ArrayTableOption(size=cfg.width * cfg.num_class, updater="sgd",
+                             name="logreg_weights",
+                             comm_policy="model_average"))
+        self._cp = cp
+
+    def sync(self) -> None:
+        merged = self._cp.model_average_arrays(
+            [np.asarray(self.weights)])[0]
+        self.weights = jnp.asarray(merged)
+        self.table.publish(merged.reshape(-1))
+
+
+def resolve_logreg_comm_policy(cfg: LogRegConfig) -> str:
+    """Per-table policy for the LR weight table (docs/DESIGN.md decision
+    table). Default ""/ps keeps the PSModel path without probing; "auto"
+    resolves on the weight shape (dense, usually small -> allreduce where
+    the probe agrees); FTRL is pinned to ps."""
+    from multiverso_tpu.core.zoo import Zoo
+    from multiverso_tpu.parallel import comm_policy as cp
+    from multiverso_tpu.utils.log import check
+
+    explicit = (cfg.comm_policy or "").strip().lower()
+    if cfg.objective == "ftrl":
+        check(explicit in ("", "ps", "auto"),
+              "ftrl keeps server-side {z, n} updater state — its "
+              f"comm_policy must stay ps (got '{explicit}')")
+        return cp.PS
+    if explicit in ("", cp.PS):
+        return cp.PS
+    zoo = Zoo._instance
+    mesh = zoo.mesh if zoo is not None and zoo.started else None
+    return cp.resolve_comm_policy(
+        (cfg.width, cfg.num_class), np.float32, sparse=False,
+        explicit=None if explicit == "auto" else explicit, mesh=mesh,
+        table="logreg_weights")
+
+
 def make_model(cfg: LogRegConfig):
-    return PSModel(cfg) if cfg.use_ps else LocalModel(cfg)
+    if not cfg.use_ps:
+        return LocalModel(cfg)
+    from multiverso_tpu.parallel import comm_policy as cp
+    policy = resolve_logreg_comm_policy(cfg)
+    if policy == cp.ALLREDUCE:
+        return AllreduceModel(cfg)
+    if policy == cp.MODEL_AVERAGE:
+        return ModelAverageModel(cfg)
+    return PSModel(cfg)

@@ -1,0 +1,223 @@
+"""Word2vec communication planes: shared touched-row machinery + the
+pure-PS (pull-train-push) client plane.
+
+The per-table CommPolicy split (docs/DESIGN.md "CommPolicy",
+``parallel/comm_policy.py``) gives word2vec three training planes:
+
+* ``ps`` — :class:`PSPlaneTrainer` here: the reference communicator loop
+  (``Applications/WordEmbedding/src/communicator.cpp:117-202``) run
+  in-process against the worker-table client API — per block, pull
+  exactly the touched rows (``get_rows``), train on the pulled
+  sub-matrices with the fused scan step, push the deltas back
+  (``add_rows``). Every byte crosses the client plane and is counted in
+  ``comm.ps.*``. This is the pure-PS comparison baseline of the
+  three-way bench (scripts/comm_bench.py).
+* ``hybrid`` (AUTO) — the sparse tables ride the fused in-store PS plane
+  (the server's own jitted gather/update/scatter, PR 2 lineage) while
+  small dense quantities merge through one in-graph collective per block
+  (``comm_policy.build_dense_sync``) — MXNET-MPI's collectives-embedded-
+  in-PS shape (PAPERS.md 1801.03855). Lives in ``model.py``.
+* ``model_average`` — replicas train fused and reconcile per epoch over
+  the collective plane (``comm_policy.model_average_arrays``); also in
+  ``model.py``.
+
+The touched-row collection/remapping helpers here are shared with
+:class:`~multiverso_tpu.models.word2vec.distributed.DistributedWord2Vec`
+(the cross-process deployment of the same ps plane), so the two paths
+cannot drift.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.telemetry import span
+from multiverso_tpu.utils.log import log
+
+_WORDCOUNT_KEY = 0
+
+
+def bucketed_unique(values: np.ndarray) -> np.ndarray:
+    """Unique ids padded to a power of two (repeat-last padding) so the
+    jitted scan step compiles once per bucket, not once per block."""
+    ids = np.unique(values)
+    bucket = 1 << int(np.ceil(np.log2(max(len(ids), 1))))
+    return np.concatenate(
+        [ids, np.full(bucket - len(ids), ids[-1], ids.dtype)])
+
+
+def hs_codes(huffman, max_code_length: int, words: np.ndarray,
+             mask: np.ndarray):
+    """Huffman (points, codes, length-mask) streams for a batch of target
+    word ids — the HS step's table-side inputs."""
+    points = huffman.points[words]
+    codes = huffman.codes[words]
+    lmask = ((np.arange(max_code_length)[None, :] <
+              huffman.lengths[words][:, None])
+             .astype(np.float32) * mask[:, None])
+    return points, codes, lmask
+
+
+def collect_and_remap(batches: Sequence, sg: bool, hs: bool, huffman,
+                      max_code_length: int
+                      ) -> Tuple[np.ndarray, np.ndarray, List[tuple]]:
+    """Per-variant touched-row sets for the input/output tables and the
+    remapped per-batch step args (ids_in, ids_out, group). Input and
+    output tables have separate id spaces (HS output rows are Huffman
+    inner nodes), so each gets its own set."""
+    if sg:
+        ids_in = bucketed_unique(
+            np.concatenate([b.centers for b in batches]))
+    else:
+        ids_in = bucketed_unique(
+            np.concatenate([b.contexts.reshape(-1) for b in batches]))
+    if hs:
+        targets = [b.contexts if sg else b.centers for b in batches]
+        points_all = np.concatenate(
+            [huffman.points[t].reshape(-1) for t in targets])
+        ids_out = bucketed_unique(points_all)
+    else:
+        if sg:
+            ids_out = bucketed_unique(np.concatenate(
+                [np.concatenate([b.contexts, b.negatives.reshape(-1)])
+                 for b in batches]))
+        else:
+            ids_out = bucketed_unique(np.concatenate(
+                [np.concatenate([b.centers, b.negatives.reshape(-1)])
+                 for b in batches]))
+
+    def rm_in(x):
+        return np.searchsorted(ids_in, x).astype(np.int32)
+
+    def rm_out(x):
+        return np.searchsorted(ids_out, x).astype(np.int32)
+
+    group = []
+    for b in batches:
+        if sg and not hs:
+            group.append((rm_in(b.centers), rm_out(b.contexts),
+                          rm_out(b.negatives), b.mask))
+        elif sg and hs:
+            points, codes, lmask = hs_codes(huffman, max_code_length,
+                                            b.contexts, b.mask)
+            group.append((rm_in(b.centers), rm_out(points), codes, lmask))
+        elif not sg and not hs:
+            group.append((rm_out(b.centers), rm_in(b.contexts),
+                          b.context_mask, rm_out(b.negatives), b.mask))
+        else:
+            points, codes, lmask = hs_codes(huffman, max_code_length,
+                                            b.centers, b.mask)
+            # centers are unused by the cbow-hs step (tables are indexed
+            # via contexts and points only)
+            group.append((b.centers, rm_in(b.contexts), b.context_mask,
+                          rm_out(points), codes, lmask))
+    return ids_in, ids_out, group
+
+
+def stack_group(group: List[tuple]) -> tuple:
+    """Pad a block's batch group to a power-of-two length with zero
+    (masked-out) batches and stack into the scan step's [N, ...] args —
+    one compiled executable per group bucket."""
+    n_groups = 1 << int(np.ceil(np.log2(max(len(group), 1))))
+    zero_batch = tuple(np.zeros_like(a) for a in group[0])
+    group = list(group) + [zero_batch] * (n_groups - len(group))
+    return tuple(np.stack([g[i] for g in group])
+                 for i in range(len(group[0])))
+
+
+class PSPlaneTrainer:
+    """``comm_policy=ps``: the reference's worker loop against the
+    in-process tables — every parameter byte crosses the client push/pull
+    plane (host round trips, counted in ``comm.ps.*``). Wall-clock is the
+    price of the plane: the hybrid mode exists because the fused in-store
+    dispatch beats these round trips for every table that fits on device
+    (BENCH_COMM.json carries the measured three-way)."""
+
+    def __init__(self, w2v):
+        self.w2v = w2v
+        self.cfg = w2v.cfg
+        self._adagrad = w2v._adagrad
+
+    def _train_block(self, block) -> Tuple[int, int, object]:
+        """Pull touched rows -> scan-train on the sub-matrices -> push
+        deltas. Returns (words, pairs, device loss)."""
+        w2v, cfg = self.w2v, self.cfg
+        batches = list(w2v.generator.batches(block))
+        words = sum(len(s) for s in block)
+        if not batches:
+            return words, 0, None
+        ids_in, ids_out, group = collect_and_remap(
+            batches, cfg.sg, cfg.hs, w2v.huffman, cfg.max_code_length)
+        pairs = sum(b.n_words for b in batches)
+
+        # Pull exactly the touched rows through the client plane
+        # (RequestParameter, communicator.cpp:117-155).
+        local_in = w2v.input_table.get_rows(ids_in)
+        local_out = w2v.output_table.get_rows(ids_out)
+        old_in, old_out = local_in.copy(), local_out.copy()
+        if self._adagrad:
+            local_gin = w2v.adagrad_in.get_rows(ids_in)
+            local_gout = w2v.adagrad_out.get_rows(ids_out)
+            old_gin, old_gout = local_gin.copy(), local_gout.copy()
+        else:
+            local_gin = np.zeros_like(local_in)
+            local_gout = np.zeros_like(local_out)
+
+        stacked = stack_group(group)
+        lr = np.float32(w2v._current_lr() * w2v._push_scale)
+        new_in, new_out, new_gin, new_gout, loss = w2v._scan_step(
+            jnp.asarray(local_in), jnp.asarray(local_out),
+            jnp.asarray(local_gin), jnp.asarray(local_gout), *stacked, lr)
+
+        # Push the deltas back (AddDeltaParameter, communicator.cpp:
+        # 157-202). The push-scale convention is the FUSED path's (lr is
+        # already scaled by _push_scale above), so the deltas ship raw —
+        # scaling here too would square the factor (the distributed path
+        # scales the delta INSTEAD of the lr; pick exactly one).
+        w2v.input_table.add_rows(ids_in, np.asarray(new_in) - old_in)
+        w2v.output_table.add_rows(ids_out, np.asarray(new_out) - old_out)
+        if self._adagrad:
+            w2v.adagrad_in.add_rows(ids_in,
+                                    np.asarray(new_gin) - old_gin)
+            w2v.adagrad_out.add_rows(ids_out,
+                                     np.asarray(new_gout) - old_gout)
+        return words, pairs, loss
+
+    def train(self, sentences, corpus_path, epochs) -> dict:
+        from multiverso_tpu.models.word2vec.data import (BlockStream,
+                                                         read_corpus)
+
+        w2v, cfg = self.w2v, self.cfg
+        t0 = time.perf_counter()
+        losses: List = []
+        total_pairs = 0
+        for _ in range(epochs):
+            if corpus_path is not None:
+                sents = (w2v.dict.encode(s)
+                         for s in read_corpus(corpus_path))
+            else:
+                sents = iter(sentences)
+            for block in BlockStream(sents, cfg.block_words,
+                                     prefetch=cfg.pipeline):
+                with span("w2v.ps_block"):
+                    words, pairs, loss = self._train_block(block)
+                if loss is not None:
+                    losses.append(loss)
+                total_pairs += pairs
+                w2v.trained_words += words
+                if words:
+                    w2v.wordcount_table.add([_WORDCOUNT_KEY], [words])
+        elapsed = time.perf_counter() - t0
+        w2v.words_per_sec = w2v.trained_words / max(elapsed, 1e-9)
+        mean_loss = (float(np.mean([float(l) for l in losses[-50:]]))
+                     if losses else 0.0)
+        log.info("word2vec[ps plane]: %d words, %d pairs, %.0f words/sec,"
+                 " loss=%.4f", w2v.trained_words, total_pairs,
+                 w2v.words_per_sec, mean_loss)
+        return {"words": w2v.trained_words, "pairs": total_pairs,
+                "words_per_sec": w2v.words_per_sec, "loss": mean_loss,
+                "seconds": elapsed, "comm_mode": "ps"}

@@ -161,73 +161,21 @@ class DistributedWord2Vec:
             self._wc_pending = self.word_count.get_async([0])
 
     # -- one data block -------------------------------------------------------
+    # Touched-row collection/remap lives in commplane.py, SHARED with the
+    # in-process ps-plane trainer (comm_policy="ps") so the two
+    # deployments of the pull-train-push protocol cannot drift.
     @staticmethod
     def _bucketed_unique(values: np.ndarray) -> np.ndarray:
-        """Unique ids padded to a power of two (repeat-last padding) so the
-        jitted scan step compiles once per bucket, not once per block."""
-        ids = np.unique(values)
-        bucket = 1 << int(np.ceil(np.log2(max(len(ids), 1))))
-        return np.concatenate(
-            [ids, np.full(bucket - len(ids), ids[-1], ids.dtype)])
-
-    def _hs_codes(self, words: np.ndarray, mask: np.ndarray):
-        points = self.huffman.points[words]
-        codes = self.huffman.codes[words]
-        lmask = ((np.arange(self.cfg.max_code_length)[None, :] <
-                  self.huffman.lengths[words][:, None])
-                 .astype(np.float32) * mask[:, None])
-        return points, codes, lmask
+        from multiverso_tpu.models.word2vec.commplane import bucketed_unique
+        return bucketed_unique(values)
 
     def _collect_and_remap(self, batches):
         """Per-variant touched-row sets for w_in / w_out and the remapped
         per-batch step args."""
-        sg, hs = self.cfg.sg, self.cfg.hs
-        if sg:
-            ids_in = self._bucketed_unique(
-                np.concatenate([b.centers for b in batches]))
-        else:
-            ids_in = self._bucketed_unique(
-                np.concatenate([b.contexts.reshape(-1) for b in batches]))
-        if hs:
-            targets = [b.contexts if sg else b.centers for b in batches]
-            points_all = np.concatenate(
-                [self.huffman.points[t].reshape(-1) for t in targets])
-            ids_out = self._bucketed_unique(points_all)
-        else:
-            if sg:
-                ids_out = self._bucketed_unique(np.concatenate(
-                    [np.concatenate([b.contexts, b.negatives.reshape(-1)])
-                     for b in batches]))
-            else:
-                ids_out = self._bucketed_unique(np.concatenate(
-                    [np.concatenate([b.centers, b.negatives.reshape(-1)])
-                     for b in batches]))
-
-        def rm_in(x):
-            return np.searchsorted(ids_in, x).astype(np.int32)
-
-        def rm_out(x):
-            return np.searchsorted(ids_out, x).astype(np.int32)
-
-        group = []
-        for b in batches:
-            if sg and not hs:
-                group.append((rm_in(b.centers), rm_out(b.contexts),
-                              rm_out(b.negatives), b.mask))
-            elif sg and hs:
-                points, codes, lmask = self._hs_codes(b.contexts, b.mask)
-                group.append((rm_in(b.centers), rm_out(points), codes,
-                              lmask))
-            elif not sg and not hs:
-                group.append((rm_out(b.centers), rm_in(b.contexts),
-                              b.context_mask, rm_out(b.negatives), b.mask))
-            else:
-                points, codes, lmask = self._hs_codes(b.centers, b.mask)
-                # centers are unused by the cbow-hs step (tables are indexed
-                # via contexts and points only)
-                group.append((b.centers, rm_in(b.contexts), b.context_mask,
-                              rm_out(points), codes, lmask))
-        return ids_in, ids_out, group
+        from multiverso_tpu.models.word2vec.commplane import \
+            collect_and_remap
+        return collect_and_remap(batches, self.cfg.sg, self.cfg.hs,
+                                 self.huffman, self.cfg.max_code_length)
 
     def _prepare_block(self, block: List[Sequence[int]]):
         """Host-side stage: pair generation + touched-row collection."""
@@ -284,11 +232,8 @@ class DistributedWord2Vec:
             local_gin = jnp.zeros_like(local_in)
             local_gout = jnp.zeros_like(local_out)
 
-        n_groups = 1 << int(np.ceil(np.log2(len(group))))
-        zero_batch = tuple(np.zeros_like(a) for a in group[0])
-        group = group + [zero_batch] * (n_groups - len(group))
-        stacked = tuple(np.stack([g[i] for g in group])
-                        for i in range(len(group[0])))
+        from multiverso_tpu.models.word2vec.commplane import stack_group
+        stacked = stack_group(group)
         lr = np.float32(self._current_lr())
         new_in, new_out, new_gin, new_gout, _ = self._scan_step(
             jnp.asarray(local_in), jnp.asarray(local_out),

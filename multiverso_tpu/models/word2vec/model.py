@@ -110,6 +110,23 @@ class Word2VecConfig:
     max_code_length: int = 40
     seed: int = 0
     delta_scale: Optional[float] = None   # 1/num_workers push scaling
+    # Per-table communication policy (parallel/comm_policy.py;
+    # docs/DESIGN.md "CommPolicy"):
+    #   None        — legacy fused plane, no resolution (zero overhead);
+    #   "auto"/"hybrid" — per-table decision table: the sparse embedding/
+    #                 accumulator tables stay on the (fused) PS plane,
+    #                 small dense tables (the word-count) merge through
+    #                 one in-graph collective per block;
+    #   "ps"        — force EVERY table through the client push/pull
+    #                 plane (pull-train-push per block — the reference's
+    #                 communicator loop; the pure-PS bench baseline);
+    #   "model_average" — replicas train fused, reconciled per epoch via
+    #                 the collective plane (the reference's "ma" mode).
+    comm_policy: Optional[str] = None
+    # Per-table override map {table name -> policy}, e.g.
+    # {"w2v_wordcount": "ps"} pins the word-count table back onto the
+    # kv plane under an otherwise-auto resolution.
+    comm_policy_overrides: Optional[dict] = None
 
 
 def _row_gather_negatives(neg_table, key, shape):
@@ -649,6 +666,67 @@ def resolve_dispatch_mode(cfg: "Word2VecConfig", in_rows: int,
     return mode
 
 
+W2V_COMM_MODES = ("fused", "hybrid", "ps", "model_average")
+
+
+def resolve_w2v_comm(cfg: "Word2VecConfig", V: int, D: int,
+                     out_rows: int, mesh=None):
+    """Per-table CommPolicy resolution for the five word2vec tables
+    (docs/DESIGN.md decision table). Returns ``(mode, policies)`` where
+    ``mode`` is the training-loop plane and ``policies`` maps table name
+    -> policy string (passed into the table options, so each table's
+    ``comm_policy`` attribute reflects the decision).
+
+    * ``None`` -> ("fused", {}): today's fused in-store plane untouched,
+      no probe, no resolution cost.
+    * ``auto``/``hybrid`` -> per-table: the four embedding/accumulator
+      tables are sparse row-granular -> ps (served by the fused in-store
+      dispatch); the word-count table is small dense -> whatever the
+      measured probe picks (allreduce on every box we measured). Explicit
+      ``comm_policy_overrides`` entries win per table.
+    * ``ps`` / ``model_average`` -> every table pinned to that plane.
+    * ``allreduce`` is rejected with the reason: word2vec's tables are
+      sparse row-granular — densifying a [V, D] gradient per step is the
+      exact case the decision table exists to prevent. Use auto/hybrid
+      (dense quantities go allreduce, embeddings stay ps).
+    """
+    from multiverso_tpu.parallel import comm_policy as cp
+
+    mode = (cfg.comm_policy or "").strip().lower()
+    check(mode in ("", "auto", "hybrid", "ps", "model_average"),
+          "word2vec comm_policy must be auto|hybrid|ps|model_average; "
+          f"got {cfg.comm_policy!r}"
+          + (" (allreduce applies per-TABLE to small dense tables — "
+             "word2vec's embedding tables are sparse; use auto/hybrid)"
+             if mode == "allreduce" else ""))
+    if not mode:
+        return "fused", {}
+    overrides = dict(cfg.comm_policy_overrides or {})
+    names_sparse = ["w2v_input", "w2v_output", "w2v_adagrad_in",
+                    "w2v_adagrad_out"]
+    shapes = {"w2v_input": (V, D), "w2v_output": (out_rows, D),
+              "w2v_adagrad_in": (V, D), "w2v_adagrad_out": (out_rows, D),
+              "w2v_wordcount": (1,)}
+    policies = {}
+    if mode in ("ps", "model_average"):
+        want = cp.PS if mode == "ps" else cp.MODEL_AVERAGE
+        for name in names_sparse + ["w2v_wordcount"]:
+            policies[name] = cp.resolve_comm_policy(
+                shapes[name], np.float32, sparse=name in names_sparse,
+                explicit=overrides.get(name, want), mesh=mesh, table=name)
+        return mode, policies
+    # auto/hybrid: the decision table proper.
+    for name in names_sparse:
+        policies[name] = cp.resolve_comm_policy(
+            shapes[name], np.dtype(cfg.param_dtype), sparse=True,
+            explicit=overrides.get(name), mesh=mesh, table=name)
+    policies["w2v_wordcount"] = cp.resolve_comm_policy(
+        (1,), np.int64, sparse=False,
+        explicit=overrides.get("w2v_wordcount"), mesh=mesh,
+        table="w2v_wordcount")
+    return "hybrid", policies
+
+
 class _DispatchQueue:
     """Depth-N in-flight dispatch window for pipelined_host.
 
@@ -808,20 +886,39 @@ class Word2Vec:
         # output embed, two adagrad accumulators, word-count KV. Embeddings
         # may store bf16 (param_dtype); accumulators stay f32.
         pdtype = np.dtype(cfg.param_dtype)
+        out_rows = (V - 1) if cfg.hs else V   # inner nodes for HS
+        # Per-table CommPolicy resolution BEFORE creation, so each table
+        # carries its resolved policy attribute (docs/DESIGN.md).
+        from multiverso_tpu.core.zoo import Zoo as _Zoo
+        self.comm_mode, comm = resolve_w2v_comm(
+            cfg, V, D, max(out_rows, 1), mesh=_Zoo.get().mesh)
+        self.comm_policies = comm
         self.input_table = mv.create_table(MatrixTableOption(
             V, D, dtype=pdtype, random_init=True, init_low=-0.5 / D,
             init_high=0.5 / D, seed=cfg.seed, name="w2v_input",
-            updater="default"))
-        out_rows = (V - 1) if cfg.hs else V   # inner nodes for HS
+            updater="default", comm_policy=comm.get("w2v_input")))
         self.output_table = mv.create_table(MatrixTableOption(
             max(out_rows, 1), D, dtype=pdtype, name="w2v_output",
-            updater="default"))
+            updater="default", comm_policy=comm.get("w2v_output")))
         self.adagrad_in = mv.create_table(MatrixTableOption(
-            V, D, name="w2v_adagrad_in", updater="default"))
+            V, D, name="w2v_adagrad_in", updater="default",
+            comm_policy=comm.get("w2v_adagrad_in")))
         self.adagrad_out = mv.create_table(MatrixTableOption(
-            max(out_rows, 1), D, name="w2v_adagrad_out", updater="default"))
+            max(out_rows, 1), D, name="w2v_adagrad_out",
+            updater="default", comm_policy=comm.get("w2v_adagrad_out")))
         self.wordcount_table = mv.create_table(
-            KVTableOption(value_dtype=np.int64, name="w2v_wordcount"))
+            KVTableOption(value_dtype=np.int64, name="w2v_wordcount",
+                          comm_policy=comm.get("w2v_wordcount")))
+        # Hybrid mode: one in-graph collective per block merges the dense
+        # quantities (word counts — the lr schedule's cross-worker sync)
+        # while the sparse tables stay on the fused PS plane. Built once;
+        # dispatched per block; never host-synced inside the loop.
+        self._dense_sync = None
+        self._comm_synced = None
+        if (self.comm_mode == "hybrid" and
+                comm.get("w2v_wordcount") == "allreduce"):
+            from multiverso_tpu.parallel import comm_policy as _cp
+            self._dense_sync = _cp.build_dense_sync(_Zoo.get().mesh)
 
         self.huffman = (HuffmanEncoder(dictionary.counts,
                                        cfg.max_code_length)
@@ -913,6 +1010,49 @@ class Word2Vec:
             scale = 1.0
         self._push_scale = scale
 
+    # -- comm-policy hooks (docs/DESIGN.md "CommPolicy") -------------------
+    def _hybrid_sync(self, words: int) -> None:
+        """Hybrid mode's dense-plane merge: one in-graph collective per
+        block carries the block's word count, accumulated DEVICE-SIDE
+        into ``_comm_synced`` — the global trained-word count the lr
+        schedule needs agreed across workers, read back exactly once per
+        train() (``stats["synced_words"]``; a per-block read would
+        re-serialize the loop on a host sync, the exact tax the plane
+        exists to avoid). In a one-process world the psum (over
+        identical replicated contributions, normalized) is an
+        identity-preserving merge; data-parallel hybrids feed real
+        per-worker partials through the same function."""
+        if self._dense_sync is None:
+            return
+        from multiverso_tpu.parallel import comm_policy as cp
+        synced = self._dense_sync(np.asarray([words], np.float32))
+        self._comm_synced = (synced if self._comm_synced is None
+                             else self._comm_synced + synced)
+        cp.record(cp.ALLREDUCE, 4)
+
+    def _synced_words(self) -> Optional[float]:
+        """One end-of-train host read of the device-side merged word
+        count (None outside hybrid mode)."""
+        if self._comm_synced is None:
+            return None
+        return float(np.asarray(self._comm_synced)[0])
+
+    def _model_average_epoch(self) -> None:
+        """The reference "ma" epoch merge: average every table replica
+        across processes over the collective plane and publish the
+        result back through the PS surface (identity in one process —
+        bitwise — so fused and model_average runs agree exactly there;
+        multi-process runs trade one epoch of staleness for zero
+        per-block pushes)."""
+        from multiverso_tpu.parallel import comm_policy as cp
+        tables = [self.input_table, self.output_table]
+        if self._adagrad:
+            tables += [self.adagrad_in, self.adagrad_out]
+        merged = cp.model_average_arrays(
+            [np.asarray(t.store.read()) for t in tables])
+        for t, m in zip(tables, merged):
+            t.store.write_dense(m)
+
     # -- lr schedule (ref distributed_wordembedding.cpp:92-134) ------------
     def _current_lr(self) -> float:
         if self._adagrad:
@@ -998,6 +1138,14 @@ class Word2Vec:
         epochs = epochs if epochs is not None else self.cfg.epochs
         check(sentences is not None or corpus_path is not None,
               "need sentences or corpus_path")
+        if self.comm_mode == "ps":
+            # Pure client plane: pull-train-push per block through the
+            # table API (commplane.PSPlaneTrainer) — the comparison
+            # baseline the hybrid mode exists to beat.
+            from multiverso_tpu.models.word2vec.commplane import \
+                PSPlaneTrainer
+            return PSPlaneTrainer(self).train(sentences, corpus_path,
+                                              epochs)
         if self.cfg.device_pipeline:
             return self._train_device(sentences, corpus_path, epochs)
         t0 = time.perf_counter()
@@ -1033,9 +1181,12 @@ class Word2Vec:
                         # word-count table drives the lr schedule across
                         # workers (ref distributed_wordembedding.cpp:92-134)
                         self.wordcount_table.add([_WORDCOUNT_KEY], [words])
+                        self._hybrid_sync(words)
             finally:
                 if buf is not None:
                     buf.close()
+            if self.comm_mode == "model_average":
+                self._model_average_epoch()
         jax.block_until_ready(self.input_table.store.data)
         elapsed = time.perf_counter() - t0
         self.words_per_sec = self.trained_words / max(elapsed, 1e-9)
@@ -1046,7 +1197,8 @@ class Word2Vec:
                  mean_loss)
         return {"words": self.trained_words, "pairs": total_pairs,
                 "words_per_sec": self.words_per_sec, "loss": mean_loss,
-                "seconds": elapsed}
+                "seconds": elapsed, "comm_mode": self.comm_mode,
+                "synced_words": self._synced_words()}
 
     # -- device-pipeline training loop -------------------------------------
     def _sentence_blocks(self, sentences):
@@ -1189,10 +1341,13 @@ class Word2Vec:
                             pair_counts.append(pairs)
                     self.trained_words += words
                     self.wordcount_table.add([_WORDCOUNT_KEY], [words])
+                    self._hybrid_sync(words)
             finally:
                 inflight.drain()
                 if buf is not None:
                     buf.close()
+            if self.comm_mode == "model_average":
+                self._model_average_epoch()
         jax.block_until_ready(st_in.data)
         elapsed = time.perf_counter() - t0
         self.words_per_sec = self.trained_words / max(elapsed, 1e-9)
@@ -1204,7 +1359,8 @@ class Word2Vec:
                  self.words_per_sec, mean_loss)
         return {"words": self.trained_words, "pairs": total_pairs,
                 "words_per_sec": self.words_per_sec, "loss": mean_loss,
-                "seconds": elapsed}
+                "seconds": elapsed, "comm_mode": self.comm_mode,
+                "synced_words": self._synced_words()}
 
     # -- embeddings out ----------------------------------------------------
     def embeddings(self) -> np.ndarray:

@@ -111,6 +111,7 @@ class ContinuousBatcher(DynamicBatcher):
         self._g_active = gauge("serve.continuous.active")
         self._c_joins = counter("serve.continuous.joins")
         self._c_steps = counter("serve.continuous.steps")
+        self._c_batched_reads = counter("serve.continuous.batched_reads")
         self._prefill = jax.jit(self._prefill_fn,
                                 donate_argnums=(4, 5, 6, 7))
         self._step = jax.jit(self._step_fn, donate_argnums=(3, 4, 5, 6))
@@ -402,18 +403,42 @@ class ContinuousBatcher(DynamicBatcher):
         self._g_inflight.set(self._total_active())
 
     def _deliver_finished(self) -> None:
-        """A slot with all ``max_new`` tokens emitted delivers (the one
-        host sync per request) and frees at this step boundary."""
+        """Slots with all ``max_new`` tokens emitted deliver and free at
+        this step boundary. Completions that land at the SAME boundary —
+        the common case when ``max_new`` is small and requests joined
+        together — are read back as ONE device sync (a single gathered
+        [k, max_new] transfer) instead of one sync per request; the
+        per-slot fallback path contains a failed batched read without
+        losing the error-per-slot semantics."""
+        import jax.numpy as jnp
+
         now = time.monotonic()
         for eng in self._engines.values():
-            for i, r in enumerate(eng.reqs):
-                if r is None or eng.t[i] < self.max_new - 1:
-                    continue
+            done = [i for i, r in enumerate(eng.reqs)
+                    if r is not None and eng.t[i] >= self.max_new - 1]
+            if not done:
+                continue
+            rows = {}
+            if len(done) > 1:
                 try:
-                    row = np.asarray(eng.out[i])
-                except Exception as e:  # noqa: BLE001 - contain to slot
-                    log.error("continuous decode: readback failed: %s", e)
-                    row = ShedError("closed", f"runner error: {e}")
+                    block = np.asarray(jnp.take(
+                        eng.out, jnp.asarray(np.asarray(done, np.int32)),
+                        axis=0))
+                    rows = {i: block[k] for k, i in enumerate(done)}
+                    self._c_batched_reads.inc()
+                except Exception as e:  # noqa: BLE001 - fall back per-slot
+                    log.error("continuous decode: batched readback "
+                              "failed: %s", e)
+            for i in done:
+                r = eng.reqs[i]
+                row = rows.get(i)
+                if row is None:
+                    try:
+                        row = np.asarray(eng.out[i])
+                    except Exception as e:  # noqa: BLE001 - contain
+                        log.error("continuous decode: readback failed: "
+                                  "%s", e)
+                        row = ShedError("closed", f"runner error: {e}")
                 eng.reqs[i] = None
                 eng.lengths[i] = 1
                 eng.t[i] = 0

@@ -15,6 +15,7 @@ reference's offset arithmetic for the async host engine and parity tests.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import jax
@@ -24,6 +25,7 @@ from multiverso_tpu.core.options import AddOption, ArrayTableOption, GetOption
 from multiverso_tpu.core.table import ServerStore, WorkerTable
 from multiverso_tpu.core.updater import get_updater
 from multiverso_tpu.core.zoo import Zoo
+from multiverso_tpu.parallel import comm_policy as cp
 from multiverso_tpu.parallel.mesh import reference_server_offsets
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check
@@ -41,11 +43,21 @@ class ArrayTable(WorkerTable):
         self.size = option.size
         self.server_offsets = reference_server_offsets(option.size,
                                                        store.num_servers)
+        # Per-table communication policy (docs/DESIGN.md "CommPolicy"):
+        # 1-D dense tables are allreduce candidates — "auto" runs the
+        # decision table (one cached probe); None keeps ps for free.
+        self.comm = cp.policy_for_option(option.comm_policy,
+                                         (self.size,), self.store.dtype,
+                                         mesh=zoo.mesh, table=name)
+        self.comm_policy = self.comm.policy
 
     # -- get (ref array_table.cpp:29-46) -----------------------------------
     def get_async(self, option: Optional[GetOption] = None) -> int:
+        t0 = time.perf_counter()
         with self._bsp_get(option):
             arr = self.store.read()
+        self.comm.record_client_op(self.size * self.store.dtype.itemsize,
+                                   (time.perf_counter() - t0) * 1e3)
         return self._register(lambda: np.asarray(arr))
 
     def get(self, option: Optional[GetOption] = None) -> np.ndarray:
@@ -61,13 +73,27 @@ class ArrayTable(WorkerTable):
         delta = np.asarray(delta, dtype=self.store.dtype)
         check(delta.shape == (self.size,),
               f"delta shape {delta.shape} != ({self.size},)")
+        t0 = time.perf_counter()
         with self._bsp_add(option):
             self.store.apply_dense(delta, option or AddOption())
+        self.comm.record_client_op(delta.nbytes,
+                                   (time.perf_counter() - t0) * 1e3)
         return self._register_add()
 
     def add(self, delta, option: Optional[AddOption] = None) -> None:
         with monitor("WORKER_TABLE_SYNC_ADD"):
             self.wait(self.add_async(delta, option))
+
+    # -- comm-policy publish (docs/DESIGN.md "CommPolicy") -----------------
+    def publish(self, values) -> None:
+        """Whole-replica publish at a sync point (allreduce/model-average
+        reconciliation with the PS surface — one dense write instead of
+        per-step delta pushes). Counted under the table's own plane."""
+        values = np.asarray(values, dtype=self.store.dtype).reshape(-1)
+        t0 = time.perf_counter()
+        self.store.write_dense(values)
+        self.comm.record_publish(values.nbytes,
+                                 (time.perf_counter() - t0) * 1e3)
 
     # -- parity helper (ref array_table.cpp:69-86) -------------------------
     def partition(self, values: np.ndarray) -> Dict[int, np.ndarray]:

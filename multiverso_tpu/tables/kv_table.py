@@ -37,6 +37,15 @@ class KVTable:
         self._cache: Dict[int, float] = {}
         self._lock = threading.Lock()
         self.table_id = zoo.register_table(self)
+        # Per-table communication policy (docs/DESIGN.md "CommPolicy").
+        # KV tables hold small dense host metadata — an "auto" option
+        # resolves via the decision table (word2vec's word-count table is
+        # the canonical small-dense -> allreduce case); None keeps ps.
+        from multiverso_tpu.parallel import comm_policy as cp
+        self.comm = cp.policy_for_option(option.comm_policy, (1,),
+                                         self.value_dtype, mesh=zoo.mesh,
+                                         table=self.name)
+        self.comm_policy = self.comm.policy
 
     # -- worker cache (ref kv_table.h:30-40) -------------------------------
     def raw(self) -> Dict[int, float]:
@@ -53,6 +62,7 @@ class KVTable:
                 val = self._server_maps[sid].get(k, self.value_dtype.type(0))
                 self._cache[k] = val
                 out[i] = val
+        self.comm.record_client_op(keys.nbytes + out.nbytes)
         return out
 
     def add(self, keys, values) -> None:
@@ -65,6 +75,7 @@ class KVTable:
                 sid = self._route(k)
                 store = self._server_maps[sid]
                 store[k] = store.get(k, 0) + v
+        self.comm.record_client_op(keys.nbytes + values.nbytes)
 
     def _route(self, key: int) -> int:
         return int(key) % self.num_servers  # ref kv_table.h:48-50

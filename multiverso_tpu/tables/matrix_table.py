@@ -16,6 +16,7 @@ survives as a ``partition`` parity helper for the host async engine.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -25,6 +26,7 @@ from multiverso_tpu.core.options import AddOption, GetOption, MatrixTableOption
 from multiverso_tpu.core.table import ServerStore, WorkerTable
 from multiverso_tpu.core.updater import get_updater
 from multiverso_tpu.core.zoo import Zoo
+from multiverso_tpu.parallel import comm_policy as cp
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check
 
@@ -53,6 +55,18 @@ class MatrixTable(WorkerTable):
         # by clamping to 1 (matrix_table.cpp:347-369).
         self.num_servers = store.num_servers
         self.num_row_each = max(1, self.num_row // self.num_servers)
+        # Per-table communication policy (docs/DESIGN.md "CommPolicy"):
+        # None resolves to ps without probing; "auto" runs the decision
+        # table (embedding-shaped row counts read as sparse access);
+        # concrete values are pre-resolved. Client row ops record
+        # comm.ps.* regardless — they ARE the ps plane.
+        self.comm = cp.policy_for_option(
+            option.comm_policy, (self.num_row, self.num_col),
+            self.store.dtype,
+            sparse=(option.is_sparse
+                    or self.num_row >= cp.SPARSE_ROWS_MIN),
+            mesh=zoo.mesh, table=name)
+        self.comm_policy = self.comm.policy
 
     # -- whole-table ops (sentinel key -1 in the reference) ----------------
     def get_async(self, option: Optional[GetOption] = None) -> int:
@@ -83,8 +97,12 @@ class MatrixTable(WorkerTable):
     def get_rows_async(self, row_ids,
                        option: Optional[GetOption] = None) -> int:
         row_ids = np.asarray(row_ids, dtype=np.int32)
+        t0 = time.perf_counter()
         with self._bsp_get(option):
             arr = self.store.read_rows(row_ids)
+        self.comm.record_client_op(
+            len(row_ids) * self.num_col * self.store.dtype.itemsize,
+            (time.perf_counter() - t0) * 1e3)
         return self._register(lambda: np.asarray(arr))
 
     def get_rows(self, row_ids, option: Optional[GetOption] = None
@@ -102,8 +120,11 @@ class MatrixTable(WorkerTable):
         check(deltas.shape == (len(row_ids), self.num_col),
               f"row delta shape {deltas.shape} != "
               f"{(len(row_ids), self.num_col)}")
+        t0 = time.perf_counter()
         with self._bsp_add(option):
             self.store.apply_rows(row_ids, deltas, option or AddOption())
+        self.comm.record_client_op(deltas.nbytes,
+                                   (time.perf_counter() - t0) * 1e3)
         return self._register_add()
 
     def add_rows(self, row_ids, deltas,
@@ -114,6 +135,18 @@ class MatrixTable(WorkerTable):
     def add_row(self, row_id: int, delta,
                 option: Optional[AddOption] = None) -> None:
         self.add_rows([row_id], np.asarray(delta)[None, :], option)
+
+    # -- comm-policy publish (docs/DESIGN.md "CommPolicy") -----------------
+    def publish(self, values) -> None:
+        """Whole-replica publish: overwrite the stored params with a
+        worker replica at a sync point — how allreduce/model-average
+        tables reconcile with the PS surface (one dense write instead of
+        per-step delta pushes). Counted under the table's own plane."""
+        values = np.asarray(values, dtype=self.store.dtype)
+        t0 = time.perf_counter()
+        self.store.write_dense(values)
+        self.comm.record_publish(values.nbytes,
+                                 (time.perf_counter() - t0) * 1e3)
 
     # -- serving hook (multiverso_tpu/serving; docs/SERVING.md) ------------
     def serving_runner(self, cache=None):

@@ -278,6 +278,16 @@ define_bool("fleet_proxy", True, "router also proxies plain Serve_Request "
             "traffic (clients that don't speak the routing protocol)")
 define_double("fleet_drain_timeout_s", 30.0, "drain barrier: max wait for "
               "in-flight batches before the checkpoint swap proceeds")
+# Per-table communication policy (parallel/comm_policy.py;
+# docs/DESIGN.md "CommPolicy").
+define_string("comm_policy", "", "per-table communication policy: '' = "
+              "model default (ps/fused, unchanged), auto = decision "
+              "table (sparse/HBM-scale -> ps, small dense -> measured "
+              "probe), or ps|allreduce|model_average|hybrid explicit "
+              "(models map the value onto their tables)")
+define_string("comm_policy_overrides", "", "comma 'table=policy' "
+              "per-table overrides under -comm_policy=auto, e.g. "
+              "'w2v_wordcount=ps'")
 # Telemetry export (multiverso_tpu/telemetry; docs/OBSERVABILITY.md).
 define_string("telemetry_dir", "", "write periodic metrics snapshots "
               "(metrics-<pid>-<seq>.json) and a Chrome trace "

@@ -14,3 +14,15 @@ def pad_all(rows, width):
     for r in rows:
         out.append(jnp.zeros((width,)))  # expect: host-jnp-in-loop
     return out
+
+
+def train_with_eager_allreduce(step, aggregate, table, blocks):
+    """Eager host-side allreduce inside the training loop: the merged
+    gradient is re-boxed onto the device EVERY block (the comm-policy
+    anti-idiom — build_dense_sync keeps the merge in-graph instead)."""
+    w = table.raw()
+    for block in blocks:
+        w, grad = step(w, block)
+        merged = aggregate(grad)                # host-level allreduce
+        w = w - jnp.float32(0.05) * merged  # expect: host-jnp-in-loop
+    return w

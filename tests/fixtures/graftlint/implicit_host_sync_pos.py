@@ -29,3 +29,14 @@ def loop_body(i, carry):
 
 def run(carry):
     return jax.lax.fori_loop(0, 4, loop_body, carry)
+
+
+def make_hybrid_step(aggregate):
+    """Eager host-side allreduce INSIDE the traced step body: the
+    np.asarray materializes the traced gradient on the host (TracerError
+    or a silent dispatch stall) — the merge belongs in-graph
+    (lax.psum / comm_policy.build_dense_sync)."""
+    def step(w, grads):
+        merged = aggregate(np.asarray(grads))  # expect: implicit-host-sync
+        return w - 0.05 * jnp.asarray(merged)
+    return jax.jit(step, donate_argnums=0)

@@ -1,0 +1,331 @@
+"""Per-table CommPolicy: AUTO decision table + policy parity (ISSUE 10).
+
+Covers the resolver's decision table (sparse -> ps, HBM-scale -> ps,
+explicit override wins, small dense -> the measured probe's pick), the
+routed table telemetry, and the policy-parity contracts: logreg
+``allreduce`` params BITWISE-identical to the PS path, ``model_average``
+loss-trajectory parity, and word2vec hybrid/model_average table bytes
+bitwise-identical to the fused plane (the policies change the
+communication, never the math).
+"""
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# resolver units
+# ---------------------------------------------------------------------------
+def test_resolve_sparse_and_hbm_scale_pick_ps(mv_env):
+    from multiverso_tpu.parallel import comm_policy as cp
+
+    assert cp.resolve_comm_policy((50_000, 128), np.float32,
+                                  sparse=True) == cp.PS
+    assert cp.resolve_comm_policy((1_000_000, 128), np.float32,
+                                  sparse=False, probe=False) == cp.PS
+
+
+def test_resolve_explicit_override_wins(mv_env):
+    from multiverso_tpu.parallel import comm_policy as cp
+    from multiverso_tpu.utils.log import FatalError
+
+    # Explicit wins even where the table would say otherwise.
+    assert cp.resolve_comm_policy((50_000, 128), np.float32, sparse=True,
+                                  explicit=cp.MODEL_AVERAGE) \
+        == cp.MODEL_AVERAGE
+    assert cp.resolve_comm_policy((8,), np.float32,
+                                  explicit=cp.PS) == cp.PS
+    with pytest.raises(FatalError):
+        cp.resolve_comm_policy((8,), np.float32, explicit="bogus")
+
+
+def test_resolve_small_dense_follows_probe_and_caches(mv_env):
+    """The probe path: AUTO's small-dense pick must equal the argmin of
+    its own measurement, and the measurement is one-shot (cached)."""
+    from multiverso_tpu.core.zoo import Zoo
+    from multiverso_tpu.parallel import comm_policy as cp
+
+    mesh = Zoo.get().mesh
+    lat = cp.measured_policy_latency_ms(256, mesh, world=1)
+    want = cp.PS if lat[cp.PS] < lat[cp.ALLREDUCE] else cp.ALLREDUCE
+    got = cp.resolve_comm_policy((64,), np.float32, sparse=False,
+                                 mesh=mesh, world=1, table="probe_case")
+    assert got == want
+    # One-shot: the second call returns the cached measurement.
+    assert cp.measured_policy_latency_ms(256, mesh, world=1) is lat
+
+
+def test_decision_evidence_records_reasons(mv_env):
+    from multiverso_tpu.parallel import comm_policy as cp
+
+    cp.reset_decisions()
+    cp.resolve_comm_policy((9, 9), np.float32, sparse=True, table="t_sp")
+    ev = cp.decision_evidence()
+    mine = [d for d in ev["decisions"] if d["table"] == "t_sp"]
+    assert mine and mine[0]["policy"] == cp.PS
+    assert "sparse" in mine[0]["reason"]
+
+
+def test_record_ticks_per_plane_counters(mv_env):
+    from multiverso_tpu.parallel import comm_policy as cp
+    from multiverso_tpu.telemetry import get_registry
+
+    cp.record(cp.ALLREDUCE, 1234, 0.5)
+    snap = get_registry().snapshot(buckets=False)
+    assert snap["counters"]["comm.allreduce.bytes"]["value"] >= 1234
+    assert snap["counters"]["comm.allreduce.ops"]["value"] >= 1
+    assert "comm.allreduce.latency_ms" in snap["histograms"]
+
+
+def test_dense_sync_preserves_value_on_mesh(mv_env):
+    """build_dense_sync over the 8-device test mesh: psum of a
+    replicated operand normalized by the (power-of-two) axis size is
+    value-preserving BITWISE — the hybrid step's merge is a barrier,
+    not a perturbation."""
+    from multiverso_tpu.core.zoo import Zoo
+    from multiverso_tpu.parallel import comm_policy as cp
+
+    sync = cp.build_dense_sync(Zoo.get().mesh)
+    x = np.asarray([3.0, 0.125, 17.5, 1e-3], np.float32)
+    out = np.asarray(sync(x))
+    assert np.array_equal(out, x)
+
+
+# ---------------------------------------------------------------------------
+# routed tables
+# ---------------------------------------------------------------------------
+def test_table_policy_attribute_and_publish(mv_env):
+    import multiverso_tpu as mv
+    from multiverso_tpu.parallel import comm_policy as cp
+    from multiverso_tpu.telemetry import get_registry
+
+    t = mv.create_table(mv.MatrixTableOption(32, 4, name="cpol_default"))
+    assert t.comm_policy == cp.PS       # None -> ps, no probe
+    t2 = mv.create_table(mv.MatrixTableOption(
+        32, 4, name="cpol_explicit", comm_policy="model_average"))
+    assert t2.comm_policy == cp.MODEL_AVERAGE
+    # Client row ops are the ps plane and count there; on a non-ps table
+    # they also tick the fallback counter.
+    t2.add_rows([0, 1], np.ones((2, 4), np.float32))
+    got = t2.get_rows([0, 1])
+    assert np.array_equal(got, np.ones((2, 4), np.float32))
+    snap = get_registry().snapshot(buckets=False)
+    assert snap["counters"]["comm.ps.bytes"]["value"] > 0
+    assert snap["counters"]["comm.policy.ps_fallback"]["value"] >= 2
+    # publish = whole-replica write, counted under the table's own plane.
+    vals = np.full((32, 4), 7.0, np.float32)
+    t2.publish(vals)
+    assert np.array_equal(t2.get(), vals)
+    snap = get_registry().snapshot(buckets=False)
+    assert snap["counters"]["comm.model_average.bytes"]["value"] \
+        >= vals.nbytes
+
+
+# ---------------------------------------------------------------------------
+# logreg policy parity
+# ---------------------------------------------------------------------------
+def _lr_data(F=24, B=16, N=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(N * B, F + 1)).astype(np.float32)
+    X[:, -1] = 1.0
+    w_true = rng.normal(size=(F + 1, 1)).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32).ravel()
+    return [(X[i * B:(i + 1) * B], y[i * B:(i + 1) * B])
+            for i in range(N)], F, B
+
+
+def test_logreg_allreduce_bitwise_equals_ps(mv_env):
+    """The headline parity contract: same batches, same epochs — the
+    allreduce-policy model's params are BITWISE identical to PSModel's
+    (the policy moves bytes differently; it must not move values)."""
+    from multiverso_tpu.models.logreg.logreg import LogReg
+    from multiverso_tpu.models.logreg.model import (AllreduceModel,
+                                                    LogRegConfig, PSModel,
+                                                    make_model)
+
+    batches, F, B = _lr_data()
+    cfg = LogRegConfig(objective="sigmoid", num_feature=F,
+                       learning_rate=0.1, minibatch_size=B, epochs=3)
+    m_ps = PSModel(cfg)
+    LogReg(cfg, model=m_ps).train(batches)
+    cfg_ar = LogRegConfig(objective="sigmoid", num_feature=F,
+                          learning_rate=0.1, minibatch_size=B, epochs=3,
+                          comm_policy="allreduce")
+    m_ar = make_model(cfg_ar)
+    assert isinstance(m_ar, AllreduceModel)
+    LogReg(cfg_ar, model=m_ar).train(batches)
+    assert np.array_equal(m_ps.get_weights(), m_ar.get_weights())
+    # The table surface reconciles at sync: published replica == weights.
+    assert np.array_equal(
+        m_ar.table.get().reshape(m_ar.get_weights().shape),
+        m_ar.get_weights())
+
+
+def test_logreg_allreduce_dp_psum_matches_single(mv_env):
+    """The in-graph psum path proper: the step shard_mapped over the
+    8-device test mesh (per-shard grads psum-merged in-graph) tracks the
+    single-contributor step to float tolerance."""
+    from multiverso_tpu.core.zoo import Zoo
+    from multiverso_tpu.models.logreg.model import (AllreduceModel,
+                                                    LogRegConfig)
+
+    batches, F, B = _lr_data(B=16)      # 16 % 8 == 0 shards evenly
+    cfg = LogRegConfig(objective="sigmoid", num_feature=F,
+                       learning_rate=0.1, minibatch_size=B,
+                       comm_policy="allreduce")
+    m_dp = AllreduceModel(cfg, dp_mesh=Zoo.get().mesh, dp_axis="server")
+    m_s = AllreduceModel(cfg)
+    for Xb, yb in batches:
+        l_dp = float(m_dp.update(Xb, yb))
+        l_s = float(m_s.update(Xb, yb))
+        assert l_dp == pytest.approx(l_s, rel=1e-5)
+    assert np.allclose(m_dp.get_weights(), m_s.get_weights(), atol=1e-6)
+
+
+def test_logreg_model_average_loss_trajectory_parity(mv_env):
+    """model_average changes merge cadence, not per-step math: in a
+    one-process world its loss trajectory tracks the PS path to float
+    tolerance (not bitwise — the fused local step rounds differently)."""
+    from multiverso_tpu.models.logreg.logreg import LogReg
+    from multiverso_tpu.models.logreg.model import (LogRegConfig,
+                                                    ModelAverageModel,
+                                                    PSModel, make_model)
+
+    batches, F, B = _lr_data()
+    cfg = LogRegConfig(objective="sigmoid", num_feature=F,
+                       learning_rate=0.1, minibatch_size=B, epochs=3)
+    losses_ps = LogReg(cfg, model=PSModel(cfg)).train(batches)
+    cfg_ma = LogRegConfig(objective="sigmoid", num_feature=F,
+                          learning_rate=0.1, minibatch_size=B, epochs=3,
+                          comm_policy="model_average")
+    m_ma = make_model(cfg_ma)
+    assert isinstance(m_ma, ModelAverageModel)
+    losses_ma = LogReg(cfg_ma, model=m_ma).train(batches)
+    assert np.allclose(losses_ps, losses_ma, rtol=1e-4)
+
+
+def test_logreg_ftrl_pins_ps(mv_env):
+    from multiverso_tpu.models.logreg.model import (LogRegConfig,
+                                                    resolve_logreg_comm_policy)
+    from multiverso_tpu.utils.log import FatalError
+
+    cfg = LogRegConfig(objective="ftrl", num_feature=4,
+                       comm_policy="auto")
+    assert resolve_logreg_comm_policy(cfg) == "ps"
+    cfg_bad = LogRegConfig(objective="ftrl", num_feature=4,
+                           comm_policy="allreduce")
+    with pytest.raises(FatalError):
+        resolve_logreg_comm_policy(cfg_bad)
+
+
+# ---------------------------------------------------------------------------
+# word2vec policy parity
+# ---------------------------------------------------------------------------
+def _w2v_corpus(V=120, n_sent=24, sent_len=24, seed=0):
+    from multiverso_tpu.models.word2vec import Dictionary
+
+    rng = np.random.default_rng(seed)
+    d, zipf = Dictionary.synthetic_zipf(V, n_sent * sent_len)
+    sents = [rng.choice(V, size=sent_len, p=zipf).astype(np.int32)
+             for _ in range(n_sent)]
+    return d, sents
+
+
+def _w2v_cfg(**kw):
+    from multiverso_tpu.models.word2vec import Word2VecConfig
+
+    base = dict(embedding_size=8, window=3, negative=3, batch_size=64,
+                sample=1e-3, sg=True, hs=False, optimizer="adagrad",
+                epochs=1, pipeline=False, device_pipeline=True,
+                block_sentences=8, pad_sentence_length=32, seed=0)
+    base.update(kw)
+    return Word2VecConfig(**base)
+
+
+def test_w2v_hybrid_tables_bitwise_equal_fused(mv_env):
+    """Hybrid = fused sparse plane + a value-preserving dense-plane
+    merge: the trained embeddings must be BITWISE identical to the
+    legacy fused run, with both planes' counters ticking."""
+    from multiverso_tpu.models.word2vec import Word2Vec
+    from multiverso_tpu.telemetry import get_registry
+
+    d, sents = _w2v_corpus()
+    w_f = Word2Vec(_w2v_cfg(), d)
+    assert w_f.comm_mode == "fused"
+    w_f.train(sentences=sents)
+    emb_f = w_f.embeddings().copy()
+
+    w_h = Word2Vec(_w2v_cfg(comm_policy="auto"), d)
+    assert w_h.comm_mode == "hybrid"
+    assert w_h.comm_policies["w2v_input"] == "ps"
+    assert w_h.input_table.comm_policy == "ps"
+    stats = w_h.train(sentences=sents)
+    assert np.array_equal(emb_f, w_h.embeddings())
+    # The dense plane carries a real value: the device-side merged word
+    # count equals the host count exactly (power-of-two test mesh).
+    assert stats["synced_words"] == stats["words"]
+    snap = get_registry().snapshot(buckets=False)
+    assert snap["counters"]["comm.ps.bytes"]["value"] > 0
+    assert snap["counters"]["comm.allreduce.bytes"]["value"] > 0
+
+
+def test_w2v_hybrid_override_pins_wordcount_to_ps(mv_env):
+    from multiverso_tpu.models.word2vec import Word2Vec
+
+    d, _ = _w2v_corpus()
+    w = Word2Vec(_w2v_cfg(comm_policy="auto",
+                          comm_policy_overrides={"w2v_wordcount": "ps"}),
+                 d)
+    assert w.comm_policies["w2v_wordcount"] == "ps"
+    assert w._dense_sync is None        # no collective leg configured
+
+
+def test_w2v_allreduce_mode_rejected(mv_env):
+    from multiverso_tpu.models.word2vec import Word2Vec
+    from multiverso_tpu.utils.log import FatalError
+
+    d, _ = _w2v_corpus()
+    with pytest.raises(FatalError):
+        Word2Vec(_w2v_cfg(comm_policy="allreduce"), d)
+
+
+def test_w2v_ps_plane_trains_and_counts(mv_env):
+    """comm_policy=ps: pull-train-push through the table clients — the
+    model still learns (finite loss, words counted) and every parameter
+    byte shows up on the ps plane."""
+    from multiverso_tpu.models.word2vec import Word2Vec
+    from multiverso_tpu.telemetry import get_registry
+
+    d, sents = _w2v_corpus()
+    w = Word2Vec(_w2v_cfg(comm_policy="ps", device_pipeline=False), d)
+    assert w.comm_mode == "ps"
+    stats = w.train(sentences=sents)
+    assert stats["comm_mode"] == "ps"
+    assert stats["words"] == sum(len(s) for s in sents)
+    assert np.isfinite(stats["loss"]) and stats["pairs"] > 0
+    emb = w.embeddings()
+    assert np.isfinite(emb).all() and np.abs(emb).sum() > 0
+    snap = get_registry().snapshot(buckets=False)
+    # 4 tables x (pull + push) per block, plus wordcount adds.
+    assert snap["counters"]["comm.ps.bytes"]["value"] > emb.nbytes
+    assert "comm.ps.latency_ms" in snap["histograms"]
+
+
+def test_w2v_model_average_bitwise_equal_fused_one_process(mv_env):
+    """In one process the "ma" epoch merge is the identity (mean of one
+    replica), so model_average must reproduce the fused tables exactly
+    while still exercising (and counting) the collective plane."""
+    from multiverso_tpu.models.word2vec import Word2Vec
+    from multiverso_tpu.telemetry import get_registry
+
+    d, sents = _w2v_corpus()
+    w_f = Word2Vec(_w2v_cfg(), d)
+    w_f.train(sentences=sents)
+    emb_f = w_f.embeddings().copy()
+
+    w_m = Word2Vec(_w2v_cfg(comm_policy="model_average"), d)
+    assert w_m.comm_mode == "model_average"
+    w_m.train(sentences=sents)
+    assert np.array_equal(emb_f, w_m.embeddings())
+    snap = get_registry().snapshot(buckets=False)
+    assert snap["counters"]["comm.model_average.bytes"]["value"] > 0

@@ -1,0 +1,65 @@
+"""Tier-1 CommPolicy smoke: drives ``scripts/comm_bench.py --dry-run``
+end to end (ISSUE 10 CI satellite).
+
+Asserts the AUTO decision table picks the expected policy for the
+canonical shapes, that the hybrid word2vec dry run really ran BOTH
+planes (PS add counter AND ``comm.allreduce.bytes`` nonzero — the
+script's own witness block, re-checked here), that the logreg allreduce
+params are bitwise-equal to the PS path, and that the measured
+policy ordering matches AUTO's choices. A regression that silently
+routes everything back onto one plane fails here, not in a bench
+review.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_comm_bench_dry_run_witnesses(tmp_path):
+    out = tmp_path / "BENCH_COMM.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "comm_bench.py"),
+         "--dry-run", f"--out={out}"],
+        capture_output=True, text=True, timeout=420, cwd=_REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    rec = json.loads(out.read_text())
+    assert rec["metric"] == "comm_policy_bench" and rec["dry_run"]
+
+    # AUTO decision table, canonical shapes (deterministic rows).
+    canon = rec["auto"]["canonical"]
+    assert canon["w2v_embedding_50000x128"] == "ps"      # sparse
+    assert canon["hbm_scale_1Mx128"] == "ps"             # HBM-scale
+    assert canon["override_wins"] == "ps"                # explicit wins
+    # The probed rows must match the probe evidence they carry.
+    probed = {d["table"]: d for d in
+              rec["auto"]["evidence"]["decisions"] if "probe_ms" in d}
+    for name in ("logreg_weights_785x1", "wordcount_1"):
+        lat = probed[name]["probe_ms"]
+        want = "ps" if lat["ps"] < lat["allreduce"] else "allreduce"
+        assert canon[name] == want, (name, lat)
+
+    # Both planes ran in the hybrid word2vec dry run.
+    wit = rec["witnesses"]
+    assert wit["hybrid_ps_adds_nonzero"], wit
+    assert wit["hybrid_allreduce_bytes_nonzero"], wit
+    assert all(wit.values()), wit
+
+    # Policy parity + ordering: allreduce == ps bitwise, and the
+    # same-semantics plane AUTO picked is the measured fastest.
+    assert rec["logreg"]["allreduce_bitwise_eq_ps"]
+    assert rec["logreg"]["allreduce_over_ps"] > 1.0
+    assert rec["word2vec"]["hybrid_over_ps"] > 1.0
+    matches = rec["auto"]["auto_matches_fastest"]
+    assert matches["logreg_weights"]["match"], matches
+    assert matches["w2v_tables"]["match"], matches
+
+    # Per-policy telemetry is embedded per leg.
+    assert rec["word2vec"]["ps"]["comm"]["comm.ps.bytes"] > 0
+    assert rec["word2vec"]["model_average"]["comm"][
+        "comm.model_average.bytes"] > 0
+    assert rec["logreg"]["allreduce"]["comm"]["comm.allreduce.bytes"] > 0

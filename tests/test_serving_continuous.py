@@ -118,6 +118,34 @@ def test_max_new_one_parity(mv_env):
         cb.close()
 
 
+def test_same_boundary_completions_batch_into_one_read(mv_env):
+    """Requests that join at the same step boundary finish at the same
+    boundary and deliver via ONE gathered device sync
+    (``serve.continuous.batched_reads``) — with tokens still bitwise
+    equal to the solo drain path. The submits happen under the batcher's
+    (reentrant) cv so the worker claims all three in one round."""
+    from multiverso_tpu.serving import ContinuousBatcher
+    from multiverso_tpu.telemetry import get_registry
+
+    runner, _, _ = _lm(max_new=3, max_batch=3)
+    prompts = [[5, 9, 2], [1], [7, 3, 3]]
+    solo = [_solo_drain_tokens(runner, p, bucket=8) for p in prompts]
+
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=3,
+                           max_queue=16)
+    try:
+        with cb._cv:        # hold the worker until all three are queued
+            futs = [cb.submit(np.asarray(p, np.int32),
+                              deadline_ms=60_000) for p in prompts]
+        for p, want, f in zip(prompts, solo, futs):
+            assert f.wait(60).tolist() == want, p
+        snap = get_registry().snapshot(buckets=False)
+        assert snap["counters"]["serve.continuous.batched_reads"][
+            "value"] >= 1, "same-boundary completions were read one-by-one"
+    finally:
+        cb.close()
+
+
 def test_multi_bucket_engines_and_jit_accounting(mv_env):
     """One prefill + one step executable per exercised bucket (the
     no-retrace witness, continuous flavor)."""
