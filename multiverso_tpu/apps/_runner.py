@@ -75,6 +75,15 @@ def serve_config() -> dict:
             raise FatalError(f"bad -serve_pipeline_depth value "
                              f"'{depth_raw}' (want an int or 'auto')") \
                 from None
+    from multiverso_tpu.serving.quant import STORAGE_DTYPES
+    kv_dtype = str(get_flag("serve_kv_dtype")).strip().lower() or "f32"
+    table_dtype = str(get_flag("serve_table_dtype")).strip().lower() \
+        or "f32"
+    for name, val in (("-serve_kv_dtype", kv_dtype),
+                      ("-serve_table_dtype", table_dtype)):
+        if val not in STORAGE_DTYPES:
+            raise FatalError(f"bad {name} value '{val}' "
+                             f"(want one of {', '.join(STORAGE_DTYPES)})")
     return {
         "host": str(get_flag("serve_host")),
         "port": int(get_flag("serve_port")),
@@ -86,6 +95,12 @@ def serve_config() -> dict:
         "cache_rows": int(get_flag("serve_cache_rows")),
         "cache_staleness": int(get_flag("serve_cache_staleness")),
         "continuous": bool(get_flag("serve_continuous")),
+        "paged": bool(get_flag("serve_paged_kv")),
+        "kv_page": int(get_flag("serve_kv_page")),
+        "kv_pages": int(get_flag("serve_kv_pages")),
+        "kv_dtype": kv_dtype,
+        "table_dtype": table_dtype,
+        "prefix_entries": int(get_flag("serve_prefix_cache")),
     }
 
 

@@ -128,7 +128,12 @@ def _replica_body(cfg: dict) -> int:
                             max_wait_ms=scfg["max_wait_ms"],
                             max_queue=scfg["max_queue"],
                             pipeline_depth=scfg["pipeline_depth"],
-                            continuous=scfg["continuous"])
+                            continuous=scfg["continuous"],
+                            paged=scfg["paged"],
+                            kv_dtype=scfg["kv_dtype"],
+                            kv_page=scfg["kv_page"],
+                            kv_pages=scfg["kv_pages"],
+                            prefix_entries=scfg["prefix_entries"])
     # Warm BEFORE joining the ring: the first routed request must never
     # pay a trace.
     warmed = service.warmup()

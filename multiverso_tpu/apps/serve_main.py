@@ -63,7 +63,11 @@ def _body(remaining: List[str]) -> int:
                             max_wait_ms=cfg["max_wait_ms"],
                             max_queue=cfg["max_queue"],
                             pipeline_depth=cfg["pipeline_depth"],
-                            continuous=cfg["continuous"])
+                            continuous=cfg["continuous"],
+                            paged=cfg["paged"], kv_dtype=cfg["kv_dtype"],
+                            kv_page=cfg["kv_page"],
+                            kv_pages=cfg["kv_pages"],
+                            prefix_entries=cfg["prefix_entries"])
     host, port = service.address
     log.info("serving table '%s' (step %d) at %s:%d", table, snap.step,
              host, port)

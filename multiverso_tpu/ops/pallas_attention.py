@@ -183,3 +183,119 @@ def supported(q: jax.Array, k: jax.Array,
     the head dim should be lane-friendly."""
     return (q.shape[2] % block_q == 0 and k.shape[2] % block_k == 0
             and q.shape[3] % 8 == 0)
+
+
+# ---------------------------------------------------------------------------
+# Paged single-token decode attention (docs/SERVING.md "Decode memory
+# hierarchy"). The serving step's XLA formulation gathers every slot's
+# pages into a [B, H, G*P, dh] logical cache in HBM before attending —
+# bytes MOVED per step stay O(context) even though bytes HELD are paged.
+# This kernel removes the materialized gather: the per-slot page table
+# rides scalar prefetch, the BlockSpec index_map dereferences it, and
+# Mosaic DMAs each physical page straight from the pool into VMEM while
+# the online-softmax recurrence streams over pages. Same protocol as the
+# kernels above: interpret-mode parity on CPU decides correctness
+# (tests/test_pallas_attention.py), on-chip timing decides adoption
+# (default OFF in the serving step until measured).
+# ---------------------------------------------------------------------------
+def _paged_kernel(ptab_ref, len_ref, t_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_s, m_s, l_s, *, scale: float, n_pages: int,
+                  page: int, bucket: int):
+    """One (slot, logical-page) grid step; the page axis is innermost so
+    the VMEM scratch carries the online-softmax state across one slot's
+    pages. ``k_ref``/``v_ref`` hold the PHYSICAL page the index_map
+    resolved via the prefetched page table."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    q = q_ref[0].astype(jnp.float32)                       # [H, dh]
+    k = k_ref[0].astype(jnp.float32)                       # [H, P, dh]
+    v = v_ref[0].astype(jnp.float32)                       # [H, P, dh]
+    # s[h, p] = q[h] . k[h, p]  (batched over heads)
+    s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * scale
+    # Slot/position mask computed IN the kernel from the prefetched
+    # scalars — the drain path's formula verbatim: a key at logical
+    # position r is valid iff r < len (real prompt) or bucket <= r <=
+    # bucket + t (generated so far).
+    pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    length = len_ref[b]
+    t = t_ref[b]
+    valid = (pos < length) | ((pos >= bucket) & (pos <= bucket + t))
+    s = s + jnp.where(valid, 0.0, NEG_INF)
+
+    m_prev = m_s[:, :1]                                    # [H, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                                 # [H, P]
+    l_new = alpha * l_s[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+    acc_s[...] = acc_s[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+    l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _flush():
+        o_ref[0] = acc_s[...] / l_s[:, :1]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("bucket", "page", "scale",
+                                    "interpret"))
+def paged_decode_attn(q: jax.Array, kp: jax.Array, vp: jax.Array,
+                      ptab: jax.Array, lengths: jax.Array,
+                      t: jax.Array, *, bucket: int, page: int,
+                      scale: float, interpret: bool = False) -> jax.Array:
+    """One decode step of attention over paged KV storage.
+
+    q: [B, H, dh] this step's queries (one token per slot); kp/vp:
+    [n_phys, H, page, dh] ONE layer's physical page pool; ptab: [B, G]
+    int32 logical->physical page table; lengths/t: [B] int32 prompt
+    lengths and per-slot step counters. Returns the NORMALIZED
+    attention output [B, H, dh] — softmax over each slot's valid keys
+    (prompt + generated-so-far), numerically the online-softmax
+    refactoring of the serving step's gather-then-attend.
+
+    The page table and mask scalars ride ``PrefetchScalarGridSpec``:
+    block index maps dereference ``ptab`` so each grid step DMAs
+    exactly one PHYSICAL page — no [B, G*P, dh] logical cache is ever
+    materialized in HBM."""
+    B, H, dh = q.shape
+    G = ptab.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, G),
+        in_specs=[
+            pl.BlockSpec((1, H, dh),
+                         lambda b, j, ptab_r, len_r, t_r: (b, 0, 0)),
+            pl.BlockSpec((1, H, page, dh),
+                         lambda b, j, ptab_r, len_r, t_r:
+                         (ptab_r[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, H, page, dh),
+                         lambda b, j, ptab_r, len_r, t_r:
+                         (ptab_r[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, H, dh), lambda b, j, ptab_r, len_r, t_r: (b, 0, 0)),
+        scratch_shapes=[
+            _scratch((H, dh), jnp.float32),
+            _scratch((H, 128), jnp.float32),
+            _scratch((H, 128), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, scale=scale, n_pages=G,
+                               page=page, bucket=bucket)
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, dh), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(ptab, jnp.int32), jnp.asarray(lengths, jnp.int32),
+      jnp.asarray(t, jnp.int32), q, kp, vp)

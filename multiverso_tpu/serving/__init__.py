@@ -16,14 +16,18 @@ See docs/SERVING.md for architecture and tuning.
 
 from multiverso_tpu.serving.batcher import (BucketLadder, DynamicBatcher,
                                             ServeRequest, ShedError)
-from multiverso_tpu.serving.cache import HotRowCache, cache_from_flags
+from multiverso_tpu.serving.cache import (HotRowCache, StampedRows,
+                                          cache_from_flags)
 from multiverso_tpu.serving.client import (ReplicaUnavailableError,
                                            RoutedLookupClient, ServeResult,
                                            ServingClient,
                                            connect_with_backoff)
 from multiverso_tpu.serving.continuous import ContinuousBatcher
+from multiverso_tpu.serving.paged import (PagePlan, PagePool, page_plan,
+                                          pages_of)
 from multiverso_tpu.serving.pipeline import (DispatchPipeline,
                                              resolve_pipeline_depth)
+from multiverso_tpu.serving.prefix import PrefixStore
 from multiverso_tpu.serving.replica import (CheckpointReplica,
                                             ReplicaSnapshot,
                                             load_checkpoint_tables)
@@ -36,10 +40,11 @@ from multiverso_tpu.serving.service import ServingService
 __all__ = [
     "AttentionLMRunner", "BucketLadder", "CheckpointReplica",
     "ContinuousBatcher", "DispatchPipeline", "DynamicBatcher",
-    "HotRowCache", "ReplicaLookupRunner", "ReplicaSnapshot",
+    "HotRowCache", "PagePlan", "PagePool", "PrefixStore",
+    "ReplicaLookupRunner", "ReplicaSnapshot",
     "ReplicaUnavailableError", "RoutedLookupClient", "ServeRequest",
     "ServeResult", "ServingClient", "ServingRunner", "ServingService",
-    "ShedError", "SparseLookupRunner", "cache_from_flags",
-    "connect_with_backoff", "load_checkpoint_tables",
-    "resolve_pipeline_depth",
+    "ShedError", "SparseLookupRunner", "StampedRows", "cache_from_flags",
+    "connect_with_backoff", "load_checkpoint_tables", "page_plan",
+    "pages_of", "resolve_pipeline_depth",
 ]

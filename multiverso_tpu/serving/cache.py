@@ -34,6 +34,23 @@ import numpy as np
 from multiverso_tpu.telemetry import counter, gauge
 
 
+class StampedRows(np.ndarray):
+    """A cache-hit result matrix carrying the BSP clock stamp of the
+    OLDEST cached row it was assembled from. The serving service stamps
+    the reply meta with THIS value instead of ``runner.clock()`` — with
+    ``-serve_cache_staleness>0`` the runner's last-batch clock can be
+    newer than the cached bytes, and a reply must never claim a version
+    newer than what it serves (ROADMAP 5a)."""
+
+    clock_stamp: float
+
+    @classmethod
+    def wrap(cls, rows: np.ndarray, stamp: float) -> "StampedRows":
+        out = rows.view(cls)
+        out.clock_stamp = float(stamp)
+        return out
+
+
 class HotRowCache:
     """Bounded LRU of ``row id -> (clock stamp, value row)``.
 
@@ -61,11 +78,14 @@ class HotRowCache:
         return (now_clock - stamp) <= self.staleness
 
     def get_rows(self, keys: np.ndarray,
-                 now_clock: float) -> Optional[np.ndarray]:
+                 now_clock: float) -> Optional["StampedRows"]:
         """The full value matrix for ``keys`` iff EVERY key is cached
         within the staleness bound; None otherwise (counts one miss or
-        stale per request, one hit per fully-served request)."""
+        stale per request, one hit per fully-served request). The result
+        is a :class:`StampedRows` whose ``clock_stamp`` is the oldest
+        contributing row's stamp — what the reply meta must claim."""
         out = []
+        stamp = now_clock
         with self._lock:
             for k in keys:
                 entry = self._rows.get(int(k))
@@ -75,13 +95,14 @@ class HotRowCache:
                 if not self._fresh(entry[0], now_clock):
                     self._c_stale.inc()
                     return None
+                stamp = min(stamp, entry[0]) if out else entry[0]
                 out.append(entry[1])
             for k in keys:                    # LRU touch only on full hits
                 self._rows.move_to_end(int(k))
         self._c_hit.inc()
         if not out:
             return None                       # empty request: device path
-        return np.stack(out)
+        return StampedRows.wrap(np.stack(out), stamp)
 
     def put_rows(self, keys: np.ndarray, rows: np.ndarray,
                  clock: float) -> None:

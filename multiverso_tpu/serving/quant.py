@@ -1,0 +1,138 @@
+"""Storage codecs for the decode-side memory hierarchy.
+
+``-serve_wire_dtype`` already ships bf16 on the wire; this module takes
+the same trade to *storage*: KV pages (``serving/paged.py``) and frozen
+replica table rows (``serving/replica.py``) may live in HBM as bf16 or
+int8 and dequantize on read, fused into the lookup/attention kernels.
+On a memory-bound decode step bytes resident and bytes moved are the
+throughput (PAPERS.md 2011.03641's roofline framing; 2605.25645's
+TPU-serving cost framing) — halving or quartering the KV working set is
+a direct users-per-chip lever.
+
+The parity contract, bitwise-controlled:
+
+* ``f32`` (default) is the IDENTITY codec: encode/decode return their
+  input array object untouched, so every f32 path stays bit-identical
+  to the pre-quantization code. The scale plane is a 1-element dummy
+  (shape-stable jit signatures, no branches in callers).
+* ``bf16`` stores ``bfloat16`` payloads (relative error <= 2^-8 per
+  element after the round-trip); no scale plane.
+* ``int8`` stores symmetric per-ROW absmax-scaled int8: one f32 scale
+  per row (the last axis is the row), ``|x - decode(encode(x))| <=
+  absmax(row)/254`` — the bound ``tests/test_serving_paged.py``
+  asserts.
+
+Every helper here is pure jnp and trace-safe: callers fuse
+``decode_rows`` straight into their gather/attention kernels so the
+dequant never materializes a second full-precision copy in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu.utils.log import check
+
+#: Storage dtypes the serving plane accepts (flags validate against this).
+STORAGE_DTYPES = ("f32", "bf16", "int8")
+
+_INT8_MAX = 127.0
+
+
+def storage_dtype(name: str) -> str:
+    """Validate + canonicalize a ``-serve_kv_dtype``/``-serve_table_dtype``
+    value."""
+    name = str(name).strip().lower() or "f32"
+    check(name in STORAGE_DTYPES,
+          f"unknown storage dtype '{name}' (want one of {STORAGE_DTYPES})")
+    return name
+
+
+def jnp_dtype(name: str):
+    """The jnp dtype payloads are stored as."""
+    return {"f32": jnp.float32, "bf16": jnp.bfloat16,
+            "int8": jnp.int8}[storage_dtype(name)]
+
+
+def has_scale(name: str) -> bool:
+    """Whether the codec carries a per-row scale plane (int8 only)."""
+    return storage_dtype(name) == "int8"
+
+
+def bytes_per_element(name: str) -> float:
+    """Storage bytes per payload element (int8 includes the amortized
+    per-row scale assuming rows of >= 16 elements are the common case —
+    the bench uses the exact row width instead)."""
+    return {"f32": 4.0, "bf16": 2.0, "int8": 1.0}[storage_dtype(name)]
+
+
+def encode_rows(x, dtype: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Encode ``x`` (f32, row = last axis) into storage form.
+
+    Returns ``(payload, scale)`` where ``scale`` has ``x``'s shape with
+    the last axis reduced to 1. For f32/bf16 the scale is a dummy ONES
+    plane of that shape (callers keep one jit signature across codecs;
+    XLA dead-code-eliminates the unused plane)."""
+    dtype = storage_dtype(dtype)
+    x = jnp.asarray(x)
+    if dtype == "f32":
+        return x, jnp.ones(x.shape[:-1] + (1,), jnp.float32)
+    if dtype == "bf16":
+        return x.astype(jnp.bfloat16), \
+            jnp.ones(x.shape[:-1] + (1,), jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / _INT8_MAX, 1.0) \
+        .astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / scale), -_INT8_MAX, _INT8_MAX) \
+        .astype(jnp.int8)
+    return q, scale
+
+
+def decode_rows(payload, scale, dtype: str) -> jnp.ndarray:
+    """Inverse of :func:`encode_rows` — the read-side dequant callers
+    fuse into their gather/attention programs. f32 returns the payload
+    OBJECT untouched (the bitwise-identity contract)."""
+    dtype = storage_dtype(dtype)
+    if dtype == "f32":
+        return payload
+    if dtype == "bf16":
+        return payload.astype(jnp.float32)
+    return payload.astype(jnp.float32) * scale
+
+
+def roundtrip_bound(x: np.ndarray, dtype: str) -> float:
+    """The worst-case absolute error ``decode(encode(x))`` may show —
+    what the bounded-error tests assert against. 0 for f32."""
+    dtype = storage_dtype(dtype)
+    x = np.asarray(x, np.float32)
+    if dtype == "f32":
+        return 0.0
+    if dtype == "bf16":
+        # bf16 keeps 8 mantissa bits: rel err <= 2^-9 + one ulp slack.
+        return float(np.max(np.abs(x)) * 2.0 ** -8) if x.size else 0.0
+    absmax = np.max(np.abs(x), axis=-1, keepdims=True) if x.size else 0.0
+    # round() is within half a quantization step; scale = absmax/127.
+    return float(np.max(absmax) / (2.0 * _INT8_MAX)) if x.size else 0.0
+
+
+def encode_table(data: np.ndarray, dtype: str
+                 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Host->device conversion of one 2-D replica table into storage
+    form (the once-per-checkpoint-swap amortization point). Returns
+    ``(device payload, device scale-or-None)`` — f32 is exactly the
+    ``jnp.asarray`` the replica always did."""
+    dtype = storage_dtype(dtype)
+    if dtype == "f32":
+        return jnp.asarray(data), None
+    if dtype == "bf16":
+        return jnp.asarray(data, jnp.bfloat16), None
+    arr = np.asarray(data, np.float32)
+    absmax = np.max(np.abs(arr), axis=-1, keepdims=True)
+    scale = np.where(absmax > 0, absmax / _INT8_MAX, 1.0) \
+        .astype(np.float32)
+    q = np.clip(np.round(arr / scale), -_INT8_MAX, _INT8_MAX) \
+        .astype(np.int8)
+    return jnp.asarray(q), jnp.asarray(scale)

@@ -34,22 +34,48 @@ from multiverso_tpu.utils.log import check, log
 
 class ReplicaSnapshot:
     """One immutable checkpoint's worth of tables. ``tables`` maps table
-    name -> device-resident array (shards already reassembled, uploaded
-    once at swap time so per-batch gathers never pay H2D again)."""
+    name -> ``(payload, scale)`` storage pairs, device-resident in the
+    replica's storage dtype (shards already reassembled, converted once
+    at swap time so per-batch gathers never pay H2D or re-quantization
+    again). ``scale`` is None except for int8 (per-row absmax —
+    serving/quant.py)."""
 
-    __slots__ = ("step", "root", "_tables")
+    __slots__ = ("step", "root", "dtype", "_tables", "_dequant",
+                 "_dequant_lock")
 
     def __init__(self, step: int, root: str,
-                 tables: Dict[str, np.ndarray]):
+                 tables: Dict[str, Tuple], dtype: str = "f32"):
         self.step = step
         self.root = root
+        self.dtype = dtype
         self._tables = tables
+        self._dequant: Dict[str, np.ndarray] = {}
+        self._dequant_lock = threading.Lock()
 
-    def table(self, name: str) -> np.ndarray:
+    def storage(self, name: str) -> Tuple:
+        """``(payload, scale-or-None)`` in storage form — what the
+        dequant-fused serving gather reads."""
         check(name in self._tables,
               f"checkpoint has no table '{name}' "
               f"(has: {sorted(self._tables)})")
         return self._tables[name]
+
+    def table(self, name: str) -> np.ndarray:
+        """The table at f32 — for f32 storage this IS the resident
+        array (the pre-quantization contract, bit-for-bit); quantized
+        storage dequantizes lazily and caches the copy (a convenience
+        for tests/tools — the serving path uses :meth:`storage` and
+        never materializes it)."""
+        data, scale = self.storage(name)
+        if scale is None and data.dtype == np.float32:
+            return data
+        with self._dequant_lock:
+            cached = self._dequant.get(name)
+            if cached is None:
+                from multiverso_tpu.serving.quant import decode_rows
+                cached = decode_rows(data, scale, self.dtype)
+                self._dequant[name] = cached
+            return cached
 
     @property
     def names(self) -> List[str]:
@@ -109,7 +135,16 @@ class CheckpointReplica:
     serving process follows training without any coordination channel
     beyond the checkpoint directory."""
 
-    def __init__(self, directory: str, load: bool = True):
+    def __init__(self, directory: str, load: bool = True,
+                 table_dtype: Optional[str] = None):
+        from multiverso_tpu.serving.quant import storage_dtype
+        if table_dtype is None:
+            try:
+                from multiverso_tpu.utils.configure import get_flag
+                table_dtype = str(get_flag("serve_table_dtype"))
+            except Exception:  # noqa: BLE001 - unparsed flags (bare
+                table_dtype = "f32"             # library use)
+        self.table_dtype = storage_dtype(table_dtype)
         self.directory = directory
         self._snap: Optional[ReplicaSnapshot] = None
         self._refresh_lock = threading.Lock()   # one loader at a time
@@ -132,18 +167,21 @@ class CheckpointReplica:
             cur = self._snap
             if cur is not None and step <= cur.step:
                 return False
-            import jax.numpy as jnp
+            from multiverso_tpu.serving.quant import encode_table
             tables = load_checkpoint_tables(root)
             # Device-convert ONCE per swap: serving runners pass these as
             # jit arguments, and a host numpy table would re-upload the
             # whole array on every batch (a 256MB H2D per lookup batch on
             # a 1M x 64 table) — the swap is the right amortization point.
-            tables = {name: jnp.asarray(data)
+            # The storage dtype (-serve_table_dtype) applies HERE too:
+            # quantize once per swap, dequantize fused into each gather.
+            tables = {name: encode_table(data, self.table_dtype)
                       for name, data in tables.items()}
             # Single reference rebind = the swap. Readers that already
             # hold the old snapshot keep serving it; new batches see the
             # new one. Nothing blocks, nothing tears.
-            self._snap = ReplicaSnapshot(step, root, tables)
+            self._snap = ReplicaSnapshot(step, root, tables,
+                                         self.table_dtype)
             self._g_step.set(step)
             self._c_swaps.inc()
             log.info("serving replica: swapped to step %d (%s)", step, root)

@@ -89,32 +89,75 @@ class ServingService:
                         buckets: Sequence[int] = (8, 16, 32, 64),
                         max_batch: int = 8, max_wait_ms: float = 2.0,
                         max_queue: int = 64, pipeline_depth=None,
-                        continuous: Optional[bool] = None) -> None:
+                        continuous: Optional[bool] = None,
+                        paged: Optional[bool] = None,
+                        kv_dtype: Optional[str] = None,
+                        kv_page: Optional[int] = None,
+                        kv_pages: Optional[int] = None,
+                        prefix_entries: Optional[int] = None) -> None:
         """``pipeline_depth``: in-flight dispatch window (int, or "auto"
         for the measured-latency decision table; None reads the
         ``-serve_pipeline_depth`` flag). ``continuous``: iteration-level
         continuous batching for decode runners that support it (None
         reads ``-serve_continuous``); ignored for runners without the
-        per-step contract."""
+        per-step contract. ``paged``/``kv_dtype``/``kv_page``/
+        ``kv_pages``/``prefix_entries``: the decode memory hierarchy
+        (docs/SERVING.md) — None reads ``-serve_paged_kv`` /
+        ``-serve_kv_dtype`` / ``-serve_kv_page`` / ``-serve_kv_pages`` /
+        ``-serve_prefix_cache``."""
         if pipeline_depth is None:
             pipeline_depth = _flag_or("serve_pipeline_depth", "auto")
         if continuous is None:
             continuous = bool(_flag_or("serve_continuous", False))
+        if paged is None:
+            paged = bool(_flag_or("serve_paged_kv", False))
+        if kv_dtype is None:
+            kv_dtype = str(_flag_or("serve_kv_dtype", "f32"))
+        if kv_page is None:
+            kv_page = int(_flag_or("serve_kv_page", 16))
+        if kv_pages is None:
+            kv_pages = int(_flag_or("serve_kv_pages", 0))
+        if prefix_entries is None:
+            prefix_entries = int(_flag_or("serve_prefix_cache", 0))
+        # Config validation OUTSIDE the degrade guard below: a bad flag
+        # combination must fail bring-up loudly — only a genuine
+        # checkpoint-layout incompatibility degrades to drain batching.
+        from multiverso_tpu.serving.quant import storage_dtype
+        kv_dtype = storage_dtype(kv_dtype)
+        check(int(kv_page) >= 1, "-serve_kv_page must be >= 1")
+        check(kv_dtype == "f32" or paged,
+              "-serve_kv_dtype requires -serve_paged_kv")
+        check(int(prefix_entries) == 0 or paged,
+              "-serve_prefix_cache requires -serve_paged_kv")
         with self._lock:
             check(runner_id not in self._batchers,
                   f"runner id {runner_id} already registered")
             self._runners[runner_id] = runner
+            batcher = None
             if continuous and hasattr(runner, "params_ref"):
                 from multiverso_tpu.serving.continuous import \
                     ContinuousBatcher
-                self._batchers[runner_id] = ContinuousBatcher(
-                    runner, buckets, max_batch=max_batch,
-                    max_queue=max_queue)
-            else:
-                self._batchers[runner_id] = DynamicBatcher(
+                try:
+                    batcher = ContinuousBatcher(
+                        runner, buckets, max_batch=max_batch,
+                        max_queue=max_queue, paged=paged,
+                        kv_dtype=kv_dtype, page=kv_page,
+                        pool_pages=kv_pages or None,
+                        prefix_entries=prefix_entries)
+                except Exception as e:  # noqa: BLE001 - an unsupported
+                    # checkpoint layout (MoE / pipeline attention_lm)
+                    # must DEGRADE to drain batching, not crash serving
+                    # bring-up (ROADMAP 5b).
+                    log.warning(
+                        "-serve_continuous: runner %s does not support "
+                        "continuous decode (%s); degrading to drain "
+                        "batching", getattr(runner, "name", "?"), e)
+            if batcher is None:
+                batcher = DynamicBatcher(
                     runner, buckets, max_batch=max_batch,
                     max_wait_ms=max_wait_ms, max_queue=max_queue,
                     pipeline_depth=pipeline_depth)
+            self._batchers[runner_id] = batcher
 
     def batcher(self, runner_id: int = 0) -> DynamicBatcher:
         return self._batchers[runner_id]
@@ -236,7 +279,14 @@ class ServingService:
                 self._reply_error(_conn, _msg, str(result))
             else:
                 reply = _msg.create_reply()
-                clock = float(getattr(runner, "clock", lambda: -1.0)())
+                # A hot-row cache hit carries the stamp of the bytes it
+                # actually serves (StampedRows); everything else reports
+                # the runner's last-batch clock. Using runner.clock()
+                # for hits let a staleness>0 reply claim a NEWER version
+                # than its rows (ROADMAP 5a).
+                stamp = getattr(result, "clock_stamp", None)
+                clock = float(stamp) if stamp is not None else \
+                    float(getattr(runner, "clock", lambda: -1.0)())
                 # Retired BSP worlds report an INF clock (every worker
                 # finished); the wire meta is int64, so stamp the
                 # "no finite version" sentinel instead of overflowing.

@@ -247,6 +247,29 @@ define_bool("serve_continuous", False, "iteration-level continuous "
             "batching for LM decode: new requests claim free KV-cache "
             "slots at step boundaries instead of waiting for the "
             "running batch to drain (tokens bit-identical either way)")
+# Decode memory hierarchy (docs/SERVING.md "Decode memory hierarchy").
+define_bool("serve_paged_kv", False, "paged KV cache for LM decode: "
+            "fixed-size pages from one shared pool via per-slot page "
+            "tables, so HBM held scales with ACTUAL context lengths "
+            "(f32 tokens stay bitwise-equal to the preallocated path)")
+define_int("serve_kv_page", 16, "KV page size in token positions "
+           "(paged mode); smaller pages track lengths tighter at more "
+           "page-table overhead")
+define_int("serve_kv_pages", 0, "page pool capacity (paged mode; 0 = "
+           "auto: full backing for every bucket engine). Set LOWER to "
+           "enforce an HBM budget — pool exhaustion queues decode "
+           "admissions at step boundaries instead of crashing")
+define_string("serve_kv_dtype", "f32", "f32|bf16|int8: KV page storage "
+              "dtype (paged mode) with dequant-on-read fused into the "
+              "decode step; int8 carries a per-row absmax scale")
+define_string("serve_table_dtype", "f32", "f32|bf16|int8: frozen replica "
+              "table STORAGE dtype with dequant fused into the lookup "
+              "gather (f32 stays bitwise-equal to direct table rows; "
+              "quantized trades bounded read error for table bytes)")
+define_int("serve_prefix_cache", 0, "prefix-cache entries (0 = off; "
+           "needs -serve_paged_kv): requests sharing a prompt share "
+           "prefill output and prompt KV pages (copy-on-extend), "
+           "probed at step-boundary admission")
 # Fleet layer (multiverso_tpu/fleet; docs/SERVING.md "Fleet").
 define_string("fleet_role", "local", "local|router|replica|drain: local "
               "spawns a router + -fleet_replicas replica processes; "

@@ -347,6 +347,196 @@ def _export_local_trace(tdir: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Decode memory hierarchy leg (ISSUE 11 / docs/SERVING.md): paged KV vs
+# preallocated users-per-chip at a fixed simulated HBM budget, prefix-cache
+# reuse witness, f32/bf16/int8 storage comparison — all with the bitwise
+# parity witness embedded (paged f32 tokens == drain-path tokens).
+# ---------------------------------------------------------------------------
+_HBM_BUDGET_BYTES = 256 * 1024 * 1024    # the simulated per-chip KV budget
+
+
+def _decode_workload(rng, n_req: int, bucket: int, prefix_frac: float,
+                     shared_prompt):
+    """Long-tail context lengths: most prompts short, a tail near the
+    bucket — the workload where max-shape preallocation wastes the most
+    HBM. A ``prefix_frac`` fraction repeats ONE shared prompt (the
+    prefix-heavy skew a prompt cache exists for)."""
+    prompts = []
+    for _ in range(n_req):
+        if prefix_frac > 0.0 and rng.random() < prefix_frac:
+            prompts.append(list(shared_prompt))
+        elif rng.random() < 0.25:               # the long tail
+            n = int(rng.integers(max(bucket * 3 // 4, 2), bucket + 1))
+            prompts.append(rng.integers(1, 60, n).tolist())
+        else:                                    # the short head
+            n = int(rng.integers(1, max(bucket // 4, 2)))
+            prompts.append(rng.integers(1, 60, n).tolist())
+    return prompts
+
+
+def _drive_decode(batcher, prompts, deadline_ms: float = 120_000):
+    t0 = time.monotonic()
+    futs = [batcher.submit(np.asarray(p, np.int32),
+                           deadline_ms=deadline_ms) for p in prompts]
+    toks = [f.wait(300).tolist() for f in futs]
+    return toks, time.monotonic() - t0
+
+
+def _decode_memory_leg(args) -> dict:
+    """Runs in-process (the memory hierarchy is engine-level — wire
+    framing would only add noise to a bytes-resident comparison)."""
+    import jax
+
+    from multiverso_tpu.models.attention_lm import LMConfig, init_params
+    from multiverso_tpu.serving import (AttentionLMRunner,
+                                        ContinuousBatcher, page_plan,
+                                        pages_of)
+    from multiverso_tpu.telemetry import get_registry
+
+    small = bool(args.dry_run)
+    lm_cfg = LMConfig(vocab=61, dim=32, heads=4, layers=2, seq=128)
+    max_new = 4 if small else 8
+    max_batch = 4 if small else 8
+    bucket = 32 if small else 64
+    page = max(4, min(int(args.kv_page), bucket // 8))
+    n_req = 12 if small else 48
+    prefix_frac = args.prefix_frac if args.prefix_frac > 0 else 0.5
+
+    params = {k: np.asarray(v) for k, v in init_params(
+        lm_cfg, jax.random.PRNGKey(0)).items()}
+    runner = AttentionLMRunner(params, lm_cfg, max_new=max_new,
+                               max_batch=max_batch)
+    rng = np.random.default_rng(7)
+    shared_prompt = rng.integers(1, 60, bucket // 3).tolist()
+    prompts = _decode_workload(rng, n_req, bucket, prefix_frac,
+                               shared_prompt)
+
+    # Drain-path reference tokens (the parity oracle) for a sample.
+    def solo(prompt):
+        mat = np.zeros((max_batch, bucket), np.int32)
+        mat[0, :len(prompt)] = prompt
+        lens = np.zeros(max_batch, np.int32)
+        lens[0] = len(prompt)
+        return runner.run(mat, lens)[0].tolist()
+
+    sample = [shared_prompt, prompts[0], prompts[-1]]
+    oracle = [solo(p) for p in sample]
+
+    n_logical = pages_of(bucket + max_new, page)
+    prealloc_slot_bytes = (2 * lm_cfg.layers * lm_cfg.heads
+                           * (bucket + max_new)
+                           * (lm_cfg.dim // lm_cfg.heads) * 4)
+
+    # Marginal page cost per request WITH prefix sharing: the first
+    # occurrence of the shared prompt pays full backing, every repeat
+    # pays only its private gen pages.
+    seen = set()
+    marginal = []
+    for p in prompts:
+        plan = page_plan(len(p), bucket, max_new, page)
+        key = tuple(p)
+        if key in seen:
+            marginal.append(len(plan.private))
+        else:
+            seen.add(key)
+            marginal.append(plan.n_backed)
+
+    def _prefix_counters() -> dict:
+        snap = get_registry().snapshot(buckets=False)
+        return {k: snap["counters"].get(f"serve.prefix.{k}",
+                                        {}).get("value", 0)
+                for k in ("hits", "prefill_skipped", "shared_pages")}
+
+    def run_one(kv_dtype: str, prefix_entries: int) -> dict:
+        pfx0 = _prefix_counters()
+        cb = ContinuousBatcher(runner, buckets=(bucket,),
+                               max_batch=max_batch, max_queue=4 * n_req,
+                               paged=True, page=page, kv_dtype=kv_dtype,
+                               prefix_entries=prefix_entries)
+        try:
+            cb.warmup()
+            toks, elapsed = _drive_decode(cb, prompts)
+            sample_toks = {}
+            for p, want in zip(sample, oracle):
+                got = cb.submit(np.asarray(p, np.int32),
+                                deadline_ms=120_000).wait(300).tolist()
+                sample_toks[str(p[:4])] = {"got": got, "want": want,
+                                           "equal": got == want}
+        finally:
+            cb.close()
+        page_bytes = cb.pool.page_bytes()
+        backed = [page_plan(len(p), bucket, max_new, page).n_backed
+                  for p in prompts]
+        avg_user_bytes = float(np.mean(backed)) * page_bytes
+        shared_user_bytes = float(np.mean(marginal)) * page_bytes
+        users_paged = int(_HBM_BUDGET_BYTES // max(avg_user_bytes, 1))
+        users_shared = int(_HBM_BUDGET_BYTES // max(shared_user_bytes, 1))
+        users_prealloc = int(_HBM_BUDGET_BYTES // prealloc_slot_bytes)
+        return {
+            "kv_dtype": kv_dtype,
+            "prefix_entries": prefix_entries,
+            "decode_qps": round(len(prompts) / elapsed, 1),
+            "page_bytes": page_bytes,
+            "avg_backed_pages_per_user": round(float(np.mean(backed)), 2),
+            "pages_per_slot_max": n_logical,
+            # Per-POOL high-water mark: slot-held pages plus whatever
+            # the prefix store retains (0 when prefix_entries == 0 —
+            # the pure-paging held-bytes witness).
+            "pages_used_max": int(cb.pool.max_used),
+            "users_per_chip_paged": users_paged,
+            "users_per_chip_prefix_shared": users_shared,
+            "users_per_chip_prealloc": users_prealloc,
+            "users_per_chip_ratio": round(users_paged
+                                          / max(users_prealloc, 1), 2),
+            "parity_witness": sample_toks,
+            # Per-RUN deltas (the registry counters are process-wide).
+            "prefix": {k: v - pfx0[k]
+                       for k, v in _prefix_counters().items()},
+            "tokens": toks,
+        }
+
+    # Phase A — pure-paging witness (no prefix store): peak resident
+    # pages must undercut max-shape backing for every slot, and the f32
+    # tokens must be bitwise-equal to the drain path.
+    paging = run_one("f32", prefix_entries=0)
+    # Phase B — prefix-reuse witness: the shared-prompt burst must hit.
+    prefixed = run_one("f32", prefix_entries=64)
+    dtypes = [] if small and args.kv_dtype == "f32" \
+        else sorted({args.kv_dtype} - {"f32"})
+    if args.decode_bench:
+        dtypes = ["bf16", "int8"]
+    runs = {"f32": paging, "f32+prefix": prefixed}
+    for dt in dtypes:
+        runs[dt] = run_one(dt, prefix_entries=0)
+    f32_tokens = paging["tokens"]
+    for name, run in runs.items():
+        if name not in ("f32", "f32+prefix"):
+            run["token_rows_equal_f32"] = sum(
+                int(a == b) for a, b in zip(run["tokens"], f32_tokens))
+        run.pop("tokens", None)
+    parity_ok = all(v["equal"]
+                    for v in paging["parity_witness"].values())
+    witness = {
+        "paged_f32_bitwise_vs_drain": parity_ok,
+        "prefix_hits_ok": prefixed["prefix"]["hits"] >= 1,
+        # HBM held must beat per-slot max-shape: peak pages resident
+        # (pure paging, no cache retention) stayed below full backing
+        # for every slot.
+        "paged_held_ok": paging["pages_used_max"]
+        < max_batch * n_logical,
+    }
+    return {
+        "bucket": bucket, "max_new": max_new, "max_batch": max_batch,
+        "page": page, "n_requests": n_req,
+        "prefix_frac": round(prefix_frac, 3),
+        "hbm_budget_bytes": _HBM_BUDGET_BYTES,
+        "prealloc_slot_bytes": prealloc_slot_bytes,
+        "witness": witness,
+        "runs": runs,
+    }
+
+
+# ---------------------------------------------------------------------------
 # Single-process mode (PR 5's harness, kept as the no-fleet baseline)
 # ---------------------------------------------------------------------------
 def run_single(args) -> dict:
@@ -450,12 +640,22 @@ def run_single(args) -> dict:
         cli.close()
     service.close()
 
+    # Decode memory hierarchy leg AFTER the lookup service closed (no
+    # GIL contention into the bytes-resident comparison). Dry-run always
+    # runs it (the prefix-burst + paged-held tier-1 witnesses);
+    # --decode-bench runs the full f32/bf16/int8 comparison.
+    decode_block = None
+    if args.dry_run or args.decode_bench:
+        decode_block = _decode_memory_leg(args)
+
     record = _make_record("serve_lookup", args, stats, elapsed,
                           _metric_families(("serve.",)))
     record["process_cpu_pct"] = {"bench": cpu_pct}
     record["pipeline"] = probe
     if sweep is not None:
         record["qps_sweep"] = sweep
+    if decode_block is not None:
+        record["decode_memory"] = decode_block
     tdir = args.telemetry_dir or tempfile.mkdtemp(prefix="serve_trace_")
     _export_local_trace(tdir)
     record["tracing"] = _tracing_block(args, tdir, record["achieved_qps"],
@@ -1061,7 +1261,10 @@ def _make_record(benchmark: str, args, stats: _LoadStats,
         # v4: + pipeline block (window depth/occupancy + cache hit
         # witnesses), optional qps_sweep (achieved-vs-offered knee with
         # per-point CPU%) and client-CPU-bound warning.
-        "schema": "multiverso_tpu.bench_serve/v4",
+        # v5: + decode_memory block (paged-vs-prealloc users-per-chip at
+        # a fixed simulated HBM budget, prefix-reuse witness, kv-dtype
+        # comparison, bitwise parity witness embedded).
+        "schema": "multiverso_tpu.bench_serve/v5",
         "benchmark": benchmark,
         "time_unix": time.time(),
         "config": {k: (v if not isinstance(v, tuple) else list(v))
@@ -1112,6 +1315,19 @@ def main() -> int:
                    "uniform workload for record comparability)")
     p.add_argument("--hot-keys", type=int, default=64,
                    help="size of the hot key set --hot-frac draws from")
+    p.add_argument("--prefix-frac", type=float, default=0.0,
+                   help="decode-memory leg: fraction of decode requests "
+                   "repeating one shared prompt (0 = leg default 0.5)")
+    p.add_argument("--kv-dtype", default="f32",
+                   choices=("f32", "bf16", "int8"),
+                   help="decode-memory leg: paged KV storage dtype to "
+                   "compare against f32")
+    p.add_argument("--kv-page", type=int, default=16,
+                   help="decode-memory leg: KV page size in positions")
+    p.add_argument("--decode-bench", action="store_true",
+                   help="run the full decode-memory leg (paged vs "
+                   "prealloc users-per-chip, f32/bf16/int8) in single "
+                   "mode")
     p.add_argument("--qps-sweep", default="",
                    help="A:B:STEP offered-QPS sweep recorded as the "
                    "achieved-vs-offered knee in one history record")

@@ -92,3 +92,28 @@ class DepthBoundedDispatchPipeline:
             with cv:
                 if self._fifo:
                     self._fifo.popleft()                # drain evidence
+
+
+class PagePoolBoundedReclaim:
+    """The shipped page-pool shape (serving/paged.py): the free list is
+    seeded to a FIXED capacity at construction, the reclaim loop sheds
+    double-frees behind a capacity check, and the allocator pop()s —
+    bound and drain evidence both in scope."""
+
+    CAPACITY = 256
+
+    def __init__(self):
+        self._free = list(range(self.CAPACITY))
+
+    def reclaim_loop(self, releases):
+        while True:
+            page = releases.get_next()
+            if page is None:
+                break
+            if len(self._free) >= self.CAPACITY:        # capacity bound
+                continue                                # double-free shed
+            self._free.append(page)
+
+    def alloc(self):
+        while self._free:
+            return self._free.pop()                     # drain evidence

@@ -87,3 +87,22 @@ class BrokenDispatchPipeline:
                 break
             handle = batch.dispatch()
             self._fifo.append(handle)  # expect: unbounded-queue-append
+
+
+class PagePoolUnboundedGrowth:
+    """Decode page pool whose reclaim loop grows its free list straight
+    from a network-driven release stream with no bound — a looping or
+    hostile peer double-freeing page ids inflates the 'free' list (and
+    the release journal) forever."""
+
+    def __init__(self):
+        self._free = []
+        self._release_log = collections.deque()
+
+    def reclaim_loop(self, sock):
+        while True:
+            page = sock.recv()
+            if page is None:
+                break
+            self._free.append(page)  # expect: unbounded-queue-append
+            self._release_log.append(page)  # expect: unbounded-queue-append

@@ -43,7 +43,7 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert line["replicas"] == 2
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v4"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v5"
     assert record["replicas"] == 2
 
     # Routed lookups bitwise-equal to the direct table gather.

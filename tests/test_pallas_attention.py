@@ -158,3 +158,42 @@ def test_ring_attention_flash_flag_matches_xla_path(mv_env, causal):
         mv.set_flag("flash_attention", False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_paged_decode_attn_matches_gather_formulation():
+    """The paged decode kernel (scalar-prefetched page table, online
+    softmax across pages) equals the serving step's gather-then-attend
+    formulation — including the slot/position mask and the masked
+    alignment tail past bucket+max_new."""
+    from multiverso_tpu.ops.pallas_attention import paged_decode_attn
+
+    rng = np.random.default_rng(0)
+    B, H, dh, P, G = 3, 4, 8, 4, 4
+    bucket = 8
+    n_phys = 16
+    q = jnp.asarray(rng.normal(size=(B, H, dh)).astype(np.float32))
+    kp = jnp.asarray(rng.normal(size=(n_phys, H, P, dh))
+                     .astype(np.float32))
+    vp = jnp.asarray(rng.normal(size=(n_phys, H, P, dh))
+                     .astype(np.float32))
+    ptab = jnp.asarray(rng.integers(0, n_phys, (B, G)).astype(np.int32))
+    lengths = jnp.asarray([3, 1, 7], jnp.int32)
+    t = jnp.asarray([0, 2, 5], jnp.int32)
+    scale = 1.0 / np.sqrt(dh)
+
+    kf = jnp.take(kp, ptab, axis=0).transpose(0, 2, 1, 3, 4) \
+        .reshape(B, H, G * P, dh)
+    vf = jnp.take(vp, ptab, axis=0).transpose(0, 2, 1, 3, 4) \
+        .reshape(B, H, G * P, dh)
+    key_slot = jnp.arange(G * P)[None, :]
+    mask = (key_slot < lengths[:, None]) | \
+        ((key_slot >= bucket) & (key_slot <= (bucket + t)[:, None]))
+    s = jnp.einsum("bhd,bhkd->bhk", q, kf) * scale
+    probs = jax.nn.softmax(jnp.where(mask[:, None], s, -jnp.inf),
+                           axis=-1)
+    want = np.asarray(jnp.einsum("bhk,bhkd->bhd", probs, vf))
+
+    got = np.asarray(paged_decode_attn(
+        q, kp, vp, ptab, lengths, t, bucket=bucket, page=P,
+        scale=float(scale), interpret=True))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)

@@ -28,8 +28,8 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["benchmark"] == "serve_lookup"
     record = json.loads(out.read_text())
-    # v4: + pipeline/cache witnesses and optional qps_sweep block
-    assert record["schema"] == "multiverso_tpu.bench_serve/v4"
+    # v5: + decode_memory block (paged KV / prefix / kv-dtype witnesses)
+    assert record["schema"] == "multiverso_tpu.bench_serve/v5"
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
     assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
@@ -62,6 +62,26 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     assert pipe["cache_hits"] >= 1, pipe
     assert pipe["cache_hit_ok"] is True, pipe
     assert "serve.pipeline.inflight" in record["serve_metrics"]["gauges"]
+    # ISSUE-11 acceptance witnesses: the dry run forces a prefix-heavy
+    # decode workload (shared-prompt burst) — the prefix cache must
+    # record hits, paged f32 decode must be bitwise-equal to the drain
+    # path, and peak pages resident must stay BELOW max-shape backing
+    # for every slot (the decode memory hierarchy cannot silently
+    # regress to preallocation).
+    dm = record["decode_memory"]
+    wit = dm["witness"]
+    assert wit["paged_f32_bitwise_vs_drain"] is True, dm
+    assert wit["prefix_hits_ok"] is True, dm
+    assert wit["paged_held_ok"] is True, dm
+    f32 = dm["runs"]["f32"]              # pure-paging witness run
+    pref = dm["runs"]["f32+prefix"]      # shared-prompt burst run
+    assert pref["prefix"]["hits"] >= 1
+    assert pref["prefix"]["prefill_skipped"] >= 1
+    assert f32["pages_used_max"] \
+        < dm["max_batch"] * f32["pages_per_slot_max"]
+    assert f32["users_per_chip_paged"] > f32["users_per_chip_prealloc"]
+    assert pref["users_per_chip_prefix_shared"] \
+        >= f32["users_per_chip_paged"]
 
 
 def test_serve_main_cli_end_to_end(tmp_path):

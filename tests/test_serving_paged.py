@@ -1,0 +1,724 @@
+"""Decode-side memory hierarchy (ISSUE 11): paged KV slots, prefix-cache
+reuse, quantized storage.
+
+The headline contract: paged decode with f32 storage produces tokens
+BITWISE-identical to the preallocated drain path — the page gather only
+appends exactly-masked keys (softmax weight exactly 0.0), so which
+physical pages a slot happens to draw can never change its tokens. On
+top of that: prefix sharers alias prompt pages without re-prefilling
+(copy-on-extend for the straddle page), pool exhaustion queues at the
+admission boundary instead of crashing, and the quantized codecs carry
+a bounded-error + greedy-token-parity story."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def _lm(max_new=6, max_batch=3, **runner_kw):
+    import jax
+
+    from multiverso_tpu.models.attention_lm import LMConfig, init_params
+    from multiverso_tpu.serving import AttentionLMRunner
+
+    cfg = LMConfig(vocab=61, dim=32, heads=4, layers=2, seq=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    runner = AttentionLMRunner({k: np.asarray(v) for k, v in
+                                params.items()}, cfg, max_new=max_new,
+                               max_batch=max_batch, **runner_kw)
+    return runner, params, cfg
+
+
+def _solo_drain_tokens(runner, prompt, bucket):
+    mat = np.zeros((runner.max_batch, bucket), np.int32)
+    mat[0, :len(prompt)] = prompt
+    lens = np.zeros(runner.max_batch, np.int32)
+    lens[0] = len(prompt)
+    return runner.run(mat, lens)[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# Page-plan math
+# ---------------------------------------------------------------------------
+def test_page_plan_classification():
+    from multiverso_tpu.serving import page_plan
+
+    # bucket 8, max_new 8, page 4: logical pages 0..3; prompt pages 0-1,
+    # gen pages 2-3; page size divides the bucket -> no straddle.
+    p = page_plan(3, 8, 8, 4)
+    assert p.n_logical == 4 and p.n_prompt == 2
+    assert p.shared == (0,)          # holds tokens 0..2
+    assert p.pad == (1,)             # pure pad: unbacked
+    assert p.private == (2, 3)
+    assert p.straddle is None
+    assert p.n_backed == 3           # < n_logical: held scales with length
+
+    # page 3 does NOT divide bucket 8: page 2 (positions 6..8) holds
+    # prompt tail AND gen head -> the straddle, private, copy-on-extend.
+    p = page_plan(7, 8, 6, 3)
+    assert p.straddle == 2 and p.straddle in p.private
+    assert p.straddle_has_prompt
+    # a short prompt leaves the straddle pad-only: no copy needed
+    p = page_plan(2, 8, 6, 3)
+    assert p.straddle == 2 and not p.straddle_has_prompt
+    assert p.shared == (0,) and p.pad == (1,)
+
+    # longer prompts back more pages — the HBM-scales-with-length claim
+    assert page_plan(1, 64, 16, 16).n_backed \
+        < page_plan(60, 64, 16, 16).n_backed
+
+
+def test_page_pool_refcounts_and_exhaustion():
+    from multiverso_tpu.serving import PagePool
+
+    pool = PagePool(4, layers=1, heads=1, page=2, dh=2)
+    a = pool.alloc(3)
+    assert a is not None and len(a) == 3 and 0 not in a
+    assert pool.alloc(2) is None          # exhausted: caller queues
+    pool.incref(a)
+    assert pool.decref(a) == 0            # still referenced
+    assert pool.decref(a) == 3            # now free
+    assert pool.free_pages() == 4
+
+
+# ---------------------------------------------------------------------------
+# Paged continuous decode: bitwise parity with the drain path
+# ---------------------------------------------------------------------------
+def test_paged_late_join_bitwise_equal_drain_path(mv_env):
+    """The PR-9 late-join parity test, paged flavor: joiners mid-decode
+    land in pool pages, tokens stay bitwise-equal to solo drain."""
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, _ = _lm(max_new=8, max_batch=3)
+    prompts = [[5, 9, 2], [1], [7, 3, 3, 3, 8, 2, 40]]
+    solo = {tuple(p): _solo_drain_tokens(runner, p, bucket=8)
+            for p in prompts}
+
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=3,
+                           max_queue=16, paged=True, page=4)
+    try:
+        f1 = cb.submit(np.asarray(prompts[0], np.int32),
+                       deadline_ms=60_000)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            eng = cb._engines.get(8)
+            if eng is not None and eng.n_active() and eng.t.max() >= 1:
+                break
+            time.sleep(0.001)
+        f2 = cb.submit(np.asarray(prompts[1], np.int32),
+                       deadline_ms=60_000)
+        f3 = cb.submit(np.asarray(prompts[2], np.int32),
+                       deadline_ms=60_000)
+        for p, f in zip(prompts, (f1, f2, f3)):
+            assert f.wait(60).tolist() == solo[tuple(p)], p
+    finally:
+        cb.close()
+    # every page returned at the step-boundary frees
+    assert cb.pool.used_pages() == 0
+
+
+def test_paged_slot_churn_returns_pages(mv_env):
+    """3x max_batch requests churn through 2 slots: reused slots stay
+    bitwise (stale page contents never leak — the mask contract) and
+    the pool drains back to zero used pages."""
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, _ = _lm(max_new=4, max_batch=2)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 60, int(n)).tolist()
+               for n in rng.integers(1, 8, 6)]
+    solo = [_solo_drain_tokens(runner, p, bucket=8) for p in prompts]
+
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=2,
+                           max_queue=16, paged=True, page=4)
+    try:
+        futs = [cb.submit(np.asarray(p, np.int32), deadline_ms=60_000)
+                for p in prompts]
+        for p, want, f in zip(prompts, solo, futs):
+            assert f.wait(60).tolist() == want, p
+    finally:
+        cb.close()
+    assert cb.pool.used_pages() == 0
+
+
+def test_paged_multi_bucket_shares_one_pool(mv_env):
+    """Engines for different buckets draw from the SAME pool (one jitted
+    prefill+step per bucket; exercising a new bucket allocates pages,
+    not a fresh max-shape cache)."""
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, _ = _lm(max_new=3, max_batch=2)
+    cb = ContinuousBatcher(runner, buckets=(4, 8), max_batch=2,
+                           max_queue=16, paged=True, page=4)
+    try:
+        s4 = _solo_drain_tokens(runner, [5, 9], bucket=4)
+        assert cb.submit(np.asarray([5, 9], np.int32),
+                         deadline_ms=60_000).wait(60).tolist() == s4
+        assert cb.jit_cache_size() == 1
+        s8 = _solo_drain_tokens(runner, [7, 3, 3, 3, 8], bucket=8)
+        assert cb.submit(np.asarray([7, 3, 3, 3, 8], np.int32),
+                         deadline_ms=60_000).wait(60).tolist() == s8
+        assert cb.jit_cache_size() == 2
+        assert cb._step_cache_size() == 2
+        # re-serving an old bucket never retraces
+        assert cb.submit(np.asarray([5, 9], np.int32),
+                         deadline_ms=60_000).wait(60).tolist() == s4
+        assert cb.jit_cache_size() == 2
+    finally:
+        cb.close()
+    assert cb.pool.used_pages() == 0
+
+
+def test_paged_non_dividing_page_size_bitwise(mv_env):
+    """page=3 leaves a straddle page (prompt tail + gen head) and a
+    masked alignment tail past bucket+max_new — tokens still bitwise."""
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, _ = _lm(max_new=6, max_batch=2)
+    prompts = [[7, 3, 3, 3, 8, 2, 40], [5, 9, 2]]
+    solo = [_solo_drain_tokens(runner, p, bucket=8) for p in prompts]
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=2,
+                           max_queue=16, paged=True, page=3)
+    try:
+        futs = [cb.submit(np.asarray(p, np.int32), deadline_ms=60_000)
+                for p in prompts]
+        for want, f in zip(solo, futs):
+            assert f.wait(60).tolist() == want
+    finally:
+        cb.close()
+
+
+def test_page_pool_exhaustion_queues_not_crashes(mv_env):
+    """A pool sized for ~one request forces the others to QUEUE at the
+    step-boundary admission; everyone completes bitwise eventually and
+    the exhaustion counter shows the queueing happened."""
+    from multiverso_tpu.serving import ContinuousBatcher
+    from multiverso_tpu.telemetry import get_registry
+
+    runner, _, _ = _lm(max_new=6, max_batch=3)
+    prompts = [[5, 9, 2], [1], [7, 3, 3, 3, 8, 2, 40]]
+    solo = [_solo_drain_tokens(runner, p, bucket=8) for p in prompts]
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=3,
+                           max_queue=16, paged=True, page=4,
+                           pool_pages=4)
+    try:
+        futs = [cb.submit(np.asarray(p, np.int32), deadline_ms=60_000)
+                for p in prompts]
+        for want, f in zip(solo, futs):
+            assert f.wait(60).tolist() == want
+        snap = get_registry().snapshot(buckets=False)
+        assert snap["counters"]["serve.kv.pool_exhausted"]["value"] >= 1
+    finally:
+        cb.close()
+    assert cb.pool.used_pages() == 0
+
+
+# ---------------------------------------------------------------------------
+# Prefix-cache reuse
+# ---------------------------------------------------------------------------
+def test_prefix_share_skips_prefill_and_stays_bitwise(mv_env):
+    """A repeated prompt hits the prefix store: prefill skipped, prompt
+    pages shared, tokens bitwise-equal. page=3 forces the straddle
+    copy-on-extend path on the long prompt."""
+    from multiverso_tpu.serving import ContinuousBatcher
+    from multiverso_tpu.telemetry import get_registry
+
+    runner, _, _ = _lm(max_new=6, max_batch=3)
+    long_p = [7, 3, 3, 3, 8, 2, 40]
+    want = _solo_drain_tokens(runner, long_p, bucket=8)
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=3,
+                           max_queue=16, paged=True, page=3,
+                           prefix_entries=8)
+    try:
+        assert cb.submit(np.asarray(long_p, np.int32),
+                         deadline_ms=60_000).wait(60).tolist() == want
+        assert cb.submit(np.asarray(long_p, np.int32),
+                         deadline_ms=60_000).wait(60).tolist() == want
+        snap = get_registry().snapshot(buckets=False)
+        assert snap["counters"]["serve.prefix.hits"]["value"] == 1
+        assert snap["counters"]["serve.prefix.prefill_skipped"][
+            "value"] == 1
+        assert snap["counters"]["serve.prefix.shared_pages"]["value"] >= 1
+    finally:
+        cb.close()
+    # the store (not the slots) still holds the prompt pages
+    assert cb.pool.used_pages() == len(cb.prefix) \
+        or cb.pool.used_pages() >= 1
+
+
+def test_prefix_share_under_concurrent_free_and_extend(mv_env):
+    """Donor slots free while sharers join and extend: interleaved
+    repeats of two prompts across slot churn stay bitwise — shared
+    prompt pages are never written after prefill, every extension goes
+    to private pages."""
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, _ = _lm(max_new=4, max_batch=2)
+    a = [7, 3, 3, 3, 8, 2, 40]
+    b = [5, 9, 2]
+    want = {tuple(p): _solo_drain_tokens(runner, p, bucket=8)
+            for p in (a, b)}
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=2,
+                           max_queue=32, paged=True, page=3,
+                           prefix_entries=4)
+    try:
+        order = [a, b, a, a, b, a, b, a]
+        futs = [cb.submit(np.asarray(p, np.int32), deadline_ms=60_000)
+                for p in order]
+        for p, f in zip(order, futs):
+            assert f.wait(60).tolist() == want[tuple(p)], p
+    finally:
+        cb.close()
+
+
+def test_prefix_eviction_returns_pages(mv_env):
+    """A capacity-1 store evicts the older entry when a second prompt
+    publishes; the evicted pages return to the pool once no slot holds
+    them (serve.kv.page_evictions counts them)."""
+    from multiverso_tpu.serving import ContinuousBatcher
+    from multiverso_tpu.telemetry import get_registry
+
+    runner, _, _ = _lm(max_new=3, max_batch=2)
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=2,
+                           max_queue=16, paged=True, page=4,
+                           prefix_entries=1)
+    try:
+        for p in ([5, 9, 2], [7, 3, 3, 3, 8]):
+            want = _solo_drain_tokens(runner, p, bucket=8)
+            assert cb.submit(np.asarray(p, np.int32),
+                             deadline_ms=60_000).wait(60).tolist() == want
+        assert len(cb.prefix) == 1
+        snap = get_registry().snapshot(buckets=False)
+        assert snap["counters"]["serve.kv.page_evictions"]["value"] >= 1
+    finally:
+        cb.close()
+
+
+def test_prefix_invalidated_by_param_swap(mv_env):
+    """A checkpoint hot-swap must drop every prefix entry — prefill
+    output under old weights can never serve new-weight requests."""
+    import jax
+
+    from multiverso_tpu.models.attention_lm import init_params
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, cfg = _lm(max_new=5, max_batch=2)
+    prompt = [5, 9, 2]
+    want = _solo_drain_tokens(runner, prompt, bucket=8)
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=2,
+                           max_queue=16, paged=True, page=4,
+                           prefix_entries=8)
+    try:
+        assert cb.submit(np.asarray(prompt, np.int32),
+                         deadline_ms=60_000).wait(60).tolist() == want
+        runner.swap_params({k: np.asarray(v) for k, v in init_params(
+            cfg, jax.random.PRNGKey(9)).items()})
+        want2 = _solo_drain_tokens(runner, prompt, bucket=8)
+        assert want2 != want
+        got = cb.submit(np.asarray(prompt, np.int32),
+                        deadline_ms=60_000).wait(60).tolist()
+        assert got == want2, "prefix served stale-weight prefill output"
+    finally:
+        cb.close()
+
+
+# ---------------------------------------------------------------------------
+# Quantized storage
+# ---------------------------------------------------------------------------
+def test_quant_roundtrip_bounded_error():
+    from multiverso_tpu.serving.quant import (decode_rows, encode_rows,
+                                              roundtrip_bound)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(5, 4, 16)).astype(np.float32) * 3.0
+    for dt in ("f32", "bf16", "int8"):
+        q, s = encode_rows(x, dt)
+        back = np.asarray(decode_rows(q, s, dt))
+        err = float(np.max(np.abs(back - x)))
+        assert err <= roundtrip_bound(x, dt) + 1e-7, (dt, err)
+    # f32 is the identity codec: the SAME object, bit-for-bit
+    q, _ = encode_rows(x, "f32")
+    assert np.asarray(q) is not None and np.array_equal(np.asarray(q), x)
+
+
+def test_kv_dtype_greedy_token_parity(mv_env):
+    """bf16/int8 KV pages: greedy tokens match the f32 reference on the
+    seeded tiny model (bounded dequant error does not flip argmaxes
+    here — the parity witness quantized serving ships with)."""
+    from multiverso_tpu.serving import ContinuousBatcher
+
+    runner, _, _ = _lm(max_new=6, max_batch=3)
+    prompts = [[7, 3, 3, 3, 8, 2, 40], [5, 9, 2], [1]]
+    want = [_solo_drain_tokens(runner, p, bucket=8) for p in prompts]
+    for dt in ("bf16", "int8"):
+        cb = ContinuousBatcher(runner, buckets=(8,), max_batch=3,
+                               max_queue=16, paged=True, page=4,
+                               kv_dtype=dt)
+        try:
+            got = [cb.submit(np.asarray(p, np.int32),
+                             deadline_ms=60_000).wait(60).tolist()
+                   for p in prompts]
+            assert got == want, (dt, got)
+        finally:
+            cb.close()
+
+
+def test_quantized_kv_requires_paged(mv_env):
+    from multiverso_tpu.serving import ContinuousBatcher
+    from multiverso_tpu.utils.log import FatalError
+
+    runner, _, _ = _lm(max_new=2, max_batch=1)
+    with pytest.raises((FatalError, RuntimeError)):
+        ContinuousBatcher(runner, buckets=(8,), max_batch=1,
+                          paged=False, kv_dtype="int8")
+
+
+class _StubReplica:
+    """A frozen one-table replica snapshot without checkpoint plumbing."""
+
+    def __init__(self, data, dtype):
+        from multiverso_tpu.serving.quant import encode_table
+        from multiverso_tpu.serving.replica import ReplicaSnapshot
+        self._snap = ReplicaSnapshot(
+            3, "stub", {"emb": encode_table(data, dtype)}, dtype)
+
+    def snapshot(self):
+        return self._snap
+
+
+def test_replica_table_dtype_storage(mv_env):
+    """f32 replica lookups stay bitwise; bf16/int8 dequant-on-read stays
+    within the codec's bound — through the real runner dispatch path."""
+    from multiverso_tpu.serving import ReplicaLookupRunner
+    from multiverso_tpu.serving.quant import roundtrip_bound
+
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(64, 16)).astype(np.float32)
+    keys = rng.integers(0, 64, 8).astype(np.int32)
+    mat = np.zeros((2, 8), np.int32)
+    mat[0] = keys
+    lens = np.asarray([8, 0], np.int32)
+    for dt in ("f32", "bf16", "int8"):
+        runner = ReplicaLookupRunner(_StubReplica(data, dt), "emb")
+        out = runner.run(mat, lens)
+        got = runner.slice_result(out, 0, 8)
+        if dt == "f32":
+            assert np.array_equal(got, data[keys])
+        else:
+            assert np.max(np.abs(got - data[keys])) \
+                <= roundtrip_bound(data, dt) + 1e-7
+        assert runner.clock() == 3.0     # the checkpoint step stamp
+
+
+# ---------------------------------------------------------------------------
+# Paged drain path (AttentionLMRunner)
+# ---------------------------------------------------------------------------
+def test_drain_paged_bitwise_and_pool_returns(mv_env):
+    """AttentionLMRunner paged=True: batch tokens bitwise-equal to the
+    preallocated drain decode across buckets, pages freed at collect,
+    one executable per bucket, one pool across buckets."""
+    runner, params, cfg = _lm(max_new=6, max_batch=3)
+    paged, _, _ = _lm(max_new=6, max_batch=3, paged=True, page=4)
+
+    rng = np.random.default_rng(3)
+    for bucket in (8, 4):
+        mat = np.zeros((3, bucket), np.int32)
+        lens = np.zeros(3, np.int32)
+        for i in range(3):
+            n = int(rng.integers(1, bucket + 1))
+            mat[i, :n] = rng.integers(1, 60, n)
+            lens[i] = n
+        assert np.array_equal(runner.run(mat, lens),
+                              paged.run(mat, lens)), bucket
+    assert paged.jit_cache_size() == 2
+    assert paged._pool.used_pages() == 0
+
+
+def test_drain_paged_pool_grows_instead_of_deadlocking(mv_env):
+    """A drain batch larger than the configured pool GROWS the pool
+    (logged + counted) — the correctness valve; serving-side budgets
+    belong to the continuous engine's queueing admission."""
+    from multiverso_tpu.telemetry import get_registry
+
+    paged, _, _ = _lm(max_new=4, max_batch=2, paged=True, page=4,
+                      pool_pages=2)
+    mat = np.zeros((2, 8), np.int32)
+    mat[0, :3] = [5, 9, 2]
+    mat[1, :2] = [7, 3]
+    out = paged.run(mat, np.asarray([3, 2], np.int32))
+    assert out.shape == (2, 4)
+    snap = get_registry().snapshot(buckets=False)
+    assert snap["counters"]["serve.kv.pool_grows"]["value"] >= 1
+    assert paged._pool.used_pages() == 0
+
+
+# ---------------------------------------------------------------------------
+# Satellite regressions: cache-hit stamp + continuous degrade
+# ---------------------------------------------------------------------------
+def test_cache_hit_reply_carries_entry_stamp(mv_env):
+    """ROADMAP 5a: with -serve_cache_staleness>0, a cache-hit reply must
+    claim the STAMP OF ITS BYTES, not runner.clock() (which a fresher
+    batch for other keys may have advanced past the cached rows)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from multiverso_tpu.core.table import ServerStore
+    from multiverso_tpu.core.updater import get_updater
+    from multiverso_tpu.serving import (HotRowCache, ServingClient,
+                                        ServingService,
+                                        SparseLookupRunner)
+
+    rng = np.random.default_rng(0)
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("server",))
+    store = ServerStore("t", (64, 8), np.float32,
+                        get_updater(np.float32, "default"), mesh,
+                        num_workers=1,
+                        init_array=rng.normal(size=(64, 8))
+                        .astype(np.float32))
+    clock = [0.0]
+    svc = ServingService()
+    svc.register_runner(
+        SparseLookupRunner(store, clock_fn=lambda: (clock[0], 0.0),
+                           cache=HotRowCache(64, staleness=2)),
+        buckets=(8,), max_batch=2, max_wait_ms=0.5, continuous=False,
+        pipeline_depth=0)
+    cli = ServingClient(*svc.address)
+    try:
+        keys_a = np.asarray([1, 2, 3], np.int32)
+        vals_a, c_a = cli.request_async(keys_a,
+                                        deadline_ms=10_000).wait(30)
+        assert c_a == 0
+        clock[0] = 1.0      # training tick: a fresh batch for OTHER keys
+        _, c_b = cli.request_async(np.asarray([9, 10], np.int32),
+                                   deadline_ms=10_000).wait(30)
+        assert c_b == 1     # runner.clock() now reads 1
+        vals_hit, c_hit = cli.request_async(keys_a,
+                                            deadline_ms=10_000).wait(30)
+        assert np.array_equal(vals_hit, vals_a)
+        assert c_hit == 0, \
+            "cache-hit reply claimed a newer version than its bytes"
+    finally:
+        cli.close()
+        svc.close()
+
+
+class _UnsupportedDecodeRunner:
+    """A decode runner for a checkpoint shape ContinuousBatcher refuses
+    (MoE / pipeline attention_lm)."""
+
+    name = "unsupported_lm"
+    payload_dtype = np.int32
+    pad_id = 0
+    max_new = 4
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def params_ref(self):
+        return {}
+
+    def run(self, batch, lengths):
+        return np.zeros((batch.shape[0], self.max_new), np.int32)
+
+    def slice_result(self, out, i, length):
+        return out[i]
+
+    def jit_cache_size(self):
+        return 0
+
+
+@pytest.mark.parametrize("shape", ["moe", "pipeline"])
+def test_continuous_degrades_to_drain_on_unsupported_checkpoints(
+        mv_env, shape):
+    """ROADMAP 5b: -serve_continuous=true on a MoE/pipeline attention_lm
+    checkpoint degrades to drain batching (logged) instead of crashing
+    serving bring-up — and the degraded service still answers."""
+    from multiverso_tpu.models.attention_lm import LMConfig
+    from multiverso_tpu.serving import (ContinuousBatcher, DynamicBatcher,
+                                        ServingService)
+
+    cfg = LMConfig(moe_experts=2) if shape == "moe" \
+        else LMConfig(pipeline_stages=2, layers=2)
+    svc = ServingService()
+    try:
+        svc.register_runner(_UnsupportedDecodeRunner(cfg), buckets=(8,),
+                            max_batch=2, continuous=True,
+                            pipeline_depth=0)
+        b = svc.batcher(0)
+        assert isinstance(b, DynamicBatcher)
+        assert not isinstance(b, ContinuousBatcher)
+        out = b.submit(np.asarray([1, 2], np.int32),
+                       deadline_ms=10_000).wait(30)
+        assert out.shape == (4,)
+    finally:
+        svc.close()
+
+
+def test_paged_through_service_with_swap(mv_env):
+    """Full plane, paged flavor: register with continuous+paged+prefix,
+    serve decodes over the wire, hot-swap params mid-life — the NEXT
+    request serves the new weights (prefix store invalidated)."""
+    import jax
+
+    from multiverso_tpu.models.attention_lm import init_params
+    from multiverso_tpu.serving import ServingClient, ServingService
+
+    runner, _, cfg = _lm(max_new=5, max_batch=2)
+    svc = ServingService()
+    svc.register_runner(runner, buckets=(8,), max_batch=2,
+                        max_wait_ms=1.0, continuous=True, paged=True,
+                        kv_dtype="f32", kv_page=4, kv_pages=0,
+                        prefix_entries=8)
+    assert svc.warmup() == 2
+    cli = ServingClient(*svc.address)
+    try:
+        prompt = [5, 9, 2]
+        want = _solo_drain_tokens(runner, prompt, bucket=8)
+        got = cli.generate(np.asarray(prompt, np.int32),
+                           deadline_ms=60_000, timeout=120)
+        assert got.tolist() == want
+        # repeat -> prefix hit over the wire
+        got = cli.generate(np.asarray(prompt, np.int32),
+                           deadline_ms=60_000, timeout=120)
+        assert got.tolist() == want
+
+        runner.swap_params({k: np.asarray(v) for k, v in init_params(
+            cfg, jax.random.PRNGKey(9)).items()})
+        want2 = _solo_drain_tokens(runner, prompt, bucket=8)
+        assert want2 != want
+        got2 = cli.generate(np.asarray(prompt, np.int32),
+                            deadline_ms=60_000, timeout=120)
+        assert got2.tolist() == want2
+    finally:
+        cli.close()
+        svc.close()
+
+
+def test_paged_quiesce_and_cancel_release_claims(mv_env):
+    """Shed paths must release reserved pages/pins: cancel a queued
+    request while the single slot is busy, then quiesce — the pool must
+    drain to zero used pages (no leaked claims)."""
+    from multiverso_tpu.serving import ContinuousBatcher, ShedError
+
+    runner, _, _ = _lm(max_new=12, max_batch=1)
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=1,
+                           max_queue=8, paged=True, page=4)
+    try:
+        running = cb.submit(np.asarray([5, 9, 2], np.int32),
+                            deadline_ms=60_000)
+        done = threading.Event()
+        outcome = []
+
+        def on_done(result):
+            outcome.append(result)
+            done.set()
+
+        token = cb.submit_callback(np.asarray([7], np.int32), 60_000.0,
+                                   on_done)
+        if token is not None and cb.cancel(token):
+            assert done.wait(30)
+            assert isinstance(outcome[0], ShedError)
+        running.wait(60)
+        assert cb.quiesce(timeout_s=60)
+    finally:
+        cb.close()
+    assert cb.pool.used_pages() == 0
+
+
+# ---------------------------------------------------------------------------
+# Review-fix regressions: never-fits shed, retention reclaim, params
+# token soundness, config fail-fast
+# ---------------------------------------------------------------------------
+def test_request_larger_than_pool_is_shed_not_hung(mv_env):
+    """A request whose page need exceeds TOTAL pool capacity can never
+    be served by waiting — it must shed with a clear reason instead of
+    queueing forever (and the worker must not wedge)."""
+    from multiverso_tpu.serving import ContinuousBatcher, ShedError
+
+    runner, _, _ = _lm(max_new=6, max_batch=2)
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=2,
+                           max_queue=8, paged=True, page=4,
+                           pool_pages=1)     # one page: nothing fits
+    try:
+        with pytest.raises(ShedError) as e:
+            cb.submit(np.asarray([7, 3, 3, 3, 8, 2, 40], np.int32),
+                      deadline_ms=60_000).wait(30)
+        assert e.value.reason == "oversize"
+        # the batcher is still alive for admission-level decisions
+        with pytest.raises(ShedError):
+            cb.submit(np.arange(9, dtype=np.int32) + 1,
+                      deadline_ms=60_000).wait(30)
+    finally:
+        cb.close()
+
+
+def test_prefix_retention_yields_pages_to_live_admissions(mv_env):
+    """Store-retained pages must never starve the pool: with a pool
+    sized for ~one request and a prefix store holding the previous
+    prompt's pages, the NEXT (different) prompt must still complete —
+    the allocator reclaims LRU entries instead of queueing forever."""
+    from multiverso_tpu.serving import ContinuousBatcher
+    from multiverso_tpu.telemetry import get_registry
+
+    runner, _, _ = _lm(max_new=6, max_batch=2)
+    prompts = [[7, 3, 3, 3, 8, 2, 40], [5, 9, 2], [1, 2, 3, 4, 5, 6]]
+    solo = [_solo_drain_tokens(runner, p, bucket=8) for p in prompts]
+    cb = ContinuousBatcher(runner, buckets=(8,), max_batch=2,
+                           max_queue=8, paged=True, page=4,
+                           pool_pages=4, prefix_entries=8)
+    try:
+        for p, want in zip(prompts, solo):
+            got = cb.submit(np.asarray(p, np.int32),
+                            deadline_ms=60_000).wait(60).tolist()
+            assert got == want, p
+        snap = get_registry().snapshot(buckets=False)
+        assert snap["counters"]["serve.kv.page_evictions"]["value"] >= 1
+    finally:
+        cb.close()
+
+
+def test_params_token_is_monotonic_not_identity(mv_env):
+    """The prefix store's weights token must be the runner's monotonic
+    swap version — id() of the params dict can be REUSED by the
+    allocator after two swaps, silently validating stale entries."""
+    import jax
+
+    from multiverso_tpu.models.attention_lm import init_params
+
+    runner, _, cfg = _lm(max_new=2, max_batch=1)
+    _, v0 = runner.params_versioned()
+    runner.swap_params({k: np.asarray(v) for k, v in init_params(
+        cfg, jax.random.PRNGKey(1)).items()})
+    _, v1 = runner.params_versioned()
+    runner.swap_params({k: np.asarray(v) for k, v in init_params(
+        cfg, jax.random.PRNGKey(2)).items()})
+    _, v2 = runner.params_versioned()
+    assert v0 < v1 < v2
+
+
+def test_register_runner_bad_paged_config_fails_fast(mv_env):
+    """A flag MISCONFIGURATION (quantized KV without paged mode, bad
+    dtype, zero page) must crash bring-up loudly — only genuine
+    checkpoint-layout incompatibilities degrade to drain batching."""
+    from multiverso_tpu.models.attention_lm import LMConfig
+    from multiverso_tpu.serving import ServingService
+    from multiverso_tpu.utils.log import FatalError
+
+    svc = ServingService()
+    try:
+        for kw in ({"paged": False, "kv_dtype": "int8"},
+                   {"paged": True, "kv_dtype": "fp4"},
+                   {"paged": True, "kv_page": 0},
+                   {"paged": False, "prefix_entries": 8}):
+            cfg_kw = dict(paged=False, kv_dtype="f32", kv_page=4,
+                          kv_pages=0, prefix_entries=0)
+            cfg_kw.update(kw)
+            with pytest.raises((FatalError, RuntimeError)):
+                svc.register_runner(
+                    _UnsupportedDecodeRunner(LMConfig()), buckets=(8,),
+                    max_batch=2, continuous=True, pipeline_depth=0,
+                    **cfg_kw)
+    finally:
+        svc.close()
