@@ -624,13 +624,42 @@ def main() -> None:
     try:
         from scripts.comm_bench import (auto_evidence,
                                         bench_logreg_policies,
+                                        bench_ma_convergence,
                                         bench_word2vec_policies)
         comm_block = {"word2vec": bench_word2vec_policies(False),
                       "logreg": bench_logreg_policies(False)}
         comm_block["auto"] = auto_evidence(comm_block["word2vec"],
                                            comm_block["logreg"])
+        comm_block["ma_convergence"] = bench_ma_convergence(False)
     except Exception as e:  # noqa: BLE001 - policy leg is best-effort
         _log(f"comm-policy leg skipped: {e}")
+    # Sharded-optimizer-state + fused-stateful-kernel legs
+    # (scripts/state_bench.py; docs/DESIGN.md "Sharded updater state").
+    # Best-effort: on a 1-device chip the replica axis is absent and the
+    # memory leg records that instead of a reduction.
+    state_block = {}
+    try:
+        from scripts.state_bench import (bench_sharded_parity_witness,
+                                         bench_state_memory,
+                                         bench_stateful_sparse)
+        state_block = {
+            "state_memory": bench_state_memory(False),
+            "stateful_sparse": bench_stateful_sparse(False),
+            "sharded_parity": bench_sharded_parity_witness(False),
+        }
+    except Exception as e:  # noqa: BLE001 - state leg is best-effort
+        _log(f"state-sharding leg skipped: {e}")
+    if state_block:
+        try:   # fold the memory witness into the outage-provenance file
+            latest_path = os.path.join(here, "BENCH_LATEST.json")
+            with open(latest_path) as f:
+                latest = json.load(f)
+            latest["state_memory"] = state_block.get("state_memory")
+            latest["sharded_parity"] = state_block.get("sharded_parity")
+            with open(latest_path, "w") as f:
+                json.dump(latest, f)
+        except (OSError, ValueError):
+            pass
     print(json.dumps({
         "metric": "w2v_words_per_sec",
         "value": round(words_per_sec, 1),
@@ -642,6 +671,7 @@ def main() -> None:
                       "serve_lookup_qps": round(serve_qps, 1),
                       **roofline, **_virtual_trend(here),
                       "comm_policy": comm_block,
+                      "state_sharding": state_block,
                       "telemetry": telemetry},
     }))
 

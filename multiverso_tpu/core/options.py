@@ -21,12 +21,20 @@ import numpy as np
 
 @dataclasses.dataclass
 class AddOption:
-    """Per-Add hyperparameters (ref updater.h:10-70)."""
+    """Per-Add hyperparameters (ref updater.h:10-70).
+
+    ``staleness`` is a TPU-era addition the reference struct lacks: the
+    MEASURED clock lag of this worker at add time (SSP staleness), fed by
+    the sync coordinator / PS service when ``-staleness_adaptive`` is on.
+    Negative means unmeasured — staleness-aware updaters (DC-ASGD) keep
+    their fixed lambda then, so the default is behavior-preserving.
+    """
     worker_id: int = 0
     momentum: float = 0.0
     learning_rate: float = 0.1
     rho: float = 0.1
     lambda_: float = 0.0
+    staleness: float = -1.0
 
     def scalars(self):
         """Pack numeric fields as device-friendly scalars for jit args."""
@@ -36,6 +44,7 @@ class AddOption:
             np.float32(self.learning_rate),
             np.float32(self.rho),
             np.float32(self.lambda_),
+            np.float32(self.staleness),
         )
 
 

@@ -178,6 +178,20 @@ class SyncCoordinator:
             self._gets.finish(worker_id)
             self._cv.notify_all()
 
+    def lag(self, worker_id: int) -> float:
+        """This worker's measured add-clock lag behind the most advanced
+        ACTIVE worker — the SSP staleness the DC-ASGD compensation term
+        exists to correct (``-staleness_adaptive`` feeds it into
+        ``AddOption.staleness``). Retired workers (and fully-retired
+        tables) read 0: there is nothing left to be stale against."""
+        with self._cv:
+            vals = [self._adds.value(w) for w in range(self.num_workers)]
+        mine = vals[worker_id]
+        finite = [v for v in vals if v != VectorClock.INF]
+        if not finite or mine == VectorClock.INF:
+            return 0.0
+        return float(max(finite) - mine)
+
     def clock(self) -> Tuple[float, float]:
         """Snapshot version for read-only consumers: the globally committed
         ``(add_min, get_min)`` clocks. The serving plane stamps replies

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import dataclasses
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -34,8 +35,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from multiverso_tpu.core.options import AddOption, GetOption
-from multiverso_tpu.core.updater import Updater
+from multiverso_tpu.core.updater import Updater, pallas_row_capability
 from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.telemetry import gauge
+from multiverso_tpu.utils.configure import get_flag
 from multiverso_tpu.utils.log import check
 
 # XLA's CPU collectives deadlock under concurrent dispatch: a sharded
@@ -57,6 +60,17 @@ from multiverso_tpu.utils.log import check
 _CPU_COLLECTIVE_LOCK = threading.Lock()
 
 
+def _physical_bytes(arr: jax.Array) -> int:
+    """HBM actually held by ``arr`` across the mesh: per-device shard bytes
+    x device count — so replication (a leaf NOT sharded over some mesh
+    axis) counts once per replica, which is exactly the cost the
+    cross-replica state sharding exists to eliminate. Host-side shape
+    arithmetic only (no device sync)."""
+    shard = arr.sharding.shard_shape(arr.shape)
+    return (int(np.prod(shard, dtype=np.int64)) * np.dtype(arr.dtype).itemsize
+            * len(arr.sharding.device_set))
+
+
 class ServerStore:
     """Device-resident sharded storage for one table + its updater state.
 
@@ -69,7 +83,8 @@ class ServerStore:
                  updater: Updater, mesh: jax.sharding.Mesh,
                  num_workers: int, shard_axis: int = 0,
                  init_array: Optional[np.ndarray] = None,
-                 use_pallas_rows: bool = False):
+                 use_pallas_rows: bool = False,
+                 state_sharding: Optional[str] = None):
         self.name = name
         self.logical_shape = tuple(int(s) for s in shape)
         self.dtype = np.dtype(dtype)
@@ -98,35 +113,82 @@ class ServerStore:
 
         # Updater state: shard each leaf along the same logical axis, shifted
         # by any leading worker axis (AdaGrad's [num_workers, ...] g2).
+        # Cross-replica state sharding (arXiv 2004.13336; docs/DESIGN.md
+        # "Sharded updater state"): on a mesh with a replica ("worker")
+        # axis the data stays replicated across it (row lookups/serving
+        # read it without collectives) but P(server) state leaves would be
+        # replicated too — pure waste, since the update math is
+        # elementwise. Sharding each leaf's row axis over (server, worker)
+        # instead holds 1/k of the state per replica; the update step
+        # slices the delta onto the state shard and all-gathers only the
+        # updated data rows, and because no cross-element reduction exists
+        # in any updater the params stay BITWISE-equal to the unsharded
+        # layout (tested, pow-2 axes).
+        mode = (state_sharding if state_sharding is not None
+                else get_flag("state_sharding"))
+        check(mode in ("auto", "on", "off"),
+              f"state_sharding must be auto|on|off, got {mode!r}")
+        replicas = mesh.shape.get(mesh_lib.WORKER_AXIS, 1)
+        self.state_replicas = replicas
+        want_sharded = mode != "off" and replicas > 1
         state_host = updater.init_state(self.padded_shape, self.dtype,
                                         num_workers)
+        check(not (mode == "on" and replicas < 2 and state_host),
+              f"state_sharding=on: table '{name}' carries updater state "
+              "but the mesh has no replica ('worker') axis to shard it "
+              "over — add one (e.g. -mesh_shape=server:N,worker:K) or "
+              "use auto/off")
         self.state = {}
+        self.state_sharded = False
         for key, leaf in state_host.items():
-            leaf_axis = shard_axis + (leaf.ndim - len(self.padded_shape))
-            leaf_sharding = mesh_lib.table_sharding(mesh, leaf.ndim, leaf_axis)
+            leaf_axis = self._leaf_axis(leaf.ndim)
+            axes: Any = mesh_lib.SERVER_AXIS
+            if want_sharded and \
+                    leaf.shape[leaf_axis] % (num_servers * replicas) == 0:
+                axes = (mesh_lib.SERVER_AXIS, mesh_lib.WORKER_AXIS)
+                self.state_sharded = True
+            else:
+                check(not (want_sharded and mode == "on"),
+                      f"state_sharding=on: leaf '{key}' of table '{name}' "
+                      f"(axis {leaf_axis} extent {leaf.shape[leaf_axis]}) "
+                      f"does not divide server x replica = "
+                      f"{num_servers * replicas}")
+            leaf_sharding = mesh_lib.table_sharding(mesh, leaf.ndim,
+                                                    leaf_axis,
+                                                    mesh_axis=axes)
             self.state[key] = jax.device_put(leaf, leaf_sharding)
 
-        # Opt-in Pallas row data plane (DMA gather / sorted scatter-add,
-        # ops/pallas_rows.py). Eligibility (widened round 2): 2-D float32
-        # tables, plain-add or SGD updaters (sign-flipped scatter), single
-        # shard. bf16 is EXCLUDED on measured grounds: Mosaic packs 2-byte
-        # types two rows per sublane in HBM ((8,128)(2,1) tiling), so the
-        # kernels' single-row DMA slices fail to compile on real chips
-        # ("Slice shape along dimension 0 must be aligned to tiling").
-        # Multi-shard stays XLA: the row kernel would need per-shard offset
-        # remapping under shard_map, and XLA's sharded scatter already
-        # overlaps the collective with the update.
-        self._pallas_rows = bool(
-            use_pallas_rows
-            and len(self.padded_shape) == 2
-            and np.dtype(self.dtype) == np.dtype(np.float32)
-            and num_servers == 1
-            and type(updater).__name__ in ("Updater", "SGDUpdater"))
+        # Opt-in Pallas row data plane (DMA gather / sorted scatter-add /
+        # fused stateful gather-update-scatter, ops/pallas_rows.py),
+        # selected through the per-updater capability registry
+        # (core/updater.PALLAS_ROW_CAPABILITY). Eligibility: 2-D float32
+        # tables, single shard, unsharded state (the fused kernel owns
+        # whole rows). bf16 is EXCLUDED on measured grounds: Mosaic packs
+        # 2-byte types two rows per sublane in HBM ((8,128)(2,1) tiling),
+        # so the kernels' single-row DMA slices fail to compile on real
+        # chips ("Slice shape along dimension 0 must be aligned to
+        # tiling"). Multi-shard stays XLA: the row kernels would need
+        # per-shard offset remapping under shard_map, and XLA's sharded
+        # scatter already overlaps the collective with the update.
+        self._pallas_cap = None
+        if (use_pallas_rows and len(self.padded_shape) == 2
+                and np.dtype(self.dtype) == np.dtype(np.float32)
+                and num_servers == 1):
+            cap = pallas_row_capability(updater)
+            if cap in ("scatter_add", "scatter_sub") or (
+                    cap == "fused_stateful" and not self.state_sharded):
+                self._pallas_cap = cap
+        self._pallas_rows = self._pallas_cap is not None
         self._build_kernels()
         self._lock = threading.Lock()
         devices = list(self.sharding.device_set)
         self._serial_exec = (len(devices) > 1
                              and devices[0].platform == "cpu")
+        # Memory accounting (docs/OBSERVABILITY.md): host-computed at
+        # init/load/publish — never on the hot path.
+        self._g_data_bytes = gauge(f"ps.data_bytes.{name}")
+        self._g_state_bytes = gauge(f"ps.state_bytes.{name}")
+        self._publish_memory_gauges()
 
     @contextlib.contextmanager
     def _dispatch_scope(self):
@@ -159,16 +221,67 @@ class ServerStore:
         pad = self._pad
         axis = self.shard_axis
         ndim = len(self.padded_shape)
+        # Pin kernel outputs to the live layouts so (a) donation reuses
+        # the input buffers (mismatched layouts silently fall back to
+        # copies) and (b) sharded state stays sharded: GSPMD slices the
+        # replicated delta onto each state shard (the reduce-scatter leg
+        # of 2004.13336 — a plain dynamic-slice here because the store
+        # receives the already-merged delta) and all-gathers only the
+        # updated data rows back to the replicated param layout.
+        state_shardings = {k: v.sharding for k, v in self.state.items()}
+        pin_layouts = len(self.sharding.device_set) > 1
+
+        def _pin(data, state):
+            if not pin_layouts:
+                return data, state
+            data = jax.lax.with_sharding_constraint(data, self.sharding)
+            state = {k: jax.lax.with_sharding_constraint(
+                v, state_shardings[k]) for k, v in state.items()}
+            return data, state
+
+        # Dense plane under sharded state: run the updater MATH in the
+        # unsharded (server-only) state layout and reshard the results.
+        # Elementwise math is layout-invariant in exact arithmetic, but
+        # XLA:CPU's codegen is not — fusing the same chain over
+        # differently-partitioned operands contracts mul/sub into fma (and
+        # div/sqrt into rsqrt) differently, measured as ~tens-of-ulp drift
+        # on the adagrad/dcasgd dense path (the PR-10 allreduce rounding
+        # story again). Gathering state to the off-mode layout makes the
+        # math HLO identical in both modes — bitwise parity by structure —
+        # at the cost of a TRANSIENT full-size state working set on dense
+        # updates only; the row plane (the capacity-critical embedding hot
+        # path) computes on gathered row blocks, which are layout-invariant
+        # already, and stays shard-local end to end.
+        math_shardings = {
+            k: mesh_lib.table_sharding(self.mesh, self.state[k].ndim,
+                                       self._leaf_axis(self.state[k].ndim))
+            for k in self.state}
+        gather_for_dense = self.state_sharded
 
         def dense(data, state, delta, *opt):
             if pad:
                 pads = [(0, 0)] * ndim
                 pads[axis] = (0, pad)
                 delta = jnp.pad(delta, pads)
-            return updater.update_dense(data, state, delta, opt)
+            if gather_for_dense:
+                state = {k: jax.lax.with_sharding_constraint(
+                    v, math_shardings[k]) for k, v in state.items()}
+                new_data, new_state = updater.update_dense(data, state,
+                                                           delta, opt)
+                # Pin the math RESULTS to the unsharded layout too before
+                # resharding for storage: without this, GSPMD propagates
+                # the sharded storage layout backwards through shared
+                # subexpressions (adagrad's g2_w feeds both the step and
+                # the stored accumulator) and the math region partitions
+                # differently from the off mode after all.
+                new_state = {k: jax.lax.with_sharding_constraint(
+                    v, math_shardings[k]) for k, v in new_state.items()}
+                return _pin(new_data, new_state)
+            return _pin(*updater.update_dense(data, state, delta, opt))
 
         def rows(data, state, row_ids, delta, *opt):
-            return updater.update_rows(data, state, row_ids, delta, opt)
+            return _pin(*updater.update_rows(data, state, row_ids, delta,
+                                             opt))
 
         def access(data):
             if pad:
@@ -182,21 +295,37 @@ class ServerStore:
 
         self._dense_update = jax.jit(dense, donate_argnums=(0, 1))
         if self._pallas_rows:
-            from multiverso_tpu.ops.pallas_rows import (gather_rows,
+            from multiverso_tpu.ops.pallas_rows import (fused_stateful_rows,
+                                                        gather_rows,
                                                         scatter_add_rows)
 
             # Mosaic kernels need the interpreter on CPU backends (tests).
             interpret = jax.default_backend() == "cpu"
-            # SGD applies data -= delta (client pre-scales lr).
-            sign = (-1.0 if type(self.updater).__name__ == "SGDUpdater"
-                    else 1.0)
 
-            def pallas_rows_update(data, state, row_ids, delta, *opt):
-                del opt
-                return (scatter_add_rows(data, row_ids,
-                                         delta.astype(data.dtype),
-                                         interpret=interpret, sign=sign),
-                        state)
+            if self._pallas_cap == "fused_stateful":
+                from multiverso_tpu.core.updater import combine_duplicate_rows
+
+                def pallas_rows_update(data, state, row_ids, delta, *opt):
+                    # Same duplicate folding as the XLA path (stateful
+                    # set-semantics must combine, not accumulate), then
+                    # ONE fused gather-update-scatter dispatch over data
+                    # + every state leaf.
+                    rows_eff, delta_c = combine_duplicate_rows(
+                        row_ids, delta.astype(data.dtype), data.shape[0])
+                    return fused_stateful_rows(data, state, rows_eff,
+                                               delta_c, opt, updater,
+                                               interpret=interpret)
+            else:
+                # SGD applies data -= delta (client pre-scales lr).
+                sign = -1.0 if self._pallas_cap == "scatter_sub" else 1.0
+
+                def pallas_rows_update(data, state, row_ids, delta, *opt):
+                    del opt
+                    return (scatter_add_rows(data, row_ids,
+                                             delta.astype(data.dtype),
+                                             interpret=interpret,
+                                             sign=sign),
+                            state)
 
             def pallas_access_rows(data, row_ids):
                 return gather_rows(data, row_ids, interpret=interpret)
@@ -271,26 +400,81 @@ class ServerStore:
         with self._dispatch_scope():
             self.data = jax.device_put(host, self.sharding)
 
+    # -- memory accounting (docs/OBSERVABILITY.md ps.*_bytes) --------------
+    def data_bytes(self) -> int:
+        """Physical parameter bytes held across the mesh (replication
+        counted per copy)."""
+        return _physical_bytes(self.data)
+
+    def state_bytes(self) -> int:
+        """Physical updater-state bytes held across the mesh — the number
+        the cross-replica sharding shrinks by ~(k-1)/k."""
+        return sum(_physical_bytes(leaf) for leaf in self.state.values())
+
+    def _publish_memory_gauges(self) -> None:
+        self._g_data_bytes.set(self.data_bytes())
+        self._g_state_bytes.set(self.state_bytes())
+
     # -- checkpointing (ref table_interface.h:61-75) -----------------------
+    def _leaf_axis(self, leaf_ndim: int) -> int:
+        """A state leaf's shard axis: the table's, shifted by any leading
+        worker axis (AdaGrad's [num_workers, ...] g2)."""
+        return self.shard_axis + (leaf_ndim - len(self.padded_shape))
+
     def store_state(self) -> Dict[str, np.ndarray]:
+        """Payloads carry LOGICAL extents (shard-axis padding stripped from
+        data and state alike): physical padding depends on the mesh the
+        writer ran on, and a checkpoint must restore onto a mesh with a
+        different server/replica count (load re-pads + re-shards)."""
         out = {"data": np.asarray(self.read())}
+        logical = self.logical_shape[self.shard_axis]
         for key, leaf in self.state.items():
-            out[f"state/{key}"] = np.asarray(leaf)
+            arr = np.asarray(leaf)
+            sl = [slice(None)] * arr.ndim
+            sl[self._leaf_axis(arr.ndim)] = slice(0, logical)
+            out[f"state/{key}"] = arr[tuple(sl)]
         return out
 
     def load_state(self, payload: Dict[str, np.ndarray]) -> None:
-        data = payload["data"]
+        data = np.asarray(payload["data"])
+        check(tuple(data.shape) == self.logical_shape,
+              f"checkpoint data shape {tuple(data.shape)} incompatible "
+              f"with table '{self.name}' {self.logical_shape}")
         host = np.zeros(self.padded_shape, dtype=self.dtype)
         host[tuple(slice(0, s) for s in self.logical_shape)] = data
         self.data = jax.device_put(host, self.sharding)
+        logical = self.logical_shape[self.shard_axis]
         for key in list(self.state):
             saved = payload.get(f"state/{key}")
-            if saved is not None:
-                # Checkpoint backends may widen extension dtypes (bf16) to
-                # f32 for serialization; restore the live leaf's dtype.
-                leaf = self.state[key]
-                self.state[key] = jax.device_put(
-                    np.asarray(saved).astype(leaf.dtype), leaf.sharding)
+            if saved is None:
+                continue
+            leaf = self.state[key]
+            saved = np.asarray(saved)
+            ax = self._leaf_axis(leaf.ndim)
+            # Accept logical-extent saves (current format) and legacy
+            # padded saves (shard-axis extent >= logical; the pad region
+            # was zeros by construction). Every OTHER dim must match
+            # exactly — a different worker count or column width is a
+            # genuinely incompatible checkpoint and must fail loudly, not
+            # silently truncate.
+            check(saved.ndim == leaf.ndim
+                  and all(saved.shape[i] == leaf.shape[i]
+                          for i in range(leaf.ndim) if i != ax)
+                  and saved.shape[ax] >= logical,
+                  f"checkpoint state leaf '{key}' shape "
+                  f"{tuple(saved.shape)} incompatible with live leaf "
+                  f"{tuple(leaf.shape)} of table '{self.name}' "
+                  f"(logical shard-axis extent {logical})")
+            sl = [slice(None)] * leaf.ndim
+            sl[ax] = slice(0, logical)
+            # Checkpoint backends may widen extension dtypes (bf16) to
+            # f32 for serialization; restore the live leaf's dtype. The
+            # device_put with the LIVE sharding is what reshards a
+            # checkpoint written under a different replica count.
+            host_leaf = np.zeros(leaf.shape, dtype=np.dtype(leaf.dtype))
+            host_leaf[tuple(sl)] = saved[tuple(sl)].astype(leaf.dtype)
+            self.state[key] = jax.device_put(host_leaf, leaf.sharding)
+        self._publish_memory_gauges()
 
 
 class WorkerTable:
@@ -329,6 +513,9 @@ class WorkerTable:
             from multiverso_tpu.core.sync_coordinator import SyncCoordinator
             self._sync = SyncCoordinator(zoo.num_local_workers,
                                          name=getattr(self, "name", ""))
+        # SSP staleness-adaptive DC-ASGD (docs/DESIGN.md): feed measured
+        # clock lag into the add options of staleness-aware updaters.
+        self._staleness_adaptive = bool(get_flag("staleness_adaptive"))
 
     # -- BSP gates (no-ops in async mode / single-worker worlds). Context
     # managers so a raise during application releases the in-flight slot
@@ -340,13 +527,24 @@ class WorkerTable:
 
     @contextlib.contextmanager
     def _bsp_add(self, option: Optional[AddOption]):
+        """Gate + stamp: yields the AddOption the caller must dispatch
+        with. Under ``-staleness_adaptive`` with a staleness-aware updater
+        (DC-ASGD family), the yielded option carries this worker's
+        MEASURED add-clock lag (sampled after the gate admits the add, so
+        it reflects the committed updates the worker's view is actually
+        missing); otherwise the option passes through untouched."""
+        opt = option or AddOption()
         if self._sync is None:
-            yield
+            yield opt
             return
-        wid = self._local_wid(option.worker_id if option else 0)
+        wid = self._local_wid(opt.worker_id)
         self._sync.acquire_add(wid)
+        if (self._staleness_adaptive and opt.staleness < 0
+                and getattr(self.store.updater, "staleness_aware", False)):
+            opt = dataclasses.replace(opt,
+                                      staleness=self._sync.lag(wid))
         try:
-            yield
+            yield opt
         except BaseException:
             self._sync.abort_add(wid)
             raise

@@ -20,6 +20,7 @@ Per-worker AdaGrad accumulators (``adagrad_updater.h:17-20``) are kept as a
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Dict, Tuple
 
 import jax
@@ -28,10 +29,96 @@ import numpy as np
 
 from multiverso_tpu.utils.configure import get_flag
 
+
+@functools.lru_cache(maxsize=1)
+def _strict_rows_math() -> bool:
+    """XLA:CPU only: run row-block updater math one materialized primitive
+    at a time. The CPU backend's LLVM codegen contracts mul+add chains to
+    fma PER FUSION GROUP — the same math fused into a scatter kernel, an
+    interpret-mode Pallas body, or a standalone region rounds differently
+    per element (vector body vs scalar tail even diverge within one
+    array). Materializing every intermediate pins each primitive to its
+    strict IEEE result, making the XLA and Pallas row planes bitwise-equal
+    BY VALUE (both match eager arithmetic). Real accelerator backends keep
+    the fully fused math — this is a CPU-codegen determinism valve, not a
+    semantics change."""
+    return jax.default_backend() == "cpu"
+
+
+def _eval_jaxpr_contraction_proof(jaxpr, consts, guard, *args):
+    """Evaluate a jaxpr routing every float result through a division by
+    a RUNTIME-opaque 1.0 (``select(guard, 1, 2)`` with an always-true
+    runtime guard). ``x / 1.0`` is an exact IEEE identity, and it defeats
+    the two XLA:CPU codegen behaviors that break cross-plane bitwise
+    parity of identical math:
+
+    * LLVM contracts ``fadd(fmul(a, b), c)`` to fma inside one fused
+      loop — with the divide between them the add's operand is no longer
+      a multiply;
+    * XLA's fusion pass DUPLICATES cheap producers into every consumer
+      fusion, and each copy may contract differently — so one jaxpr var
+      can yield two different values (measured: a momentum ``smooth``
+      fed both the state scatter and the data subtract with a 1-ulp
+      split). Divides are "expensive" instructions XLA refuses to
+      duplicate, so every consumer reads the same materialized bytes.
+
+    ``optimization_barrier`` does NOT work for any of this — the
+    pipeline elides it before fusion (verified: barrier count 0 in the
+    optimized HLO)."""
+    env: Dict[Any, Any] = {}
+    one = jnp.where(guard, np.float32(1.0), np.float32(2.0))
+
+    def read(v):
+        return v.val if isinstance(v, jax.core.Literal) else env[v]
+
+    for var, val in zip(jaxpr.constvars, consts):
+        env[var] = val
+    for var, val in zip(jaxpr.invars, args):
+        env[var] = val
+    for eqn in jaxpr.eqns:
+        outs = eqn.primitive.bind(*[read(v) for v in eqn.invars],
+                                  **eqn.params)
+        if not eqn.primitive.multiple_results:
+            outs = [outs]
+        for var, val in zip(eqn.outvars, outs):
+            if jnp.issubdtype(val.dtype, jnp.floating):
+                val = val / one.astype(val.dtype)
+            env[var] = val
+    return [read(v) for v in jaxpr.outvars]
+
+
+def exact_elementwise(fn: Callable) -> Callable:
+    """Wrap ``fn`` so its floating-point math rounds strictly per
+    primitive (see :func:`_strict_rows_math`); pass-through off-CPU.
+    ``guard`` must be a RUNTIME scalar bool that is always true (e.g.
+    ``worker_id >= 0``) — the compiler must not be able to fold it."""
+    def wrapped(guard, *args):
+        if not _strict_rows_math():
+            return fn(*args)
+        flat, in_tree = jax.tree_util.tree_flatten(args)
+        out_tree_box = []
+
+        def flat_fn(*leaves):
+            out = fn(*jax.tree_util.tree_unflatten(in_tree, leaves))
+            out_flat, out_tree = jax.tree_util.tree_flatten(out)
+            out_tree_box.append(out_tree)
+            return out_flat
+        closed = jax.make_jaxpr(flat_fn)(*flat)
+        outs = _eval_jaxpr_contraction_proof(closed.jaxpr, closed.consts,
+                                             guard, *flat)
+        return jax.tree_util.tree_unflatten(out_tree_box[0], outs)
+    return wrapped
+
 # state pytree: dict[str, jax.Array] (possibly empty)
 State = Dict[str, jax.Array]
-# scalars: (worker_id, momentum, learning_rate, rho, lambda_)
-Scalars = Tuple[Any, Any, Any, Any, Any]
+# scalars: (worker_id, momentum, learning_rate, rho, lambda_, staleness)
+Scalars = Tuple[Any, ...]
+
+
+def _opt_staleness(opt: Scalars):
+    """Measured clock lag, or -1 when the caller predates the 6th scalar
+    (older wire peers / direct test callers pass 5-tuples)."""
+    return opt[5] if len(opt) > 5 else np.float32(-1.0)
 
 
 def combine_duplicate_rows(rows: jax.Array, delta: jax.Array, num_rows: int
@@ -63,9 +150,25 @@ def combine_duplicate_rows(rows: jax.Array, delta: jax.Array, num_rows: int
 
 
 class Updater:
-    """Base: plain accumulate — ``data += delta`` (ref updater.cpp:19-29)."""
+    """Base: plain accumulate — ``data += delta`` (ref updater.cpp:19-29).
+
+    Class contract consumed by the store / kernel layers:
+
+    * ``per_worker_state`` — state-leaf names carrying a leading
+      ``[num_workers]`` axis (indexed by the ``worker_id`` scalar);
+    * ``staleness_aware`` — True when ``opt``'s staleness scalar changes
+      the math (DC-ASGD family), so callers know when to measure it;
+    * ``rows_math(d_rows, state_rows, delta, opt)`` — the PER-ROW update
+      math on already-gathered row blocks, shared verbatim between the
+      XLA scatter path (:meth:`update_rows` via ``_rows_update_via_math``)
+      and the fused Pallas gather-update-scatter kernel
+      (:mod:`multiverso_tpu.ops.pallas_rows`) — one implementation is the
+      structural bitwise-parity guarantee between the two planes.
+    """
 
     name = "default"
+    per_worker_state: Tuple[str, ...] = ()
+    staleness_aware = False
 
     def init_state(self, shape: Tuple[int, ...], dtype: Any,
                    num_workers: int) -> State:
@@ -81,6 +184,42 @@ class Updater:
                     delta: jax.Array, opt: Scalars) -> Tuple[jax.Array, State]:
         del opt
         return data.at[rows].add(delta, mode="drop"), state
+
+    # -- shared row-block machinery (stateful subclasses) -------------------
+    def rows_math(self, d_rows: jax.Array, state_rows: State,
+                  delta: jax.Array, opt: Scalars
+                  ) -> Tuple[jax.Array, State]:
+        raise NotImplementedError(f"{self.name} has no row-block math")
+
+    def _rows_update_via_math(self, data, state, rows, delta, opt):
+        """Gather touched rows of data AND state, apply :meth:`rows_math`,
+        scatter both back (``mode="drop"`` discards the duplicate-run
+        sentinels ``combine_duplicate_rows`` emits). ``data.at[r].set(
+        d_rows - step)`` is bitwise-identical to the historical
+        ``data.at[r].add(-step)`` (IEEE: a - b == a + (-b)); the gather
+        makes the data rows available to the shared math, which is what
+        lets the Pallas kernel run the exact same function."""
+        wid = opt[0]
+        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
+        d_rows = jnp.take(data, rows, axis=0, mode="clip")
+        st_rows: State = {}
+        for key, leaf in state.items():
+            src = leaf[wid] if key in self.per_worker_state else leaf
+            st_rows[key] = jnp.take(src, rows, axis=0, mode="clip")
+        # exact_elementwise: on XLA:CPU the math rounds strictly per
+        # primitive so this plane and the fused Pallas kernel agree
+        # bitwise (see _strict_rows_math); accelerators keep the fully
+        # fused math. worker_id >= 0 is the runtime-true guard.
+        new_d, new_st = exact_elementwise(self.rows_math)(
+            wid >= 0, d_rows, st_rows, delta, opt)
+        out_state: State = {}
+        for key, leaf in state.items():
+            if key in self.per_worker_state:
+                out_state[key] = leaf.at[wid, rows].set(new_st[key],
+                                                        mode="drop")
+            else:
+                out_state[key] = leaf.at[rows].set(new_st[key], mode="drop")
+        return data.at[rows].set(new_d, mode="drop"), out_state
 
 
 class SGDUpdater(Updater):
@@ -112,13 +251,13 @@ class MomentumUpdater(Updater):
         smooth = m * state["smooth"] + (1 - m) * delta
         return data - smooth, {"smooth": smooth}
 
+    def rows_math(self, d_rows, state_rows, delta, opt):
+        m = opt[1].astype(d_rows.dtype)
+        smooth_rows = m * state_rows["smooth"] + (1 - m) * delta
+        return d_rows - smooth_rows, {"smooth": smooth_rows}
+
     def update_rows(self, data, state, rows, delta, opt):
-        m = opt[1].astype(data.dtype)
-        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
-        prev = jnp.take(state["smooth"], rows, axis=0, mode="clip")
-        smooth_rows = m * prev + (1 - m) * delta
-        smooth = state["smooth"].at[rows].set(smooth_rows, mode="drop")
-        return data.at[rows].add(-smooth_rows, mode="drop"), {"smooth": smooth}
+        return self._rows_update_via_math(data, state, rows, delta, opt)
 
 
 class AdaGradUpdater(Updater):
@@ -135,6 +274,7 @@ class AdaGradUpdater(Updater):
 
     name = "adagrad"
     eps = 1e-6
+    per_worker_state = ("g2",)
 
     def init_state(self, shape, dtype, num_workers):
         return {"g2": jnp.zeros((max(num_workers, 1),) + tuple(shape),
@@ -146,22 +286,22 @@ class AdaGradUpdater(Updater):
         return d32 / lr_safe
 
     def update_dense(self, data, state, delta, opt):
-        worker_id, _, lr, rho, _ = opt
+        worker_id, _, lr, rho = opt[0], opt[1], opt[2], opt[3]
         g = self._grad(delta.astype(jnp.float32), lr)
         g2_w = state["g2"][worker_id] + jnp.square(g)
         g2 = state["g2"].at[worker_id].set(g2_w)
         step = rho / jnp.sqrt(g2_w + self.eps) * g
         return data - step.astype(data.dtype), {"g2": g2}
 
-    def update_rows(self, data, state, rows, delta, opt):
-        worker_id, _, lr, rho, _ = opt
-        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
+    def rows_math(self, d_rows, state_rows, delta, opt):
+        lr, rho = opt[2], opt[3]
         g = self._grad(delta.astype(jnp.float32), lr)
-        prev = jnp.take(state["g2"][worker_id], rows, axis=0, mode="clip")
-        g2_rows = prev + jnp.square(g)
-        g2 = state["g2"].at[worker_id, rows].set(g2_rows, mode="drop")
+        g2_rows = state_rows["g2"] + jnp.square(g)
         step = rho / jnp.sqrt(g2_rows + self.eps) * g
-        return data.at[rows].add(-step.astype(data.dtype), mode="drop"), {"g2": g2}
+        return d_rows - step.astype(d_rows.dtype), {"g2": g2_rows}
+
+    def update_rows(self, data, state, rows, delta, opt):
+        return self._rows_update_via_math(data, state, rows, delta, opt)
 
 
 class DCASGDUpdater(Updater):
@@ -171,16 +311,33 @@ class DCASGDUpdater(Updater):
     names): the server keeps a per-worker backup of the parameters at pull
     time and compensates gradient staleness with a first-order term,
     ``data -= lr * (g + lambda * g*g * (data - backup[w]))``, then refreshes
-    the worker's backup."""
+    the worker's backup.
+
+    SSP staleness-adaptive scaling (``-staleness_adaptive``): when the
+    caller measured this worker's clock lag tau (``opt`` staleness scalar
+    >= 0), the variance-control strength becomes ``lambda * tau`` — the
+    compensation term approximates a Taylor correction over the staleness
+    window, so its weight should track how stale the gradient actually is
+    (tau = 0: the view is current, no compensation; tau = 1 reproduces the
+    fixed-lambda behavior; deeper lag compensates harder). Unmeasured
+    (negative, the default) keeps the fixed lambda bitwise."""
 
     name = "dcasgd"
+    per_worker_state = ("backup",)
+    staleness_aware = True
+
+    @staticmethod
+    def _lam_eff(lam, opt):
+        stale = jnp.asarray(_opt_staleness(opt), jnp.float32)
+        return lam * jnp.where(stale >= 0.0, stale, 1.0)
 
     def init_state(self, shape, dtype, num_workers):
         return {"backup": jnp.zeros((max(num_workers, 1),) + tuple(shape),
                                     dtype=jnp.float32)}
 
     def update_dense(self, data, state, delta, opt):
-        worker_id, _, lr, _, lam = opt
+        worker_id, lr = opt[0], opt[2]
+        lam = self._lam_eff(opt[4], opt)
         g = delta.astype(jnp.float32)
         d32 = data.astype(jnp.float32)
         backup_w = state["backup"][worker_id]
@@ -189,19 +346,17 @@ class DCASGDUpdater(Updater):
         backup = state["backup"].at[worker_id].set(new_data)
         return new_data.astype(data.dtype), {"backup": backup}
 
-    def update_rows(self, data, state, rows, delta, opt):
-        worker_id, _, lr, _, lam = opt
-        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
+    def rows_math(self, d_rows, state_rows, delta, opt):
+        lr = opt[2]
+        lam = self._lam_eff(opt[4], opt)
         g = delta.astype(jnp.float32)
-        d_rows = jnp.take(data, rows, axis=0, mode="clip").astype(jnp.float32)
-        backup_rows = jnp.take(state["backup"][worker_id], rows, axis=0,
-                               mode="clip")
-        step = lr * (g + lam * g * g * (d_rows - backup_rows))
-        new_rows = d_rows - step
-        backup = state["backup"].at[worker_id, rows].set(new_rows,
-                                                         mode="drop")
-        return (data.at[rows].set(new_rows.astype(data.dtype), mode="drop"),
-                {"backup": backup})
+        d32 = d_rows.astype(jnp.float32)
+        step = lr * (g + lam * g * g * (d32 - state_rows["backup"]))
+        new_rows = d32 - step
+        return new_rows.astype(d_rows.dtype), {"backup": new_rows}
+
+    def update_rows(self, data, state, rows, delta, opt):
+        return self._rows_update_via_math(data, state, rows, delta, opt)
 
 
 class DCASGDAUpdater(DCASGDUpdater):
@@ -223,7 +378,8 @@ class DCASGDAUpdater(DCASGDUpdater):
         return st
 
     def update_dense(self, data, state, delta, opt):
-        worker_id, _, lr, _, lam = opt
+        worker_id, lr = opt[0], opt[2]
+        lam = self._lam_eff(opt[4], opt)
         g = delta.astype(jnp.float32)
         d32 = data.astype(jnp.float32)
         m = self.eps_m * state["m"] + (1.0 - self.eps_m) * g * g
@@ -234,23 +390,17 @@ class DCASGDAUpdater(DCASGDUpdater):
         backup = state["backup"].at[worker_id].set(new_data)
         return new_data.astype(data.dtype), {"backup": backup, "m": m}
 
-    def update_rows(self, data, state, rows, delta, opt):
-        worker_id, _, lr, _, lam = opt
-        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
+    def rows_math(self, d_rows, state_rows, delta, opt):
+        lr = opt[2]
+        lam = self._lam_eff(opt[4], opt)
         g = delta.astype(jnp.float32)
-        m_rows_prev = jnp.take(state["m"], rows, axis=0, mode="clip")
-        m_rows = self.eps_m * m_rows_prev + (1.0 - self.eps_m) * g * g
-        m = state["m"].at[rows].set(m_rows, mode="drop")
+        m_rows = self.eps_m * state_rows["m"] + (1.0 - self.eps_m) * g * g
         lam_eff = lam / jnp.sqrt(m_rows + self.eps)
-        d_rows = jnp.take(data, rows, axis=0, mode="clip").astype(jnp.float32)
-        backup_rows = jnp.take(state["backup"][worker_id], rows, axis=0,
-                               mode="clip")
-        step = lr * (g + lam_eff * g * g * (d_rows - backup_rows))
-        new_rows = d_rows - step
-        backup = state["backup"].at[worker_id, rows].set(new_rows,
-                                                         mode="drop")
-        return (data.at[rows].set(new_rows.astype(data.dtype), mode="drop"),
-                {"backup": backup, "m": m})
+        d32 = d_rows.astype(jnp.float32)
+        step = lr * (g + lam_eff * g * g * (d32 - state_rows["backup"]))
+        new_rows = d32 - step
+        return (new_rows.astype(d_rows.dtype),
+                {"backup": new_rows, "m": m_rows})
 
 
 class FTRLUpdater(Updater):
@@ -272,7 +422,7 @@ class FTRLUpdater(Updater):
 
     @staticmethod
     def _step(w, z, n, g, opt):
-        _, l2, alpha, beta, l1 = opt
+        l2, alpha, beta, l1 = opt[1], opt[2], opt[3], opt[4]
         g32 = g.astype(jnp.float32)
         n_new = n + jnp.square(g32)
         sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / alpha
@@ -288,15 +438,13 @@ class FTRLUpdater(Updater):
         w, z, n = self._step(data, state["z"], state["n"], delta, opt)
         return w, {"z": z, "n": n}
 
+    def rows_math(self, d_rows, state_rows, delta, opt):
+        w_new, z_new, n_new = self._step(d_rows, state_rows["z"],
+                                         state_rows["n"], delta, opt)
+        return w_new, {"z": z_new, "n": n_new}
+
     def update_rows(self, data, state, rows, delta, opt):
-        rows, delta = combine_duplicate_rows(rows, delta, data.shape[0])
-        w_rows = jnp.take(data, rows, axis=0, mode="clip")
-        z_rows = jnp.take(state["z"], rows, axis=0, mode="clip")
-        n_rows = jnp.take(state["n"], rows, axis=0, mode="clip")
-        w_new, z_new, n_new = self._step(w_rows, z_rows, n_rows, delta, opt)
-        return (data.at[rows].set(w_new, mode="drop"),
-                {"z": state["z"].at[rows].set(z_new, mode="drop"),
-                 "n": state["n"].at[rows].set(n_new, mode="drop")})
+        return self._rows_update_via_math(data, state, rows, delta, opt)
 
 
 _REGISTRY: Dict[str, Callable[[], Updater]] = {
@@ -309,9 +457,51 @@ _REGISTRY: Dict[str, Callable[[], Updater]] = {
     "dcasgda": DCASGDAUpdater,
 }
 
+# Per-updater Pallas row-plane capability (docs/DESIGN.md "Sharded updater
+# state"): how an opt-in ``use_pallas`` table's row updates lower.
+#   "scatter_add"/"scatter_sub" — the stateless sorted-run scatter kernel
+#       (ops/pallas_rows.scatter_add_rows, sign +/-1);
+#   "fused_stateful"            — the fused gather-update-scatter kernel
+#       family (ops/pallas_rows.fused_stateful_rows): data AND every state
+#       leaf stream HBM->VMEM once, ``rows_math`` runs on the row blocks,
+#       both scatter back in the same donated dispatch.
+# Updaters absent here (DC-ASGD family: per-worker full-row backup writes
+# dominate, the fused win is the wrong trade) keep the XLA path.
+PALLAS_ROW_CAPABILITY: Dict[str, str] = {
+    "default": "scatter_add",
+    "sgd": "scatter_sub",
+    "momentum_sgd": "fused_stateful",
+    "adagrad": "fused_stateful",
+    "ftrl": "fused_stateful",
+}
 
-def register_updater(name: str, factory: Callable[[], Updater]) -> None:
+
+def register_updater(name: str, factory: Callable[[], Updater],
+                     pallas_capability: str | None = None) -> None:
+    if pallas_capability is not None and not (
+            isinstance(factory, type) and issubclass(factory, Updater)):
+        # Capability claims bind to a CLASS (pallas_row_capability checks
+        # instance-class identity); a closure factory would make the
+        # declared capability silently inert — refuse loudly instead.
+        raise ValueError(
+            f"register_updater({name!r}): pallas_capability requires the "
+            "factory to be the Updater class itself, not a callable")
     _REGISTRY[name] = factory
+    if pallas_capability is not None:
+        PALLAS_ROW_CAPABILITY[name] = pallas_capability
+
+
+def pallas_row_capability(updater: Updater) -> str | None:
+    """The Pallas row-plane capability that applies to THIS instance, or
+    None (keep the XLA path). The registry entry is a claim about the
+    registered class's math, so it transfers only when the instance's
+    class IS the registered factory class — a subclass inheriting
+    ``name`` (or a custom factory function) may override update math the
+    registered kernels would silently ignore."""
+    cap = PALLAS_ROW_CAPABILITY.get(updater.name)
+    if cap is None or _REGISTRY.get(updater.name) is not type(updater):
+        return None
+    return cap
 
 
 def get_updater(dtype: Any, updater_type: str | None = None) -> Updater:

@@ -227,6 +227,168 @@ def scatter_add_rows(table: jax.Array, ids: jax.Array, deltas: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# fused stateful gather-update-scatter (ROADMAP perf #2 / ISSUE 12)
+# ---------------------------------------------------------------------------
+# The stateful sparse hot path (momentum/adagrad/ftrl) reads touched rows
+# of the table AND every updater-state leaf, applies the updater math, and
+# writes both back. As XLA ops that is a chain of gathers, elementwise
+# math, and scatters over full-size HBM temporaries; here it is ONE grid
+# kernel: each grid step DMAs a sublane-tile group of data+state rows
+# (addresses from the scalar-prefetched id array), runs the updater's
+# shared ``rows_math`` on the VMEM row blocks, and DMAs both back, with
+# every buffer donated via ``input_output_aliases``.
+#
+# Caller contract (core/table.py builds this inside the store's jitted
+# ``pallas_rows_update``): ids come from ``combine_duplicate_rows`` — every
+# id UNIQUE, duplicate lanes remapped to the out-of-bounds sentinel
+# ``num_rows``. Sentinel lanes clamp their load address (matching the XLA
+# path's ``mode="clip"`` gathers) and skip write-back entirely (the XLA
+# ``mode="drop"`` scatters), so no ordering hazards exist between lanes or
+# grid steps and the grid needs no run folding. Bitwise parity with the
+# XLA path is STRUCTURAL: both planes execute the same ``rows_math``
+# function on identical row blocks.
+
+
+def _make_fused_kernel(group: int, state_keys, per_worker, rows_math,
+                       row_dtype):
+    n_state = len(state_keys)
+    n_io = 1 + n_state          # table + state leaves (aliased in/out)
+
+    def _kernel(ids_ref, meta_ref, opts_ref, delta_ref, *refs):
+        # refs: [aliased inputs]*n_io, [outputs]*n_io, drows, srows*, sems
+        outs = refs[n_io:2 * n_io]
+        table_ref, st_refs = outs[0], outs[1:]
+        drows = refs[2 * n_io]
+        srows = refs[2 * n_io + 1: 2 * n_io + 1 + n_state]
+        sems = refs[2 * n_io + 1 + n_state]
+        g = pl.program_id(0)
+        base = g * group
+        wid = meta_ref[0]
+        num_rows = meta_ref[1]
+
+        def _row_copies(k):
+            """The group's row DMAs (load direction): lane k's data row +
+            each state leaf's row, sentinel ids clamped like mode='clip'."""
+            sid = jnp.minimum(ids_ref[base + k], num_rows - 1)
+            copies = [pltpu.make_async_copy(table_ref.at[sid], drows.at[k],
+                                            sems.at[0, k])]
+            for j in range(n_state):
+                src = (st_refs[j].at[wid, sid] if per_worker[j]
+                       else st_refs[j].at[sid])
+                copies.append(pltpu.make_async_copy(src, srows[j].at[k],
+                                                    sems.at[1 + j, k]))
+            return copies
+
+        for k in range(group):
+            for c in _row_copies(k):
+                c.start()
+        for k in range(group):
+            for c in _row_copies(k):
+                c.wait()
+
+        opt = (wid, opts_ref[0], opts_ref[1], opts_ref[2], opts_ref[3],
+               opts_ref[4])
+        st_rows = {key: srows[j][:] for j, key in enumerate(state_keys)}
+        # exact_elementwise: identical strict-IEEE rounding as the XLA
+        # plane on CPU interpret runs (pass-through on real chips).
+        # wid >= 0 is the runtime-true guard it needs.
+        from multiverso_tpu.core.updater import exact_elementwise
+        new_d, new_st = exact_elementwise(rows_math)(
+            wid >= 0, drows[:], st_rows, delta_ref[:], opt)
+        drows[:] = new_d.astype(row_dtype)
+        for j, key in enumerate(state_keys):
+            srows[j][:] = new_st[key]
+
+        # Write back valid lanes only (sentinel = dropped duplicate run
+        # position or padding; ids are unique so lanes never collide).
+        for k in range(group):
+            rid = ids_ref[base + k]
+
+            @pl.when(rid < num_rows)
+            def _(k=k, rid=rid):
+                copies = [pltpu.make_async_copy(drows.at[k],
+                                                table_ref.at[rid],
+                                                sems.at[0, k])]
+                for j in range(n_state):
+                    dst = (st_refs[j].at[wid, rid] if per_worker[j]
+                           else st_refs[j].at[rid])
+                    copies.append(pltpu.make_async_copy(srows[j].at[k], dst,
+                                                        sems.at[1 + j, k]))
+                for c in copies:
+                    c.start()
+                for c in copies:
+                    c.wait()
+    return _kernel
+
+
+def fused_stateful_rows(table: jax.Array, state: dict, ids: jax.Array,
+                        deltas: jax.Array, opt, updater,
+                        interpret: bool = False):
+    """One donated gather-update-scatter dispatch for a stateful updater.
+
+    ``ids``/``deltas`` must already be duplicate-combined
+    (:func:`multiverso_tpu.core.updater.combine_duplicate_rows`): unique
+    ids, duplicates folded, dropped lanes remapped to ``table.shape[0]``.
+    Returns ``(new_table, new_state)`` with every buffer aliased in place.
+    Trace this inside a donating jit (the store's ``_row_update``).
+    """
+    group = group_for_dtype(table.dtype)
+    num_rows, d = table.shape
+    state_keys = sorted(state)
+    if not state_keys:
+        raise ValueError("fused_stateful_rows needs at least one state "
+                         "leaf; stateless updaters use scatter_add_rows")
+    per_worker = [k in updater.per_worker_state for k in state_keys]
+    n = ids.shape[0]
+    if n == 0:
+        return table, dict(state)
+    # Pad with the SENTINEL id (num_rows), not a repeated real id: these
+    # are set-semantics updates, so a pad lane aimed at a real row would
+    # recompute that row from the pre-update state and clobber the real
+    # lane's write.
+    pad = (-n) % group
+    if pad:
+        ids = jnp.concatenate(
+            [ids, jnp.full((pad,), num_rows, ids.dtype)])
+        deltas = jnp.concatenate(
+            [deltas, jnp.zeros((pad,) + deltas.shape[1:], deltas.dtype)])
+    n_padded = n + pad
+    floats = list(opt[1:5]) + [opt[5] if len(opt) > 5 else -1.0]
+    meta = jnp.stack([jnp.asarray(opt[0], jnp.int32),
+                      jnp.asarray(num_rows, jnp.int32)])
+    opts = jnp.stack([jnp.asarray(f, jnp.float32) for f in floats])
+    leaves = [state[k] for k in state_keys]
+    n_state = len(leaves)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,      # ids, meta[wid, num_rows], opt floats
+        grid=(n_padded // group,),
+        in_specs=[pl.BlockSpec((group, d), lambda g, *refs: (g, 0))] +
+                 [pl.BlockSpec(memory_space=pl.ANY)] * (1 + n_state),
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (1 + n_state),
+        scratch_shapes=[pltpu.VMEM((group, d), table.dtype)] +
+                       [pltpu.VMEM((group, d), leaf.dtype)
+                        for leaf in leaves] +
+                       [pltpu.SemaphoreType.DMA((1 + n_state, group))],
+    )
+    outs = pl.pallas_call(
+        _make_fused_kernel(group, state_keys, per_worker,
+                           updater.rows_math, table.dtype),
+        out_shape=[jax.ShapeDtypeStruct(table.shape, table.dtype)] +
+                  [jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+                   for leaf in leaves],
+        grid_spec=grid_spec,
+        # inputs: ids(0) meta(1) opts(2) deltas(3) table(4) leaves(5..)
+        input_output_aliases={4 + i: i for i in range(1 + n_state)},
+        compiler_params=CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(ids.astype(jnp.int32), meta, opts,
+      deltas.astype(jnp.float32), table, *leaves)
+    new_table = outs[0]
+    new_state = {key: outs[1 + j] for j, key in enumerate(state_keys)}
+    return new_table, new_state
+
+
+# ---------------------------------------------------------------------------
 # tiled scatter-add: whole-table tile sweep (ROADMAP perf #2)
 # ---------------------------------------------------------------------------
 # The per-row-DMA kernel above moves one row per DMA (~1us each) — it can

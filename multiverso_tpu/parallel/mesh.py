@@ -96,8 +96,10 @@ def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
 
 
 def table_sharding(mesh: Mesh, ndim: int, axis: int = 0,
-                   mesh_axis: str = SERVER_AXIS) -> NamedSharding:
-    """Shard dimension ``axis`` of an ndim-array over ``mesh_axis``.
+                   mesh_axis=SERVER_AXIS) -> NamedSharding:
+    """Shard dimension ``axis`` of an ndim-array over ``mesh_axis`` (one
+    mesh axis name, or a tuple of names for a combined split — the
+    cross-replica state sharding uses ``(server, worker)``).
 
     ArrayTable: 1-D contiguous split (ref array_table.cpp:98-108).
     MatrixTable: row split (ref matrix_table.cpp:347-369).

@@ -45,6 +45,7 @@ from multiverso_tpu.parallel.mesh import reference_server_offsets
 from multiverso_tpu.parallel.net import recv_message, send_message
 from multiverso_tpu.runtime.ffi import DeltaBuffer
 from multiverso_tpu.telemetry import gauge
+from multiverso_tpu.utils.configure import get_flag
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check, log
 from multiverso_tpu.utils.quantization import OneBitsFilter, SparseFilter
@@ -466,6 +467,22 @@ class PSService:
         except OSError:
             pass
 
+    def _maybe_stamp_staleness(self, store, opt: AddOption) -> AddOption:
+        """DCN leg of ``-staleness_adaptive`` (docs/DESIGN.md): stamp the
+        server-observed add lag of this worker (the same counts feeding
+        the ``ps_service.staleness.worker_<w>`` gauges) onto the option a
+        staleness-aware updater will see — the async-mode analog of the
+        sync coordinator's vector-clock lag. Dispatcher-thread only; an
+        already-stamped option (client measured it closer to the source)
+        passes through."""
+        updater = getattr(store, "updater", None)   # host KV maps have none
+        if (opt.staleness >= 0 or not get_flag("staleness_adaptive")
+                or not getattr(updater, "staleness_aware", False)):
+            return opt
+        lag = self._top_add_count - self._worker_add_counts.get(
+            opt.worker_id, 0)
+        return dataclasses.replace(opt, staleness=float(max(lag, 0)))
+
     def _note_worker_add(self, worker: int) -> None:
         """Per-worker staleness: how many applied Adds the slowest push
         stream trails the fastest by — the async-mode analog of the BSP
@@ -681,13 +698,16 @@ class PSService:
         raw_wire = getattr(store, "wire_raw", False)
         if msg.type == MsgType.Request_Add:
             # payload: [keys(int32, may be empty = whole shard),
-            #           opt scalars(float32[5]), marker, *filtered delta]
+            #           opt scalars(float32[6]; older peers send 5 —
+            #           staleness reads as unmeasured), marker,
+            #           *filtered delta]
             # No delta blobs at all = BSP clock tick (apply nothing).
             if len(msg.data) == 2 and msg.data[0].size == 0:
                 return msg.create_reply()
             with monitor("PS_SERVICE_ADD"):   # ref server.cpp:49 monitor
                 keys, opt_arr = msg.data[0], msg.data[1]
                 opt = _opt_from_array(opt_arr)
+                opt = self._maybe_stamp_staleness(store, opt)
                 if raw_wire:
                     store.apply_rows(keys, msg.data[2], opt)
                 elif keys.size == 0:
@@ -922,13 +942,17 @@ def _reply_nbytes(reply: Message) -> int:
 
 def _opt_to_array(opt: AddOption) -> np.ndarray:
     return np.asarray([opt.worker_id, opt.momentum, opt.learning_rate,
-                       opt.rho, opt.lambda_], dtype=np.float32)
+                       opt.rho, opt.lambda_, opt.staleness],
+                      dtype=np.float32)
 
 
 def _opt_from_array(arr: np.ndarray) -> AddOption:
+    # Older peers ship 5 scalars (no staleness); absent = unmeasured (-1),
+    # which keeps the fixed-lambda DC-ASGD math bitwise.
     return AddOption(worker_id=int(arr[0]), momentum=float(arr[1]),
                      learning_rate=float(arr[2]), rho=float(arr[3]),
-                     lambda_=float(arr[4]))
+                     lambda_=float(arr[4]),
+                     staleness=float(arr[5]) if arr.size > 5 else -1.0)
 
 
 # -- wire payload codec (VERDICT r1 #5) -------------------------------------

@@ -74,8 +74,8 @@ class ArrayTable(WorkerTable):
         check(delta.shape == (self.size,),
               f"delta shape {delta.shape} != ({self.size},)")
         t0 = time.perf_counter()
-        with self._bsp_add(option):
-            self.store.apply_dense(delta, option or AddOption())
+        with self._bsp_add(option) as opt:
+            self.store.apply_dense(delta, opt)
         self.comm.record_client_op(delta.nbytes,
                                    (time.perf_counter() - t0) * 1e3)
         return self._register_add()

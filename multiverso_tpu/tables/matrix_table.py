@@ -85,8 +85,8 @@ class MatrixTable(WorkerTable):
         delta = np.asarray(delta, dtype=self.store.dtype)
         check(delta.shape == (self.num_row, self.num_col),
               f"delta shape {delta.shape} != {(self.num_row, self.num_col)}")
-        with self._bsp_add(option):
-            self.store.apply_dense(delta, option or AddOption())
+        with self._bsp_add(option) as opt:
+            self.store.apply_dense(delta, opt)
         return self._register_add()
 
     def add(self, delta, option: Optional[AddOption] = None) -> None:
@@ -121,8 +121,8 @@ class MatrixTable(WorkerTable):
               f"row delta shape {deltas.shape} != "
               f"{(len(row_ids), self.num_col)}")
         t0 = time.perf_counter()
-        with self._bsp_add(option):
-            self.store.apply_rows(row_ids, deltas, option or AddOption())
+        with self._bsp_add(option) as opt:
+            self.store.apply_rows(row_ids, deltas, opt)
         self.comm.record_client_op(deltas.nbytes,
                                    (time.perf_counter() - t0) * 1e3)
         return self._register_add()

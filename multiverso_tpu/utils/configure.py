@@ -178,6 +178,18 @@ define_string("ps_role", "default", "none|worker|server|default "
               "(ref src/zoo.cpp:23)")
 define_string("updater_type", "default", "default|sgd|adagrad|momentum_sgd "
               "(ref src/updater/updater.cpp:18)")
+define_string("state_sharding", "auto", "updater-state sharding across the "
+              "mesh's replica ('worker') axis per arXiv 2004.13336: each "
+              "replica holds 1/k of every state leaf instead of a full "
+              "copy (params stay bitwise-equal; docs/DESIGN.md 'Sharded "
+              "updater state'). auto = shard whenever the mesh has a "
+              "worker axis > 1 and the leaf divides evenly; on = require "
+              "it; off = keep state replicated")
+define_bool("staleness_adaptive", False, "scale DC-ASGD's variance-control "
+            "term by the MEASURED per-worker clock lag (sync mode: the "
+            "SyncCoordinator's add-clock lag; DCN: the PS service's "
+            "per-worker add-lag gauges) instead of a fixed lambda — "
+            "lambda_eff = lambda * lag (docs/DESIGN.md)")
 define_int("omp_threads", 4, "host-side update parallelism hint "
            "(ref src/updater/updater.cpp:19)")
 define_double("backup_worker_ratio", 0.0, "straggler over-provision ratio "

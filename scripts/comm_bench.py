@@ -204,6 +204,100 @@ def bench_logreg_policies(dry: bool) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# model_average convergence vs averaging period (ROADMAP 5d)
+# ---------------------------------------------------------------------------
+def bench_ma_convergence(dry: bool) -> dict:
+    """Loss trajectory of the model_average plane at 2-3 averaging
+    periods on logreg, so AUTO's decision table can weigh QUALITY, not
+    just wall-clock: model_average trades a staleness window (the
+    period) for zero per-step communication, and this leg measures what
+    that window costs in loss. Two replicas are simulated in-process —
+    each trains a device-resident LocalModel on its own half of the
+    minibatch stream and every P steps the replicas average weights
+    (plain mean, exactly ``model_average_arrays`` across processes). The
+    ``sequential`` row is the single-model reference trajectory (what
+    the PS plane computes when one worker owns the whole stream)."""
+    from multiverso_tpu.models.logreg.model import LocalModel, LogRegConfig
+
+    F = 64 if dry else 256
+    B = 32 if dry else 64
+    N = 40 if dry else 200          # minibatches per epoch
+    epochs = 2 if dry else 5
+    replicas = 2
+    periods = (1, 4) if dry else (1, 8, 32)
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(N * B, F + 1)).astype(np.float32)
+    X[:, -1] = 1.0
+    w_true = rng.normal(size=(F + 1, 1)).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32).ravel()
+    batches = [(X[i * B:(i + 1) * B], y[i * B:(i + 1) * B])
+               for i in range(N)]
+
+    def full_loss(w: np.ndarray) -> float:
+        """Mean sigmoid cross-entropy over the whole stream — one
+        comparable quality number per leg."""
+        z = (X @ w).ravel()
+        return float(np.mean(np.logaddexp(0.0, z) - y * z))
+
+    def cfg():
+        return LogRegConfig(objective="sigmoid", num_feature=F,
+                            learning_rate=0.1, minibatch_size=B,
+                            epochs=epochs)
+
+    def run_ma(period: int) -> dict:
+        models = [LocalModel(cfg()) for _ in range(replicas)]
+        epoch_losses = []
+        merged = None
+        for _ in range(epochs):
+            losses, rounds = [], 0
+            for i in range(0, N, replicas):
+                for r in range(replicas):
+                    if i + r < N:
+                        Xb, yb = batches[i + r]
+                        losses.append(float(models[r].update(Xb, yb)))
+                rounds += 1
+                if rounds % period == 0:
+                    merged = np.mean([m.get_weights() for m in models],
+                                     axis=0)
+                    for m in models:
+                        m.set_weights(merged)
+            # epoch-boundary reconcile (the plane's sync() semantics)
+            merged = np.mean([m.get_weights() for m in models], axis=0)
+            for m in models:
+                m.set_weights(merged)
+            epoch_losses.append(round(float(np.mean(losses)), 6))
+        return {"period": period,
+                "epoch_mean_loss": epoch_losses,
+                "final_full_loss": round(full_loss(merged), 6)}
+
+    def run_sequential() -> dict:
+        model = LocalModel(cfg())
+        epoch_losses = []
+        for _ in range(epochs):
+            losses = [float(model.update(Xb, yb)) for Xb, yb in batches]
+            epoch_losses.append(round(float(np.mean(losses)), 6))
+        return {"epoch_mean_loss": epoch_losses,
+                "final_full_loss":
+                    round(full_loss(model.get_weights()), 6)}
+
+    seq = run_sequential()
+    legs = [run_ma(p) for p in periods]
+    init_loss = full_loss(np.zeros((F + 1, 1), np.float32))
+    out = {"replicas": replicas, "epochs": epochs,
+           "minibatches_per_epoch": N,
+           "initial_full_loss": round(init_loss, 6),
+           "sequential": seq, "periods": legs,
+           "quality_gap_vs_sequential": {
+               str(leg["period"]): round(
+                   leg["final_full_loss"] - seq["final_full_loss"], 6)
+               for leg in legs}}
+    _log(f"ma_convergence: seq final {seq['final_full_loss']}, "
+         + ", ".join(f"P={leg['period']} -> {leg['final_full_loss']}"
+                     for leg in legs))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # AUTO decision evidence
 # ---------------------------------------------------------------------------
 def auto_evidence(w2v: dict, logreg: dict) -> dict:
@@ -269,11 +363,18 @@ def auto_evidence(w2v: dict, logreg: dict) -> dict:
     }
 
 
-def check_witnesses(w2v: dict, logreg: dict) -> dict:
+def check_witnesses(w2v: dict, logreg: dict,
+                    ma_conv: dict | None = None) -> dict:
     """The tier-1 witnesses: the hybrid word2vec run really ran BOTH
     planes, and every leg moved bytes on its own plane."""
     hybrid = w2v["hybrid"]["comm"]
+    ma_block = {}
+    if ma_conv is not None:
+        init = ma_conv["initial_full_loss"]
+        ma_block["ma_convergence_all_periods_improve"] = all(
+            leg["final_full_loss"] < init for leg in ma_conv["periods"])
     return {
+        **ma_block,
         "hybrid_ps_adds_nonzero":
             hybrid.get("comm.ps.bytes", 0) > 0 and
             hybrid.get("comm.ps.ops", 0) > 0,
@@ -310,8 +411,9 @@ def main() -> int:
 
     w2v = bench_word2vec_policies(args.dry_run)
     logreg = bench_logreg_policies(args.dry_run)
+    ma_conv = bench_ma_convergence(args.dry_run)
     auto = auto_evidence(w2v, logreg)
-    witnesses = check_witnesses(w2v, logreg)
+    witnesses = check_witnesses(w2v, logreg, ma_conv)
 
     try:
         rev = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
@@ -326,6 +428,7 @@ def main() -> int:
         "date": time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime()),
         "git": rev,
         "word2vec": w2v, "logreg": logreg,
+        "ma_convergence": ma_conv,
         "auto": auto, "witnesses": witnesses,
     }
 

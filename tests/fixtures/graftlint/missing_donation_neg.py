@@ -8,3 +8,11 @@ def make_update(raw_update):
 
 def make_predict(predict_fn):
     return jax.jit(predict_fn)      # not a step/update: no donation due
+
+
+def build_stateful_rows(pallas_rows_update):
+    # The shipped fused-stateful shape: the jit donates data (0) and the
+    # state pytree (1); inside, pallas_call aliases each buffer onto its
+    # output (input_output_aliases), so the whole gather-update-scatter
+    # happens in place.
+    return jax.jit(pallas_rows_update, donate_argnums=(0, 1))

@@ -9,3 +9,11 @@ def make_update(raw_update):
 def build(table_step):
     step = jax.jit(table_step, static_argnums=(4,))  # expect: missing-donation
     return step
+
+
+def build_stateful_rows(pallas_rows_update):
+    # The fused stateful-kernel idiom gone wrong: data AND every updater
+    # state leaf ride this dispatch, so an undonated jit holds TWO full
+    # copies of the table plus its optimizer state in HBM per step.
+    return jax.jit(pallas_rows_update,  # expect: missing-donation
+                   static_argnames=("interpret",))

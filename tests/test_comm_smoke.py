@@ -58,6 +58,17 @@ def test_comm_bench_dry_run_witnesses(tmp_path):
     assert matches["logreg_weights"]["match"], matches
     assert matches["w2v_tables"]["match"], matches
 
+    # model_average convergence-vs-averaging-period leg (ROADMAP 5d):
+    # every period trains (improves on the initial loss) and the record
+    # carries the quality gap AUTO's decision table can weigh.
+    ma = rec["ma_convergence"]
+    assert wit["ma_convergence_all_periods_improve"], ma
+    assert len(ma["periods"]) >= 2
+    for leg in ma["periods"]:
+        assert leg["final_full_loss"] < ma["initial_full_loss"], leg
+    assert set(ma["quality_gap_vs_sequential"]) == \
+        {str(leg["period"]) for leg in ma["periods"]}
+
     # Per-policy telemetry is embedded per leg.
     assert rec["word2vec"]["ps"]["comm"]["comm.ps.bytes"] > 0
     assert rec["word2vec"]["model_average"]["comm"][

@@ -169,8 +169,10 @@ def test_scatter_add_sgd_sign():
 
 
 def test_table_pallas_eligibility_widened():
-    """SGD tables now route through the Pallas row path (single shard,
-    sign-flipped scatter); bf16 and adagrad stay on XLA."""
+    """SGD tables route through the Pallas row path (single shard,
+    sign-flipped scatter); bf16 stays on XLA; stateful updaters named by
+    the capability registry (adagrad) get the FUSED gather-update-scatter
+    kernel; unregistered stateful updaters (dcasgd) stay on XLA."""
     import multiverso_tpu as mv
     from multiverso_tpu.core.options import AddOption
     from multiverso_tpu.core.table import ServerStore
@@ -183,7 +185,7 @@ def test_table_pallas_eligibility_widened():
         st = ServerStore("p1", (32, 128), np.float32,
                          get_updater(np.float32, "sgd"), mesh, 1,
                          use_pallas_rows=True)
-        assert st._pallas_rows
+        assert st._pallas_rows and st._pallas_cap == "scatter_sub"
         st_bf = ServerStore("p2", (32, 128), jnp.bfloat16,
                             get_updater(np.dtype(jnp.bfloat16), "default"),
                             mesh, 1, use_pallas_rows=True)
@@ -191,7 +193,11 @@ def test_table_pallas_eligibility_widened():
         st_ada = ServerStore("p3", (32, 128), np.float32,
                              get_updater(np.float32, "adagrad"), mesh, 1,
                              use_pallas_rows=True)
-        assert not st_ada._pallas_rows
+        assert st_ada._pallas_rows and st_ada._pallas_cap == "fused_stateful"
+        st_dc = ServerStore("p4", (32, 128), np.float32,
+                            get_updater(np.float32, "dcasgd"), mesh, 1,
+                            use_pallas_rows=True)
+        assert not st_dc._pallas_rows   # not in the capability registry
         # behavior: sgd table applies data -= delta through the kernel
         ids = jnp.asarray([1, 1, 3], dtype=jnp.int32)
         st.apply_rows(ids, jnp.ones((3, 128), jnp.float32), AddOption())
@@ -248,3 +254,100 @@ def test_tiled_scatter_sgd_sign_and_eligibility():
     np.testing.assert_allclose(np.asarray(got), want)
     assert tiled_scatter_eligible(8192, 128, np.float32)
     assert not tiled_scatter_eligible(100_000, 128, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# fused stateful gather-update-scatter (ISSUE 12): interpret-mode BITWISE
+# vs the XLA update path — both planes run the updater's shared rows_math,
+# so equality here proves the kernel's gather/scatter plumbing.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("updater", ["momentum_sgd", "adagrad", "ftrl"])
+def test_fused_stateful_bitwise_vs_xla(updater):
+    import multiverso_tpu as mv
+
+    mv.init([], devices=jax.devices()[:1])
+    try:
+        t_xla = mv.create_table(mv.MatrixTableOption(33, 16,
+                                                     updater=updater,
+                                                     name="fx"))
+        t_pal = mv.create_table(mv.MatrixTableOption(33, 16,
+                                                     updater=updater,
+                                                     name="fp",
+                                                     use_pallas=True))
+        assert t_pal.store._pallas_cap == "fused_stateful"
+        rng = np.random.default_rng(3)
+        opt = mv.AddOption(worker_id=0, momentum=0.9, learning_rate=0.05,
+                           rho=0.1, lambda_=0.01)
+        for step in range(5):
+            n = int(rng.integers(1, 24))
+            ids = rng.integers(0, 33, size=n).astype(np.int32)
+            d = rng.normal(size=(n, 16)).astype(np.float32)
+            t_xla.add_rows(ids, d, opt)
+            t_pal.add_rows(ids, d, opt)
+        assert np.array_equal(t_xla.get(), t_pal.get()), updater
+        for k in t_xla.store.state:
+            assert np.array_equal(np.asarray(t_xla.store.state[k]),
+                                  np.asarray(t_pal.store.state[k])), \
+                (updater, k)
+    finally:
+        mv.shutdown()
+
+
+def test_fused_stateful_duplicates_and_empty():
+    """Duplicate ids in one add fold (combine semantics, like the XLA
+    path); an empty add is a no-op; heavy duplication across group
+    boundaries stays exact."""
+    import multiverso_tpu as mv
+
+    mv.init([], devices=jax.devices()[:1])
+    try:
+        t_xla = mv.create_table(mv.MatrixTableOption(8, 4,
+                                                     updater="adagrad",
+                                                     name="dx"))
+        t_pal = mv.create_table(mv.MatrixTableOption(8, 4,
+                                                     updater="adagrad",
+                                                     name="dp",
+                                                     use_pallas=True))
+        opt = mv.AddOption(learning_rate=0.1, rho=0.1)
+        # 11 ids over 3 rows: duplicates straddle the 8-lane group
+        ids = np.array([2, 2, 2, 6, 6, 1, 1, 1, 1, 2, 6], dtype=np.int32)
+        d = np.ones((11, 4), dtype=np.float32)
+        t_xla.add_rows(ids, d, opt)
+        t_pal.add_rows(ids, d, opt)
+        t_pal.add_rows([], np.zeros((0, 4), np.float32), opt)  # no-op
+        assert np.array_equal(t_xla.get(), t_pal.get())
+        assert np.array_equal(np.asarray(t_xla.store.state["g2"]),
+                              np.asarray(t_pal.store.state["g2"]))
+    finally:
+        mv.shutdown()
+
+
+def test_fused_stateful_per_worker_state_indexing():
+    """AdaGrad's [num_workers, ...] g2: the kernel must address worker w's
+    accumulator plane, not worker 0's."""
+    import multiverso_tpu as mv
+
+    mv.init([], num_local_workers=2)
+    try:
+        t_xla = mv.create_table(mv.MatrixTableOption(16, 8,
+                                                     updater="adagrad",
+                                                     name="wx"))
+        t_pal = mv.create_table(mv.MatrixTableOption(16, 8,
+                                                     updater="adagrad",
+                                                     name="wp",
+                                                     use_pallas=True))
+        rng = np.random.default_rng(5)
+        for step in range(4):
+            w = step % 2
+            opt = mv.AddOption(worker_id=w, learning_rate=0.1, rho=0.1)
+            ids = rng.integers(0, 16, size=6).astype(np.int32)
+            d = rng.normal(size=(6, 8)).astype(np.float32)
+            t_xla.add_rows(ids, d, opt)
+            t_pal.add_rows(ids, d, opt)
+        assert np.array_equal(t_xla.get(), t_pal.get())
+        g2x = np.asarray(t_xla.store.state["g2"])
+        g2p = np.asarray(t_pal.store.state["g2"])
+        assert g2x.shape[0] == 2 and np.array_equal(g2x, g2p)
+        assert np.abs(g2x[0] - g2x[1]).max() > 0   # both planes really used
+    finally:
+        mv.shutdown()

@@ -1,0 +1,351 @@
+"""Cross-replica-sharded optimizer state (docs/DESIGN.md "Sharded updater
+state"; arXiv 2004.13336) — the parity tests are the contract:
+
+* params AND state bitwise-equal to the unsharded layout over multi-epoch
+  runs (pow-2 replica axes, every stateful updater);
+* per-store state bytes drop (k-1)/k on a k-replica mesh, gauge-backed;
+* checkpoints round-trip across replica counts (reshard on load), legacy
+  padded payloads still load, genuinely incompatible shapes fail loudly;
+* SSP staleness-adaptive DC-ASGD: measured clock lag scales the
+  variance-control term (lambda_eff = lambda * lag) only when armed.
+"""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.core.checkpoint import load_table, save_table
+
+MESH_2x4 = "-mesh_shape=server:2,worker:4"
+MESH_2x2 = "-mesh_shape=server:2,worker:2"
+
+STATEFUL = ("momentum_sgd", "adagrad", "ftrl", "dcasgd", "dcasgda")
+
+
+def _train_table(updater, epochs=3, rows=64, cols=8, name="t"):
+    """A multi-epoch mixed row/dense add schedule; returns the table."""
+    t = mv.create_table(mv.MatrixTableOption(rows, cols, updater=updater,
+                                             name=name))
+    rng = np.random.default_rng(7)
+    opt = mv.AddOption(worker_id=0, momentum=0.5, learning_rate=0.1,
+                       rho=0.1, lambda_=0.01)
+    for _ in range(epochs):
+        for _ in range(3):
+            ids = rng.integers(0, rows, size=16).astype(np.int32)
+            t.add_rows(ids, rng.normal(size=(16, cols)).astype(np.float32),
+                       opt)
+        t.add(rng.normal(size=(rows, cols)).astype(np.float32), opt)
+    return t
+
+
+def _run(mode, updater, mesh=MESH_2x4, epochs=3):
+    mv.init([mesh, f"-state_sharding={mode}"])
+    try:
+        t = _train_table(updater, epochs=epochs)
+        params = t.get().copy()
+        state = {k: np.asarray(v).copy() for k, v in t.store.state.items()}
+        state_bytes = t.store.state_bytes()
+        data_bytes = t.store.data_bytes()
+        sharded = t.store.state_sharded
+        return params, state, state_bytes, data_bytes, sharded
+    finally:
+        mv.shutdown()
+
+
+@pytest.mark.parametrize("updater", STATEFUL)
+def test_sharded_state_bitwise_params_and_state(updater):
+    """THE acceptance contract: pow-2 replica axis, multi-epoch run,
+    params and every state leaf bitwise-equal to the unsharded layout."""
+    p_off, s_off, b_off, _, sh_off = _run("off", updater)
+    p_on, s_on, b_on, _, sh_on = _run("on", updater)
+    assert not sh_off and sh_on
+    assert np.array_equal(p_off, p_on), updater
+    for key in s_off:
+        assert np.array_equal(s_off[key], s_on[key]), (updater, key)
+    # 4 replicas: sharded state holds 1/4 of the unsharded bytes.
+    assert b_on * 4 == b_off, (updater, b_off, b_on)
+
+
+def test_sharded_state_bitwise_second_mesh():
+    """Same contract on a second pow-2 axis size (k=2)."""
+    p_off, _, b_off, _, _ = _run("off", "adagrad", mesh=MESH_2x2, epochs=2)
+    p_on, _, b_on, _, sh = _run("on", "adagrad", mesh=MESH_2x2, epochs=2)
+    assert sh and np.array_equal(p_off, p_on)
+    # the >= 40% acceptance floor at world 2 is exactly 50% here
+    assert b_on * 2 == b_off
+
+
+def test_state_bytes_gauges_published():
+    """ps.data_bytes / ps.state_bytes are host-computed gauges, set at
+    init (and load), so the HBM claim is a measured number."""
+    from multiverso_tpu.telemetry import metrics_snapshot
+    mv.init([MESH_2x4, "-state_sharding=on"])
+    try:
+        t = mv.create_table(mv.MatrixTableOption(64, 8, updater="adagrad",
+                                                 name="gt"))
+        snap = metrics_snapshot(buckets=False)
+        gauges = snap.get("gauges", {})
+        assert gauges["ps.state_bytes.gt"]["last"] == t.store.state_bytes()
+        assert gauges["ps.data_bytes.gt"]["last"] == t.store.data_bytes()
+        # data is replicated across the worker axis (lookups stay local),
+        # state is not — that asymmetry IS the memory win.
+        assert t.store.state_bytes() < t.store.data_bytes() * 4
+    finally:
+        mv.shutdown()
+
+
+def test_state_leaf_sharding_spec_includes_worker_axis():
+    mv.init([MESH_2x4, "-state_sharding=on"])
+    try:
+        t = mv.create_table(mv.MatrixTableOption(64, 8,
+                                                 updater="momentum_sgd",
+                                                 name="sp"))
+        spec = t.store.state["smooth"].sharding.spec
+        flat = [ax for entry in spec if entry
+                for ax in (entry if isinstance(entry, tuple) else (entry,))]
+        assert "worker" in flat and "server" in flat
+        # params stay replicated over worker
+        dspec = t.store.data.sharding.spec
+        dflat = [ax for entry in dspec if entry
+                 for ax in (entry if isinstance(entry, tuple)
+                            else (entry,))]
+        assert "worker" not in dflat
+    finally:
+        mv.shutdown()
+
+
+def test_state_sharding_on_rejects_indivisible():
+    """-state_sharding=on fails loudly when a leaf cannot split evenly;
+    auto silently keeps that leaf unsharded."""
+    mv.init([MESH_2x4, "-state_sharding=on"])
+    try:
+        with pytest.raises(Exception, match="state_sharding=on"):
+            mv.create_table(mv.MatrixTableOption(9, 3,
+                                                 updater="momentum_sgd",
+                                                 name="bad"))
+    finally:
+        mv.shutdown()
+    mv.init([MESH_2x4, "-state_sharding=auto"])
+    try:
+        t = mv.create_table(mv.MatrixTableOption(9, 3,
+                                                 updater="momentum_sgd",
+                                                 name="ok"))
+        assert not t.store.state_sharded   # 10 padded rows !% 8
+    finally:
+        mv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trips across replica counts
+# ---------------------------------------------------------------------------
+def _ckpt_train_and_save(tmp_path, mesh, mode, updater="adagrad"):
+    # mesh "" must RESET the flag (it persists across init cycles within
+    # one test), restoring the default 1-axis all-server mesh.
+    mv.init([f"-mesh_shape={mesh.split('=', 1)[1] if mesh else ''}",
+             f"-state_sharding={mode}"])
+    try:
+        t = _train_table(updater, epochs=2, name="ck")
+        uri = str(tmp_path / "ck.npz")
+        save_table(t, uri)
+        return (uri, t.get().copy(),
+                {k: np.asarray(v).copy() for k, v in t.store.state.items()})
+    finally:
+        mv.shutdown()
+
+
+def _ckpt_load(uri, mesh, mode, updater="adagrad"):
+    mv.init([f"-mesh_shape={mesh.split('=', 1)[1] if mesh else ''}",
+             f"-state_sharding={mode}"])
+    try:
+        t = mv.create_table(mv.MatrixTableOption(64, 8, updater=updater,
+                                                 name="ck"))
+        load_table(t, uri)
+        return (t.get().copy(),
+                {k: np.asarray(v).copy() for k, v in t.store.state.items()},
+                t.store.state_sharded)
+    finally:
+        mv.shutdown()
+
+
+def test_checkpoint_reshard_on_replica_count_change(tmp_path):
+    """Sharded save (k=4) loads into k=2, k=1 (unsharded world), and back
+    — params and state bitwise through every reshard."""
+    uri, params, state = _ckpt_train_and_save(tmp_path, MESH_2x4, "on")
+    for mesh, mode, want_sharded in ((MESH_2x2, "on", True),
+                                     ("", "auto", False),
+                                     (MESH_2x4, "off", False)):
+        got_p, got_s, sharded = _ckpt_load(uri, mesh, mode)
+        assert sharded == want_sharded, (mesh, mode)
+        assert np.array_equal(got_p, params), (mesh, mode)
+        for k in state:
+            assert np.array_equal(got_s[k], state[k]), (mesh, mode, k)
+
+
+def test_checkpoint_legacy_unsharded_into_sharded(tmp_path):
+    """A checkpoint written with unsharded state (and legacy PADDED state
+    leaves) loads into a sharded store bitwise."""
+    uri, params, state = _ckpt_train_and_save(tmp_path, "", "off")
+    got_p, got_s, sharded = _ckpt_load(uri, MESH_2x4, "on")
+    assert sharded
+    assert np.array_equal(got_p, params)
+    for k in state:
+        assert np.array_equal(got_s[k], state[k]), k
+
+    # Legacy format: state leaves saved at the PADDED extent. Build one by
+    # hand and load it — the pad region is zeros by construction.
+    mv.init([MESH_2x4, "-state_sharding=on"])
+    try:
+        t = mv.create_table(mv.MatrixTableOption(64, 8, updater="adagrad",
+                                                 name="ck"))
+        padded_rows = t.store.padded_shape[0]
+        legacy = {"data": params,
+                  "state/g2": np.zeros((1, padded_rows + 8, 8),
+                                       np.float32)}
+        legacy["state/g2"][:, :64] = state["g2"][:, :64]
+        t.store.load_state(legacy)
+        assert np.array_equal(t.get(), params)
+        assert np.array_equal(np.asarray(t.store.state["g2"])[:, :64],
+                              state["g2"][:, :64])
+    finally:
+        mv.shutdown()
+
+
+def test_checkpoint_incompatible_shapes_fail_loud(tmp_path):
+    """Wrong table shape / worker extent / column width must raise, not
+    silently truncate."""
+    uri, params, state = _ckpt_train_and_save(tmp_path, "", "off")
+    mv.init([])
+    try:
+        t = mv.create_table(mv.MatrixTableOption(64, 8, updater="adagrad",
+                                                 name="ck"))
+        with pytest.raises(Exception, match="incompatible"):
+            t.store.load_state({"data": params[:32]})
+        with pytest.raises(Exception, match="incompatible"):
+            t.store.load_state({"data": params,
+                                "state/g2": state["g2"][..., :4]})
+        with pytest.raises(Exception, match="incompatible"):
+            t.store.load_state({"data": params,
+                                "state/g2": np.concatenate(
+                                    [state["g2"], state["g2"]], axis=0)})
+    finally:
+        mv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SSP staleness-adaptive DC-ASGD
+# ---------------------------------------------------------------------------
+def test_dcasgd_staleness_scales_lambda():
+    """Updater math: staleness tau >= 0 makes lambda_eff = lambda * tau —
+    update(staleness=tau, lambda) == update(unmeasured, lambda*tau);
+    unmeasured (negative) keeps the fixed lambda bitwise."""
+    import jax.numpy as jnp
+
+    from multiverso_tpu.core.options import AddOption
+    from multiverso_tpu.core.updater import get_updater
+
+    upd = get_updater(np.float32, "dcasgd")
+    data = jnp.asarray(np.random.default_rng(0)
+                       .normal(size=(6, 4)).astype(np.float32))
+    state = upd.init_state((6, 4), np.float32, 2)
+    state = {"backup": state["backup"] + 0.3}   # nonzero (data - backup)
+    delta = jnp.asarray(np.random.default_rng(1)
+                        .normal(size=(6, 4)).astype(np.float32))
+
+    def run(lam, stale):
+        opt = AddOption(worker_id=1, learning_rate=0.1, lambda_=lam,
+                        staleness=stale).scalars()
+        d, s = upd.update_dense(data, dict(state), delta, opt)
+        return np.asarray(d)
+
+    assert np.array_equal(run(0.5, 3.0), run(1.5, -1.0))      # 0.5*3
+    assert np.array_equal(run(0.5, 1.0), run(0.5, -1.0))      # tau=1 = fixed
+    assert np.array_equal(run(0.5, 0.0), run(0.0, -1.0))      # fresh: off
+
+
+def test_sync_coordinator_lag_measured():
+    from multiverso_tpu.core.sync_coordinator import SyncCoordinator
+
+    sc = SyncCoordinator(3, name="lagt")
+    for _ in range(2):                      # worker 0 commits 2 adds
+        sc.acquire_add(0)
+        sc.commit_add(0)
+    sc.acquire_add(1)
+    sc.commit_add(1)                        # worker 1 commits 1
+    assert sc.lag(0) == 0.0
+    assert sc.lag(1) == 1.0
+    assert sc.lag(2) == 2.0
+    sc.finish_train(2)
+    assert sc.lag(2) == 0.0                 # retired: nothing to be stale
+
+
+def test_bsp_add_stamps_measured_staleness():
+    """End to end: -sync + -staleness_adaptive, two workers, a dcasgd
+    table — the straggler's add is dispatched with its measured lag, so
+    its params differ from the unarmed run exactly as lambda*lag
+    predicts."""
+    results = {}
+    for armed in (False, True):
+        argv = ["-sync=true"]
+        if armed:
+            argv.append("-staleness_adaptive=true")
+        mv.init(argv, num_local_workers=2)
+        try:
+            t = mv.create_table(mv.ArrayTableOption(size=4,
+                                                    updater="dcasgd"))
+            g = np.array([1.0, -2.0, 0.5, 3.0], dtype=np.float32)
+            # Homogeneous BSP loop: each round both workers add then get.
+            for _ in range(3):
+                for w in (0, 1):
+                    t.add(g * (1 + w),
+                          mv.AddOption(worker_id=w, learning_rate=0.1,
+                                       lambda_=0.5))
+                for w in (0, 1):
+                    t.get(mv.GetOption(worker_id=w))
+            results[armed] = t.get().copy()
+        finally:
+            mv.shutdown()
+    # Worker 1 always adds at lag 1 (worker 0 committed first): armed run
+    # keeps lambda_eff = lambda * 1 == lambda for it, but worker 0 adds at
+    # lag 0 -> compensation OFF for it, so trajectories must diverge.
+    assert not np.array_equal(results[False], results[True])
+    assert np.all(np.isfinite(results[True]))
+
+
+def test_ps_service_wire_option_staleness_roundtrip():
+    """DCN leg: the 6th wire scalar round-trips; legacy 5-scalar blobs
+    read as unmeasured; the service-side stamp arms only for
+    staleness-aware updaters under the flag."""
+    import types
+
+    from multiverso_tpu.core.options import AddOption
+    from multiverso_tpu.core.updater import get_updater
+    from multiverso_tpu.parallel.ps_service import (PSService,
+                                                    _opt_from_array,
+                                                    _opt_to_array)
+
+    # exactly-representable f32 values so the wire round-trip compares ==
+    opt = AddOption(worker_id=3, momentum=0.5, learning_rate=0.25,
+                    rho=0.125, lambda_=0.75, staleness=2.0)
+    arr = _opt_to_array(opt)
+    assert arr.shape == (6,)
+    back = _opt_from_array(arr)
+    assert back == opt
+    legacy = _opt_from_array(arr[:5])           # older peer
+    assert legacy.staleness == -1.0
+
+    # service-side stamping off the dispatcher's add-lag counts
+    svc = object.__new__(PSService)             # no sockets needed
+    svc._top_add_count = 7
+    svc._worker_add_counts = {3: 4}
+    store = types.SimpleNamespace(updater=get_updater(np.float32,
+                                                      "dcasgd"))
+    plain = AddOption(worker_id=3)
+    assert svc._maybe_stamp_staleness(store, plain).staleness == -1.0
+    mv.set_flag("staleness_adaptive", True)
+    stamped = svc._maybe_stamp_staleness(store, plain)
+    assert stamped.staleness == 3.0             # 7 - 4
+    # already-stamped options pass through; non-aware updaters too
+    assert svc._maybe_stamp_staleness(store, stamped).staleness == 3.0
+    sgd_store = types.SimpleNamespace(updater=get_updater(np.float32,
+                                                          "sgd"))
+    assert svc._maybe_stamp_staleness(sgd_store, plain).staleness == -1.0
