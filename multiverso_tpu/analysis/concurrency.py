@@ -673,3 +673,151 @@ class BareThreadNoJoin(Rule):
                                 anc.iter.id == target:
                             return True
         return False
+
+
+@register
+class DaemonLoopNoWatchdog(Rule):
+    id = "daemon-loop-no-watchdog"
+    severity = "warning"
+    rationale = (
+        "A daemon service loop (a threading.Thread target containing a "
+        "`while` loop) in the watchdog-covered planes that never beats "
+        "the flight recorder's wedge watchdog is invisible to the "
+        "postmortem tooling: when it wedges, the plane stalls with no "
+        "trip, no all-thread stack dump, and no alert — the exact "
+        "silent-stall class telemetry/flight.py exists to catch. "
+        "Register a WatchdogHandle and beat() once per iteration "
+        "(a lock-free float store), or suppress with a reason when the "
+        "loop legitimately blocks in the kernel (accept()/recv() "
+        "readers whose liveness is owned by socket close).")
+
+    #: The daemon-loop planes the wedge watchdog covers (the ISSUE-13
+    #: scope): serving dispatch, fleet membership, telemetry's own
+    #: loops, and the PS service. Other dirs keep their own lifecycle
+    #: discipline (bare-thread-no-join) without the beat obligation.
+    _SCOPED = ("multiverso_tpu/serving/batcher",
+               "multiverso_tpu/serving/pipeline",
+               "multiverso_tpu/serving/continuous",
+               "multiverso_tpu/fleet/membership",
+               "multiverso_tpu/fleet/router",
+               "multiverso_tpu/telemetry/export",
+               "multiverso_tpu/telemetry/alerts",
+               "multiverso_tpu/parallel/ps_service")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.role == "script":
+            return
+        if ctx.role == "package" and \
+                not any(s in ctx.rel for s in self._SCOPED):
+            return
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call) or \
+                    astutil.resolve_name(node.func, ctx.aliases) != \
+                    "threading.Thread":
+                continue
+            target = next((k.value for k in node.keywords
+                           if k.arg == "target"), None)
+            if target is None:
+                continue
+            fn = self._resolve_target(target, node, ctx)
+            if fn is None:
+                continue        # target defined elsewhere: not provable
+            # The loop may live one delegation level down (the shipped
+            # `with watchdog_scope(...): self._run_x(wd)` shape): check
+            # the target AND the in-file functions it calls.
+            bodies = [fn] + self._delegates(fn, ctx)
+            loop = next((sub for body in bodies for sub in ast.walk(body)
+                         if isinstance(sub, ast.While)), None)
+            if loop is None:
+                continue        # one-shot worker: nothing to wedge
+            if any(self._has_beat_evidence(body) for body in bodies):
+                continue
+            yield self.finding(
+                ctx, loop,
+                f"daemon loop behind Thread target '{fn.name}' has no "
+                "watchdog heartbeat in reach (no watchdog_scope/"
+                "watchdog_register, no .beat() call, in the target or "
+                "its in-file delegates): a wedge here stalls the plane "
+                "with no postmortem — wrap the loop in watchdog_scope "
+                "and beat once per iteration")
+
+    @staticmethod
+    def _resolve_target(target: ast.expr, call: ast.Call,
+                        ctx: FileContext):
+        """The target's FunctionDef when it is visible in this file:
+        ``self._loop`` -> a method of the enclosing class, a bare name
+        -> a function in the enclosing scope chain or at module level."""
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            cls = astutil.enclosing_class(call)
+            if cls is None:
+                return None
+            for sub in cls.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and \
+                        sub.name == target.attr:
+                    return sub
+            return None
+        if isinstance(target, ast.Name):
+            scope = astutil.enclosing_function(call)
+            chain = []
+            if scope is not None:
+                chain.append(scope)
+            chain.append(ctx.tree)
+            for holder in chain:
+                body = getattr(holder, "body", [])
+                for sub in body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) and \
+                            sub.name == target.id:
+                        return sub
+        return None
+
+    @staticmethod
+    def _delegates(fn: ast.AST, ctx: FileContext) -> list:
+        """In-file functions the target calls (one level): same-class
+        methods via ``self.X(...)`` and module/local functions by name.
+        Deeper chains stay unproven — a loop buried two hops down is a
+        structure worth flattening anyway."""
+        cls = astutil.enclosing_class(fn)
+        out = []
+        seen = set()
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = None
+            pool: list = []
+            if isinstance(sub.func, ast.Attribute) and \
+                    isinstance(sub.func.value, ast.Name) and \
+                    sub.func.value.id == "self" and cls is not None:
+                name, pool = sub.func.attr, cls.body
+            elif isinstance(sub.func, ast.Name):
+                name, pool = sub.func.id, ctx.tree.body
+            if name is None or name in seen:
+                continue
+            seen.add(name)
+            for cand in pool:
+                if isinstance(cand, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        cand.name == name:
+                    out.append(cand)
+                    break
+        return out
+
+    @staticmethod
+    def _has_beat_evidence(fn: ast.AST) -> bool:
+        """A ``<anything>.beat()`` call, or a ``watchdog_scope`` /
+        ``watchdog_register`` call, anywhere in the body."""
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in ("beat", "watchdog_register",
+                                      "watchdog_scope"):
+                return True
+            if isinstance(sub.func, ast.Name) and \
+                    sub.func.id in ("watchdog_register",
+                                    "watchdog_scope"):
+                return True
+        return False

@@ -23,18 +23,24 @@ def run_app(body: Callable[[List[str]], int],
     is set, a telemetry exporter runs for the body and writes its final
     snapshot + Chrome trace after shutdown (so every rank of a spawned
     world exports, launcher processes don't)."""
-    from multiverso_tpu.telemetry import (maybe_start_exporter_from_flags,
-                                          stop_exporter)
+    from multiverso_tpu.telemetry import (
+        maybe_start_exporter_from_flags,
+        maybe_start_observability_from_flags, stop_alert_engine,
+        stop_exporter, stop_watchdog)
     try:
         remaining = mv.init(argv if argv is not None else sys.argv[1:])
     except _USER_ERRORS as e:
         log.error("%s", e)
         return 1
     telemetry_on = False
+    observability_on = False
     try:
         # Inside the guarded region: an unwritable -telemetry_dir is a
         # user error (one log line, exit 1) and must still shut down.
         telemetry_on = maybe_start_exporter_from_flags()
+        # Alert engine + wedge watchdog + fatal-signal postmortems
+        # (-telemetry_alerts / -telemetry_flight, both default-on).
+        observability_on = maybe_start_observability_from_flags()
         return body(remaining)
     except _USER_ERRORS as e:
         log.error("%s", e)
@@ -44,9 +50,15 @@ def run_app(body: Callable[[List[str]], int],
             mv.shutdown()
         finally:
             # Even a failed shutdown must not cost the final snapshot —
-            # the failed run is the one an operator most wants to inspect.
+            # the failed run is the one an operator most wants to
+            # inspect. The exporter stops (and writes) BEFORE the alert
+            # engine stops, so the final snapshot still embeds the
+            # engine's alert states and trailing timeseries windows.
             if telemetry_on:
                 stop_exporter()
+            if observability_on:
+                stop_alert_engine()
+                stop_watchdog()
 
 
 # ---------------------------------------------------------------------------

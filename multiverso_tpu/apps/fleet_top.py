@@ -36,6 +36,18 @@ def _fmt_ms(v: float) -> str:
     return f"{v:9.2f}"
 
 
+def _fmt_alerts(alerts) -> str:
+    """Compact ALERTS cell: '-' when quiet, else 'N:first_name' (the
+    full list is in the Fleet_Stats JSON; the table names the loudest)."""
+    alerts = alerts or []
+    if not alerts:
+        return "-"
+    first = str(alerts[0].get("name", "?"))
+    if len(first) > 12:
+        first = first[:11] + "…"
+    return f"{len(alerts)}:{first}"
+
+
 def render_stats(stats: Dict, clear: bool = False) -> str:
     """The fleet table as one string (pure function — unit-testable and
     reused by the bench's --fleet-top embed)."""
@@ -46,14 +58,17 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
     replicas = stats.get("replicas", {})
     stamp = time.strftime("%H:%M:%S",
                           time.localtime(stats.get("time_unix", 0)))
+    router_alerts = stats.get("router_alerts") or []
     lines.append(f"fleet_top  v{stats.get('version', 0)}  {stamp}  "
                  f"replicas={fleet.get('replicas', 0)}  "
                  f"qps={fleet.get('qps', 0.0):.1f}  "
                  f"shed={100 * fleet.get('shed_rate', 0.0):.2f}%  "
-                 f"slo_burn={fleet.get('slo_violations', 0)}")
+                 f"slo_burn={fleet.get('slo_violations', 0)}  "
+                 f"alerts={fleet.get('alerts_active', 0)}")
     header = (f"{'MEMBER':24s} {'HEALTH':>7s} {'QPS':>8s} {'SHED%':>7s} "
               f"{'QUEUE':>6s} {'INFL':>5s} {'P50ms':>9s} {'P95ms':>9s} "
-              f"{'P99ms':>9s} {'SLO':>6s} {'DRAINS':>6s} {'STATE':>8s}")
+              f"{'P99ms':>9s} {'SLO':>6s} {'DRAINS':>6s} {'STATE':>8s} "
+              f"{'ALERTS':>15s}")
     lines.append(header)
     for mid in sorted(replicas):
         r = replicas[mid]
@@ -69,8 +84,12 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
             f"{_fmt_ms(total.get('p95', 0.0))} "
             f"{_fmt_ms(total.get('p99', 0.0))} "
             f"{r.get('slo_violations', 0):6d} "
-            f"{r.get('drains_completed', 0):6d} {state:>8s}")
+            f"{r.get('drains_completed', 0):6d} {state:>8s} "
+            f"{_fmt_alerts(r.get('alerts')):>15s}")
     ftotal = fleet.get("stages", {}).get("total", {})
+    # The router's own alerts (heartbeat loss fires on the ROUTER — a
+    # dead replica cannot report its own absence) render on the FLEET
+    # row: they are fleet-scoped, not any one member's.
     lines.append(
         f"{'FLEET':24s} {'':7s} {fleet.get('qps', 0.0):8.1f} "
         f"{100 * fleet.get('shed_rate', 0.0):7.2f} "
@@ -80,7 +99,8 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
         f"{_fmt_ms(ftotal.get('p95', 0.0))} "
         f"{_fmt_ms(ftotal.get('p99', 0.0))} "
         f"{fleet.get('slo_violations', 0):6d} "
-        f"{'':6s} {'n=%d' % fleet.get('replicas', 0):>8s}")
+        f"{'':6s} {'n=%d' % fleet.get('replicas', 0):>8s} "
+        f"{_fmt_alerts(router_alerts):>15s}")
     return "\n".join(lines)
 
 

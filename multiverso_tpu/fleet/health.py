@@ -108,16 +108,8 @@ def slo_violations(hist, threshold_ms: float) -> int:
     counted from the fixed log-2 buckets: every bucket whose LOWER edge
     is >= the threshold counts whole (an under-count by at most the one
     straddling bucket — a stable burn counter beats an optimistic one)."""
-    with hist._lock:
-        counts = list(hist._counts)
-    total = 0
-    for i, c in enumerate(counts):
-        if not c:
-            continue
-        lower = 0.0 if i == 0 else hist.BOUNDS[i - 1]
-        if lower >= threshold_ms:
-            total += c
-    return total
+    _, counts = hist.raw_counts()
+    return hist.violations_from_counts(counts, threshold_ms)
 
 
 def metrics_payload() -> Dict:
@@ -142,9 +134,14 @@ def metrics_payload() -> Dict:
         stages[key] = {"count": snap["count"], "p50": round(snap["p50"], 4),
                        "p95": round(snap["p95"], 4),
                        "p99": round(snap["p99"], 4)}
+    from multiverso_tpu.telemetry import active_alert_summaries
     return {
         "requests": reg.counter("serve.requests").value,
         "replies": reg.counter("serve.replies").value,
+        # Firing alerts from this replica's in-process engine
+        # (telemetry/alerts.py; [] when no engine runs): the rollup's
+        # ALERTS column rides the heartbeat, no new wire messages.
+        "alerts": active_alert_summaries(),
         "shed": shed,
         "cancelled": reg.counter("serve.cancelled").value,
         "queue_depth": float(reg.gauge("serve.queue_depth").last),
@@ -157,6 +154,11 @@ def metrics_payload() -> Dict:
         "pipeline_inflight_max": float(
             reg.gauge("serve.pipeline.inflight").snapshot()["max"] or 0.0),
         "cache_hits": reg.counter("serve.cache.hit").value,
+        # This replica's wedge-watchdog trips (telemetry/flight.py):
+        # the fleet-wide "nothing wedged" witness lives in the processes
+        # that actually run monitored daemon loops — the replicas — not
+        # in whoever reads the rollup.
+        "watchdog_trips": reg.counter("telemetry.watchdog.trips").value,
         "slo_ms": slo_ms,
         "slo_violations": slo_violations(
             reg.histogram("serve.latency.total"), slo_ms),

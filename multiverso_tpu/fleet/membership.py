@@ -37,7 +37,7 @@ from multiverso_tpu.fleet.health import (STAT_FIELDS, health_score,
                                          local_stats, metrics_payload)
 from multiverso_tpu.parallel.net import (pack_json_blob, recv_message,
                                          send_message, unpack_json_blob)
-from multiverso_tpu.telemetry import counter, gauge, span
+from multiverso_tpu.telemetry import counter, gauge, span, watchdog_scope
 from multiverso_tpu.utils.log import check, log
 
 
@@ -295,8 +295,10 @@ class ReplicaGroup:
                 "pipeline_inflight_max": float(
                     met.get("pipeline_inflight_max", 0.0)),
                 "cache_hits": int(met.get("cache_hits", 0)),
+                "watchdog_trips": int(met.get("watchdog_trips", 0)),
                 "slo_ms": float(met.get("slo_ms", 0.0)),
                 "slo_violations": int(met.get("slo_violations", 0)),
+                "alerts": list(met.get("alerts", [])),
                 "stages": dict(met.get("stages", {})),
             }
         fleet: Dict = {
@@ -314,12 +316,22 @@ class ReplicaGroup:
             "pipeline_inflight": round(sum(p["pipeline_inflight"]
                                            for p in per.values()), 3),
             "cache_hits": sum(p["cache_hits"] for p in per.values()),
+            "watchdog_trips": sum(p["watchdog_trips"]
+                                  for p in per.values()),
             "slo_violations": sum(p["slo_violations"]
                                   for p in per.values()),
         }
         total = fleet["requests"] + fleet["shed"]
         fleet["shed_rate"] = round(fleet["shed"] / total, 5) if total \
             else 0.0
+        # The ROUTER's own alert engine (heartbeat-loss fires HERE — the
+        # dead replica cannot report its own absence) plus the sum of
+        # replica-reported firing alerts: fleet_top's ALERTS column.
+        from multiverso_tpu.telemetry import active_alert_summaries
+        router_alerts = active_alert_summaries()
+        fleet["alerts_active"] = sum(len(p["alerts"])
+                                     for p in per.values()) \
+            + len(router_alerts)
         stages: Dict[str, Dict] = {}
         for p in per.values():
             for key, s in p["stages"].items():
@@ -333,10 +345,17 @@ class ReplicaGroup:
             agg["p50"], agg["p95"], agg["p99"] = \
                 (round(w / n, 4) for w in agg.pop("_wp"))
         fleet["stages"] = stages
+        from multiverso_tpu.telemetry import get_registry
         return {"schema": "multiverso_tpu.fleet_stats/v1",
                 "version": version,
                 "time_unix": time.time(),
                 "heartbeat_ms": self.heartbeat_ms,
+                "router_alerts": router_alerts,
+                # Top-level, NOT in the fleet block: the fleet block's
+                # counters are exact sums of the per-replica rows (the
+                # tier-1 smoke asserts it) and the router is not a row.
+                "router_watchdog_trips": get_registry().counter(
+                    "telemetry.watchdog.trips").value,
                 "replicas": per,
                 "fleet": fleet}
 
@@ -414,7 +433,20 @@ class FleetMember:
 
     # -- heartbeat loop ------------------------------------------------------
     def _loop(self) -> None:
+        # Wedge watchdog: the loop's own RPC is what keeps this replica
+        # in the ring — a heartbeat thread stuck in a recv against a
+        # silent router is indistinguishable from a dead replica to the
+        # fleet, and exactly what the postmortem should show. 240s: the
+        # timeout must ride out _join's WORST-case re-dial against a
+        # packet-dropping peer (connect_with_backoff attempts=6 with
+        # ~30s connect timeouts + backoff sleeps ~ 180s) — a healthy
+        # retry loop must never be named as wedged.
+        with watchdog_scope("fleet-heartbeat", timeout_s=240.0) as wd:
+            self._run_heartbeat_loop(wd)
+
+    def _run_heartbeat_loop(self, wd) -> None:
         while not self._stop.is_set():
+            wd.beat()
             try:
                 if self._sock is None:
                     self._join()

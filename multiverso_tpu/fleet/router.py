@@ -90,6 +90,9 @@ class FleetRouter:
 
     # -- connection handling -------------------------------------------------
     def _accept_loop(self) -> None:
+        # Blocks in accept(); liveness is owned by close()'s listener
+        # teardown, and a beat here could only report kernel readiness.
+        # graftlint: disable=daemon-loop-no-watchdog
         while self._running:
             try:
                 conn, _ = self._listener.accept()
@@ -107,6 +110,9 @@ class FleetRouter:
 
     def _conn_loop(self, conn: socket.socket) -> None:
         try:
+            # Blocks in recv_message(); a silent control connection is
+            # normal, and close() breaks the recv by dropping the conn.
+            # graftlint: disable=daemon-loop-no-watchdog
             while self._running:
                 try:
                     msg = recv_message(conn)
@@ -258,9 +264,16 @@ class FleetRouter:
 
     # -- plumbing ------------------------------------------------------------
     def _sweep_loop(self) -> None:
+        from multiverso_tpu.telemetry import watchdog_scope
         interval = self.group.heartbeat_ms / 1e3
-        while not self._sweep_stop.wait(interval):
-            self.group.sweep()
+        # The sweeper IS the fleet's failure detector: a stuck sweep
+        # means dead replicas stay routable — watchdog it like every
+        # other daemon loop (telemetry/flight.py).
+        with watchdog_scope("fleet-sweeper",
+                            timeout_s=max(30.0, 60 * interval)) as wd:
+            while not self._sweep_stop.wait(interval):
+                wd.beat()
+                self.group.sweep()
 
     def _reply_json(self, conn: socket.socket, msg: Message,
                     reply_type: int, payload: Dict) -> None:

@@ -271,6 +271,14 @@ class PSService:
         self._worker_add_counts: Dict[int, int] = {}
         self._top_add_count = 0
         self._staleness_gauges: Dict[int, object] = {}
+        # Workers that declared Finish_Train: their add stream has
+        # legitimately stopped, so the leader sweep must not keep growing
+        # their published lag (a phantom ps.straggler alert would latch
+        # FOREVER — the lag only ever grows and the alert can never
+        # resolve). A crashed worker that never said goodbye keeps
+        # aging on purpose: from this layer it is indistinguishable from
+        # a wedge, which is exactly what the straggler alert is for.
+        self._retired_staleness: set = set()
         self._io_thread = threading.Thread(target=self._io_loop, daemon=True)
         self._dispatch_thread = threading.Thread(target=self._dispatch_loop,
                                                  daemon=True)
@@ -314,7 +322,17 @@ class PSService:
     # -- server loops --------------------------------------------------------
     def _io_loop(self) -> None:
         from multiverso_tpu.parallel.net import parse_frame
+        from multiverso_tpu.telemetry import watchdog_scope
+        # Wedge watchdog (telemetry/flight.py). Generous timeout: the
+        # bounded-queue put below legitimately blocks while the
+        # dispatcher digests a backlog — that is backpressure, and only
+        # minutes of it is a wedge worth a postmortem.
+        with watchdog_scope("ps-io", timeout_s=120.0) as wd:
+            self._run_io(parse_frame, wd)
+
+    def _run_io(self, parse_frame, wd) -> None:
         while self._running:
+            wd.beat()
             while self._to_drop:
                 self._drop_conn(self._to_drop.popleft())
             self._stage_outgoing()
@@ -491,6 +509,7 @@ class PSService:
         keeps a stalled straggler's lag growing in snapshots) runs only
         when the LEADER advances; otherwise just the sender's gauge moves
         — O(1) amortized on the throughput-critical dispatch thread."""
+        self._retired_staleness.discard(worker)   # an add un-retires
         n = self._worker_add_counts.get(worker, 0) + 1
         self._worker_add_counts[worker] = n
         g = self._staleness_gauges.get(worker)
@@ -504,12 +523,34 @@ class PSService:
                 if gw is None:
                     gw = self._staleness_gauges[w] = gauge(
                         f"ps_service.staleness.worker_{w}")
-                gw.set(n - c)
+                gw.set(0.0 if w in self._retired_staleness else n - c)
         else:
             g.set(self._top_add_count - n)
 
+    def _retire_worker_staleness(self, worker: int) -> None:
+        """A worker said Finish_Train (for ANY table): its add stream is
+        winding down, so stop publishing its lag — zero the gauge now and
+        skip it in leader sweeps, or the ps.straggler alert latches a
+        permanently-firing phantom naming a worker that left cleanly.
+        A worker still training OTHER tables un-retires on its very next
+        add (``_note_worker_add``) and the sweep restores its true lag.
+        Dispatcher-thread only, like all staleness accounting."""
+        self._retired_staleness.add(worker)
+        g = self._staleness_gauges.get(worker)
+        if g is not None:
+            g.set(0.0)
+
     def _dispatch_loop(self) -> None:
+        from multiverso_tpu.telemetry import watchdog_scope
+        # Wedge watchdog: the dispatcher applies device updates — a
+        # kernel that never returns wedges every table this shard
+        # serves. 120s rides out any legitimate big-table dispatch.
+        with watchdog_scope("ps-dispatcher", timeout_s=120.0) as wd:
+            self._run_dispatch(wd)
+
+    def _run_dispatch(self, wd) -> None:
         while True:
+            wd.beat()
             self._g_queue_depth.set(self._queue.qsize())
             self._g_deferred_depth.set(len(self._deferred))
             # Sweep parked requests on EVERY pass (rate-limited), not just
@@ -520,10 +561,10 @@ class PSService:
                 self._replay_deferred()
                 self._next_sweep = time.monotonic() + 0.25
             try:
-                # With requests parked on unregistered tables, poll so their
-                # deadlines expire even if no new traffic arrives.
-                item = self._queue.get(
-                    timeout=0.5 if self._deferred else None)
+                # Bounded get (was: block forever on an idle queue) so an
+                # idle dispatcher still beats its watchdog, and parked
+                # requests' deadlines expire even with no new traffic.
+                item = self._queue.get(timeout=0.5)
             except _queue_mod.Empty:
                 continue
             if item is None:
@@ -822,6 +863,10 @@ class PSService:
             def reg(r=r, addr=tuple(addr)):
                 deadline = time.monotonic() + 600.0
                 delay = 1.0
+                # Bounded-lifetime retry (600s deadline, event-
+                # interruptible backoff), not a service loop: a wedge
+                # here self-resolves at the deadline.
+                # graftlint: disable=daemon-loop-no-watchdog
                 while self._running and time.monotonic() < deadline:
                     # Re-resolve each attempt: the peer may itself have
                     # re-registered at a new address mid-loop.
@@ -885,6 +930,7 @@ class PSService:
             # table_id < 0 (mv.finish_train, process-global) retires all.
             w = (int(msg.data[0][0]) if msg.data and msg.data[0].size
                  else max(msg.src, 0))
+            self._retire_worker_staleness(w)
             with self._lock:
                 if msg.table_id >= 0:
                     # Named table: finish its gate only. Absent gate (async
@@ -1073,6 +1119,10 @@ class PeerClient:
 
     def _read_loop(self) -> None:
         try:
+            # Blocks in recv_message() on a deliberately-idle persistent
+            # connection; liveness is the peer's to prove (ping()), and
+            # socket close breaks the recv on shutdown.
+            # graftlint: disable=daemon-loop-no-watchdog
             while True:
                 msg = recv_message(self._sock)
                 if msg is None:

@@ -45,7 +45,8 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from multiverso_tpu.telemetry import (child_of, counter, current_context,
-                                      emit_span, gauge, histogram, span)
+                                      emit_span, gauge, histogram, span,
+                                      watchdog_scope)
 from multiverso_tpu.telemetry.context import TraceContext
 from multiverso_tpu.utils.log import check, log
 
@@ -149,6 +150,13 @@ class DynamicBatcher:
         self._pipeline = make_pipeline(runner, pipeline_depth)
         # Telemetry (docs/OBSERVABILITY.md catalog, serve.* family).
         self._g_depth = gauge("serve.queue_depth")
+        # The admission bound as a gauge: the saturation alert rule
+        # (telemetry/alerts.py) compares queue_depth against it. Like
+        # serve.queue_depth itself this is process-global — with several
+        # batchers in one process (in-process tests) the last-
+        # constructed bound wins and the alert is best-effort; the
+        # deployed shape is one serving service per process.
+        gauge("serve.queue_bound").set(self.max_queue)
         self._g_inflight = gauge("serve.inflight")
         self._c_requests = counter("serve.requests")
         self._c_batches = counter("serve.batches")
@@ -293,28 +301,35 @@ class DynamicBatcher:
 
     # -- batch formation + dispatch -----------------------------------------
     def _loop(self) -> None:
-        while True:
-            batch = self._gather_batch()
-            if batch is None:
+        # Wedge watchdog: the idle wait inside _gather_batch wakes every
+        # 0.2s and beats, so an idle batcher never trips — only a loop
+        # genuinely stuck (runner wedged, poisoned lock) ages past the
+        # timeout and dumps a postmortem (telemetry/flight.py).
+        with watchdog_scope("serve-batcher", timeout_s=60.0) as wd:
+            self._wd = wd
+            while True:
+                wd.beat()
+                batch = self._gather_batch()
+                if batch is None:
+                    if self._pipeline is not None:
+                        self._pipeline.close()
+                    return
+                if not batch:
+                    self._busy = False      # popped entries all expired
+                    continue
+                self._c_requests.inc(len(batch))
                 if self._pipeline is not None:
-                    self._pipeline.close()
-                return
-            if not batch:
-                self._busy = False      # popped entries all expired
-                continue
-            self._c_requests.inc(len(batch))
-            if self._pipeline is not None:
+                    try:
+                        self._dispatch_batch(batch)
+                    finally:
+                        self._busy = False
+                    continue
+                self._g_inflight.set(len(batch))
                 try:
-                    self._dispatch_batch(batch)
+                    self._run_batch(batch)
                 finally:
                     self._busy = False
-                continue
-            self._g_inflight.set(len(batch))
-            try:
-                self._run_batch(batch)
-            finally:
-                self._busy = False
-            self._g_inflight.set(0)
+                self._g_inflight.set(0)
 
     def quiesce(self, timeout_s: float = 30.0) -> bool:
         """Block until the queue is empty AND no batch is mid-dispatch
@@ -342,6 +357,7 @@ class DynamicBatcher:
         with self._cv:
             while self._running and not self._queue:
                 self._cv.wait(0.2)
+                self._wd.beat()     # idle is progress, not a wedge
             if not self._queue:
                 return None         # shutdown
             head = self._queue[0]

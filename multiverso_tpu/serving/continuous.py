@@ -589,11 +589,19 @@ class ContinuousBatcher(DynamicBatcher):
 
     # -- the iteration loop --------------------------------------------------
     def _loop(self) -> None:  # overrides DynamicBatcher._loop
+        from multiverso_tpu.telemetry import watchdog_scope
+        with watchdog_scope("serve-continuous", timeout_s=60.0) as wd:
+            self._wd = wd
+            self._run_decode_loop(wd)
+
+    def _run_decode_loop(self, wd) -> None:
         while True:
+            wd.beat()
             with self._cv:
                 while self._running and not self._queue \
                         and not self._n_active_locked():
                     self._cv.wait(0.05)
+                    wd.beat()       # idle is progress, not a wedge
                 if not self._running and not self._queue \
                         and not self._n_active_locked():
                     return

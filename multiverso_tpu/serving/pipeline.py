@@ -37,7 +37,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-from multiverso_tpu.telemetry import counter, gauge
+from multiverso_tpu.telemetry import counter, gauge, watchdog_scope
 from multiverso_tpu.utils.log import check, log
 
 # Depth decision table (AUTO): measured one-dispatch round-trip latency
@@ -210,10 +210,19 @@ class DispatchPipeline:
 
     # -- collector -----------------------------------------------------------
     def _collect_loop(self) -> None:
+        # Wedge watchdog: a wedged device sync in collect() is EXACTLY
+        # the stall this loop can hide — the window fills, the producer
+        # backpressures, and the service looks "busy" forever. The 60s
+        # timeout rides out any legitimate tunneled sync.
+        with watchdog_scope("serve-collector", timeout_s=60.0) as wd:
+            self._run_collect(wd)
+
+    def _run_collect(self, wd) -> None:
         while True:
             with self._cv:
                 while self._running and not self._fifo:
                     self._cv.wait(0.2)
+                    wd.beat()       # idle is progress, not a wedge
                 if not self._fifo:
                     return          # closed and drained
                 # Popped-but-undelivered must stay visible to empty():
@@ -223,6 +232,7 @@ class DispatchPipeline:
                 self._collecting = True
                 self._g_inflight.set(len(self._fifo) + 1)
                 self._cv.notify_all()
+            wd.beat()
             try:
                 result: object = item.collect(item.handle)
             except Exception as e:  # noqa: BLE001 - a poisoned batch must

@@ -44,11 +44,8 @@ def _wire_dtype() -> str:
 def _flag_or(name: str, default):
     """Flag value, or ``default`` when flags are unparsed (bare library
     use — unit tests construct services without ``mv.init``)."""
-    from multiverso_tpu.utils.configure import get_flag
-    try:
-        return get_flag(name)
-    except Exception:  # noqa: BLE001 - unparsed flag registry
-        return default
+    from multiverso_tpu.utils.configure import flag_or
+    return flag_or(name, default)
 
 
 class ServingService:

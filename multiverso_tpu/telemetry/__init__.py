@@ -15,9 +15,31 @@ are histogram-backed through this package) plus cross-actor tracing:
 See docs/OBSERVABILITY.md for the metric catalog and schemas.
 """
 
+from multiverso_tpu.telemetry.alerts import (AlertEngine, AlertManager,
+                                             AlertRule, BurnRateRule,
+                                             SaturationRule, StragglerRule,
+                                             ThresholdRule,
+                                             active_alert_summaries,
+                                             default_serving_rules,
+                                             maybe_start_observability_from_flags,
+                                             start_alert_engine,
+                                             stop_alert_engine)
 from multiverso_tpu.telemetry.context import (TraceContext, activate,
                                               child_of, current_context,
                                               maybe_new_root, new_root)
+from multiverso_tpu.telemetry.flight import (POSTMORTEM_SCHEMA,
+                                             FlightRecorder,
+                                             WatchdogHandle,
+                                             build_postmortem,
+                                             dump_postmortem,
+                                             flight_recorder,
+                                             install_crash_handlers,
+                                             start_watchdog, stop_watchdog,
+                                             validate_postmortem,
+                                             watchdog_handles,
+                                             watchdog_register,
+                                             watchdog_scope)
+from multiverso_tpu.telemetry.timeseries import TimeseriesStore
 from multiverso_tpu.telemetry.export import (SNAPSHOT_SCHEMA,
                                              TelemetryExporter,
                                              build_chrome_trace,
@@ -48,4 +70,14 @@ __all__ = [
     "span",
     "TraceContext", "activate", "child_of", "current_context",
     "maybe_new_root", "new_root",
+    "AlertEngine", "AlertManager", "AlertRule", "BurnRateRule",
+    "SaturationRule", "StragglerRule", "ThresholdRule",
+    "active_alert_summaries", "default_serving_rules",
+    "maybe_start_observability_from_flags", "start_alert_engine",
+    "stop_alert_engine",
+    "POSTMORTEM_SCHEMA", "FlightRecorder", "WatchdogHandle",
+    "build_postmortem", "dump_postmortem", "flight_recorder",
+    "install_crash_handlers", "start_watchdog", "stop_watchdog",
+    "validate_postmortem", "watchdog_handles", "watchdog_register",
+    "watchdog_scope", "TimeseriesStore",
 ]

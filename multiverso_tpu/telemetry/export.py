@@ -63,14 +63,30 @@ def _atomic_write_json(path: str, payload: Dict) -> None:
 
 
 def metrics_snapshot(buckets: bool = True, seq: int = 0) -> Dict:
-    """One structured snapshot of every registered metric + identity."""
+    """One structured snapshot of every registered metric + identity.
+    When the alert engine runs, the snapshot additionally embeds the
+    active-alert summary and the trailing timeseries windows (additive
+    sections — ``validate_snapshot`` ignores keys it does not know)."""
     ident = current_identity()
+    # Publish the span ring's cumulative eviction tally before the
+    # registry read so this snapshot carries it (the ring itself counts
+    # lock-locally; see TraceBuffer.record).
+    get_registry().gauge("telemetry.spans.dropped").set(
+        get_trace_buffer().dropped)
     snap = get_registry().snapshot(buckets=buckets)
     snap["schema"] = SNAPSHOT_SCHEMA
     snap["pid"] = ident["pid"]
     snap["rank"] = ident.get("rank", 0)
     snap["seq"] = seq
     snap["time_unix"] = time.time()
+    try:
+        from multiverso_tpu.telemetry import alerts as _alerts
+        eng = _alerts.engine()
+        if eng is not None:
+            snap["alerts"] = eng.manager.snapshot()
+            snap["timeseries"] = eng.store.snapshot(last_n=30)
+    except Exception:  # noqa: BLE001 - the alert embed is attribution;
+        pass           # a broken engine must not cost the base snapshot
     return snap
 
 
@@ -316,14 +332,22 @@ class TelemetryExporter:
         self._thread.start()
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval):
-            try:
-                self.write_once()
-            except OSError:
-                pass    # a full/readonly disk must never kill training
+        from multiverso_tpu.telemetry.flight import watchdog_scope
+        with watchdog_scope("telemetry-exporter",
+                            timeout_s=max(60.0, 6 * self.interval)) as wd:
+            while not self._stop.wait(self.interval):
+                wd.beat()
+                try:
+                    self.write_once()
+                except OSError:
+                    # A full/readonly disk must never kill training —
+                    # but the plane counts its own failures.
+                    get_registry().counter(
+                        "telemetry.export.failures").inc()
 
     def write_once(self) -> str:
         with self._write_lock:
+            t0 = time.perf_counter()
             self._seq += 1
             pid = os.getpid()
             snap = metrics_snapshot(seq=self._seq)
@@ -340,6 +364,10 @@ class TelemetryExporter:
                         self.out_dir, f"metrics-{pid}-{expired:05d}.json"))
                 except OSError:
                     pass    # already pruned / never written
+            # Exporter self-observability: a slow disk shows up as a
+            # rising write latency BEFORE it shows up as lost snapshots.
+            get_registry().histogram("telemetry.export.write_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
             return path
 
     def stop(self) -> None:
@@ -348,7 +376,7 @@ class TelemetryExporter:
         try:
             self.write_once()   # final snapshot: short runs still export
         except OSError:
-            pass
+            get_registry().counter("telemetry.export.failures").inc()
 
 
 _exporter: Optional[TelemetryExporter] = None
@@ -390,7 +418,12 @@ def maybe_start_exporter_from_flags() -> bool:
 
 
 def reset_telemetry() -> None:
-    """Test isolation: stop the exporter, drop every metric and span."""
+    """Test isolation: stop the exporter, alert engine and watchdog,
+    drop every metric, span, and flight event."""
+    from multiverso_tpu.telemetry.alerts import stop_alert_engine
+    from multiverso_tpu.telemetry.flight import reset_flight
+    stop_alert_engine()
+    reset_flight()
     stop_exporter()
     get_registry().reset()
     buf = get_trace_buffer()

@@ -87,32 +87,73 @@ class Histogram:
                 self._max = value_ms
 
     # -- quantiles ---------------------------------------------------------
-    def _percentile_locked(self, q: float) -> float:
-        if self.count == 0:
+    @classmethod
+    def percentile_from_counts(cls, counts, total: int, q: float,
+                               value_min: Optional[float] = None,
+                               value_max: Optional[float] = None) -> float:
+        """Geometric-interpolated percentile over log-2 bucket counts —
+        THE one statement of what a bucket means, shared by the
+        cumulative path (which passes its exact observed extrema for
+        clamping and the overflow-bucket upper edge) and the timeseries
+        plane's windowed DELTAS (which track no extrema and take the
+        bucket edges: overflow caps at one more geometric step)."""
+        if total <= 0:
             return 0.0
-        rank = q * self.count
+        rank = q * total
         cum = 0
-        for i, c in enumerate(self._counts):
+        for i, c in enumerate(counts):
             if c == 0:
                 continue
             if cum + c >= rank:
                 if i == 0:
-                    lo, hi = self.LO_MS / self.BASE, self.BOUNDS[0]
-                elif i < self.N_BOUNDS:
-                    lo, hi = self.BOUNDS[i - 1], self.BOUNDS[i]
+                    lo, hi = cls.LO_MS / cls.BASE, cls.BOUNDS[0]
+                elif i < cls.N_BOUNDS:
+                    lo, hi = cls.BOUNDS[i - 1], cls.BOUNDS[i]
                 else:
-                    lo = self.BOUNDS[-1]
-                    hi = max(self._max, lo)
+                    lo = cls.BOUNDS[-1]
+                    hi = max(value_max, lo) if value_max is not None \
+                        else lo * cls.BASE
                 frac = min(max((rank - cum) / c, 0.0), 1.0)
                 val = lo * (hi / lo) ** frac if hi > lo > 0.0 else hi
-                # Observed extrema are exact; the bucket edges are not.
-                return float(min(max(val, self._min), self._max))
+                if value_min is not None and value_max is not None:
+                    # Observed extrema are exact; bucket edges are not.
+                    val = min(max(val, value_min), value_max)
+                return float(val)
             cum += c
-        return float(self._max)
+        return float(value_max if value_max is not None
+                     else cls.BOUNDS[-1])
+
+    @classmethod
+    def violations_from_counts(cls, counts, threshold_ms: float) -> int:
+        """Observations at/above ``threshold_ms``: every bucket whose
+        LOWER edge clears the threshold counts whole — an under-count by
+        at most the one straddling bucket (a stable burn counter beats
+        an optimistic one). Shared by ``fleet.health.slo_violations``
+        (cumulative) and the timeseries ``bad.*`` series (deltas)."""
+        total = 0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            lower = 0.0 if i == 0 else cls.BOUNDS[i - 1]
+            if lower >= threshold_ms:
+                total += c
+        return total
+
+    def _percentile_locked(self, q: float) -> float:
+        return self.percentile_from_counts(
+            self._counts, self.count, q,
+            value_min=self._min, value_max=self._max)
 
     def percentile(self, q: float) -> float:
         with self._lock:
             return self._percentile_locked(q)
+
+    def raw_counts(self) -> tuple:
+        """``(count, bucket_counts)`` under the lock — the timeseries
+        sampler's entry point (windowed percentiles come from DELTAS of
+        these, so the full snapshot would be wasted work per tick)."""
+        with self._lock:
+            return self.count, list(self._counts)
 
     def snapshot(self) -> Dict:
         """Consistent point-in-time view (single lock acquisition)."""
@@ -218,6 +259,15 @@ class MetricsRegistry:
             if g is None:
                 g = self._gauges[name] = Gauge(name)
             return g
+
+    def metrics(self) -> tuple:
+        """Raw metric objects ``(histograms, counters, gauges)`` — the
+        timeseries sampler's entry point. Each metric guards its own
+        state; the registry lock only covers the dict reads."""
+        with self._lock:
+            return (list(self._histograms.values()),
+                    list(self._counters.values()),
+                    list(self._gauges.values()))
 
     def snapshot(self, buckets: bool = True) -> Dict:
         """Structured view of every metric. ``buckets=False`` drops the

@@ -65,6 +65,12 @@ class TraceBuffer:
             if len(self._events) == self.capacity:
                 self.dropped += 1       # deque evicts the oldest
             self._events.append(event)
+        # The cumulative drop tally is PUBLISHED by the samplers
+        # (exporter snapshot / timeseries tick) as the
+        # telemetry.spans.dropped gauge — a full ring is the PERMANENT
+        # steady state of a long traced run, so a per-drop registry
+        # counter here would put a global-lock acquisition on every
+        # sampled span for the rest of the process lifetime.
 
     def events(self) -> List[Dict]:
         with self._lock:

@@ -155,6 +155,17 @@ def set_flag(name: str, value: Any) -> None:
     _registry.set(name, value)
 
 
+def flag_or(name: str, default: Any) -> Any:
+    """Flag value, or ``default`` when the flag registry is unparsed /
+    the flag unknown — for bare library use (unit tests construct
+    services and telemetry without ``mv.init``). THE one shared
+    fallback helper; sites must not grow their own."""
+    try:
+        return _registry.get(name)
+    except Exception:  # noqa: BLE001 - unparsed registry IS the signal
+        return default
+
+
 def parse_cmd_flags(argv: Optional[List[str]]) -> List[str]:
     return _registry.parse_cmd_flags(argv)
 
@@ -342,3 +353,25 @@ define_double("telemetry_slow_ms", 100.0, "tail-exemplar threshold: a "
 define_double("serve_slo_ms", 50.0, "serving latency SLO: requests whose "
               "total latency exceeds this count toward the fleet "
               "rollup's slo_violations burn counter")
+# SLO burn-rate alerting + flight recorder (telemetry/alerts.py,
+# telemetry/flight.py; docs/OBSERVABILITY.md "Alerting").
+define_double("serve_slo_budget", 0.05, "SLO error budget: fraction of "
+              "requests allowed over -serve_slo_ms before burn rate 1.0")
+define_double("serve_slo_fast_s", 5.0, "fast burn-rate window (seconds): "
+              "catches an acute SLO breach within this horizon")
+define_double("serve_slo_slow_s", 60.0, "slow burn-rate window (seconds): "
+              "both windows must burn before the alert fires, so a "
+              "single spike never pages")
+define_double("serve_slo_burn", 2.0, "burn-rate threshold that BOTH "
+              "windows must exceed: (bad/total)/budget")
+define_bool("telemetry_alerts", True, "run the in-process alert engine "
+            "(timeseries ticker + SLO burn / saturation / heartbeat-loss "
+            "/ straggler rules); alerts ride the fleet heartbeat into "
+            "Fleet_Stats and fleet_top")
+define_bool("telemetry_flight", True, "arm the flight recorder's wedge "
+            "watchdog monitor and fatal-signal (SIGABRT/SIGQUIT) "
+            "postmortem handlers; dumps land in "
+            "-telemetry_dir/postmortem-<pid>.json")
+define_double("telemetry_ts_interval", 1.0, "seconds between timeseries "
+              "ticks / alert rule evaluations (the downsampled window "
+              "width burn rates are computed over)")

@@ -8,12 +8,13 @@ Fatal.
 
 from __future__ import annotations
 
+import collections
 import enum
 import os
 import sys
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 
 class LogLevel(enum.IntEnum):
@@ -29,11 +30,17 @@ class FatalError(RuntimeError):
 
 
 class Logger:
+    #: Recent-line ring depth: the flight recorder's log tail
+    #: (telemetry/flight.py) reads the crash-adjacent window from here.
+    RING_DEPTH = 256
+
     def __init__(self, level: LogLevel = LogLevel.INFO):
         self._level = level
         self._file = None
         self._kill_fatal = False  # raise by default; os._exit if enabled
         self._lock = threading.Lock()
+        self._ring: "collections.deque[str]" = collections.deque(
+            maxlen=self.RING_DEPTH)
 
     # -- configuration -----------------------------------------------------
     def set_level(self, level: LogLevel) -> None:
@@ -62,12 +69,19 @@ class Logger:
         stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime())
         line = f"[{level.name}] [{stamp}] [{os.getpid()}] {msg}"
         with self._lock:
+            self._ring.append(line)
             stream = sys.stderr if level >= LogLevel.ERROR else sys.stdout
             # The ONE sanctioned print in the framework: this module IS
             # the emitter everything else routes through.
             print(line, file=stream)  # graftlint: disable=bare-print
             if self._file is not None:
                 self._file.write(line + "\n")
+
+    def recent(self, n: int = 100) -> List[str]:
+        """The last ``n`` emitted lines (bounded ring, always on) — the
+        postmortem's crash-adjacent log window."""
+        with self._lock:
+            return list(self._ring)[-max(int(n), 1):]
 
     def raw(self, msg: str, *args: Any) -> None:
         """Un-leveled, un-stamped line to stdout (+ file sink): CLI result

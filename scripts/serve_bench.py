@@ -347,6 +347,106 @@ def _export_local_trace(tdir: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Observability legs (ISSUE 13): steady-state overhead A/B of the
+# timeseries+alerts+watchdog plane, a deterministic synthetic SLO-breach
+# witness of the burn-rate state machine, and the bench process's own
+# watchdog steady-state (trips must stay 0 when nothing is wedged).
+# ---------------------------------------------------------------------------
+def _observability_ab(args, run_window) -> dict:
+    """Interleaved A/B (plain, observed, plain, observed): QPS with the
+    alert engine + watchdog monitor running vs without. The watchdog
+    BEATS run in both legs (they are unconditional attribute stores in
+    the daemon loops); the A/B isolates the ticker + monitor threads —
+    the part ``-telemetry_alerts``/``-telemetry_flight`` can turn off."""
+    from multiverso_tpu.telemetry import (start_alert_engine,
+                                          start_watchdog,
+                                          stop_alert_engine,
+                                          stop_watchdog)
+    dur = max(args.duration / 2, 1.0)
+    n = {"plain": 0, "observed": 0}
+    elapsed = {"plain": 0.0, "observed": 0.0}
+    for _round in range(2):
+        for mode in ("plain", "observed"):
+            if mode == "observed":
+                start_alert_engine(interval_s=0.25)
+                start_watchdog()
+            stats = _LoadStats()
+            el = run_window(stats, dur)
+            if mode == "observed":
+                stop_alert_engine()
+                stop_watchdog()
+            n[mode] += len(stats.latencies)
+            elapsed[mode] += el
+    qps_plain = n["plain"] / elapsed["plain"] if elapsed["plain"] else 0.0
+    qps_obs = n["observed"] / elapsed["observed"] \
+        if elapsed["observed"] else 0.0
+    overhead = round(100.0 * (1.0 - qps_obs / qps_plain), 2) \
+        if qps_plain > 0 else 0.0
+    return {"qps_plain": round(qps_plain, 1),
+            "qps_observed": round(qps_obs, 1),
+            "overhead_pct": overhead,
+            "windows": 4, "window_s": dur}
+
+
+def _slo_breach_probe(args) -> dict:
+    """Deterministic synthetic SLO breach against the SHIPPED burn-rate
+    state machine: manual ticks (no wall clock) drive a clean baseline,
+    one tolerated spike, then a sustained breach that must fire within
+    the fast window, then a recovery that must resolve. Observations go
+    into a histogram OUTSIDE the serve.* family: the record embeds
+    `_metric_families(("serve.",))`, and a serve.*-named synthetic
+    histogram would fold its fake 500ms tail into every downstream
+    serve-latency aggregation of the published record."""
+    from multiverso_tpu.telemetry import (AlertManager, BurnRateRule,
+                                          TimeseriesStore, get_registry)
+    hist_name = "bench.synthetic_slo"
+    fast, slow = 5, 30
+    store = TimeseriesStore()
+    rule = BurnRateRule("serve.slo_burn", hist_name, slo_ms=50.0,
+                        budget=0.05, fast_windows=fast, slow_windows=slow,
+                        burn_threshold=2.0, min_count=8,
+                        for_windows=2, clear_windows=3)
+    # shared_telemetry=False: this probe's synthetic firings must not
+    # pollute the process's real telemetry.alerts.* counters or the
+    # flight ring (a later postmortem would show a fake alert).
+    mgr = AlertManager(store, [rule], shared_telemetry=False)
+    h = get_registry().histogram(hist_name)
+    clock = [0.0]
+
+    def window(good, bad):
+        for _ in range(good):
+            h.observe(1.0)
+        for _ in range(bad):
+            h.observe(500.0)
+        clock[0] += 1.0
+        store.tick(now=clock[0])
+        mgr.evaluate()
+
+    for _ in range(slow):
+        window(20, 0)
+    baseline_quiet = not mgr.active()
+    window(0, 20)                       # one spike
+    spike_tolerated = not mgr.active()
+    windows_to_fire = 0
+    while not mgr.active() and windows_to_fire < 2 * slow:
+        window(0, 20)
+        windows_to_fire += 1
+    fired = bool(mgr.active())
+    while mgr.active() and clock[0] < 4 * slow:
+        window(20, 0)
+    return {"synthetic": True,
+            "baseline_quiet": baseline_quiet,
+            "spike_tolerated": spike_tolerated,
+            "fired": fired,
+            # +1: the spike window already counts toward the breach.
+            "windows_to_fire": windows_to_fire + 1,
+            "fast_windows": fast,
+            "fired_within_fast_window": fired
+            and windows_to_fire + 1 <= fast,
+            "resolved": not mgr.active()}
+
+
+# ---------------------------------------------------------------------------
 # Decode memory hierarchy leg (ISSUE 11 / docs/SERVING.md): paged KV vs
 # preallocated users-per-chip at a fixed simulated HBM budget, prefix-cache
 # reuse witness, f32/bf16/int8 storage comparison — all with the bitwise
@@ -636,6 +736,30 @@ def run_single(args) -> dict:
                                lambda: {"bench": _proc_cpu_s(os.getpid())},
                                cores=os.cpu_count())
 
+    # Observability legs (ISSUE 13): steady-state overhead A/B of the
+    # alerts+watchdog plane against the LIVE service, plus the
+    # deterministic synthetic burn-rate witness.
+    observability = None
+    if args.dry_run or args.obs_ab:
+        from multiverso_tpu.telemetry import get_registry
+        trips0 = get_registry().counter("telemetry.watchdog.trips").value
+
+        def ab_window(stats_w, dur):
+            return _run_load(do_request, stats_w, args.threads, args.qps,
+                             dur, args.rows, args.keys_per_req, sampler)
+        observability = {
+            "ab": _observability_ab(args, ab_window),
+            "slo_breach": _slo_breach_probe(args),
+            # Stuck-free steady state: the bench process runs the
+            # batcher/collector/exporter loops — none may have tripped.
+            "watchdog": {
+                "trips": get_registry().counter(
+                    "telemetry.watchdog.trips").value - trips0,
+                "loops": float(get_registry().gauge(
+                    "telemetry.watchdog.loops").last),
+            },
+        }
+
     for cli in clients:
         cli.close()
     service.close()
@@ -652,6 +776,8 @@ def run_single(args) -> dict:
                           _metric_families(("serve.",)))
     record["process_cpu_pct"] = {"bench": cpu_pct}
     record["pipeline"] = probe
+    if observability is not None:
+        record["observability"] = observability
     if sweep is not None:
         record["qps_sweep"] = sweep
     if decode_block is not None:
@@ -798,6 +924,10 @@ def _spawn_router(args, tdir: str, addr_file: str) -> subprocess.Popen:
            f"-serve_duration={lifetime}",
            f"-telemetry_dir={tdir}",
            "-telemetry_interval=2",
+           # Fast alert windows: the fault drill asserts the router's
+           # heartbeat-loss alert within a 4s dry-run drill window.
+           "-telemetry_alerts=true", "-telemetry_flight=true",
+           "-telemetry_ts_interval=0.25",
            "-serve_device=cpu"]
     return subprocess.Popen(cmd, cwd=_REPO)
 
@@ -805,6 +935,10 @@ def _spawn_router(args, tdir: str, addr_file: str) -> subprocess.Popen:
 def _spawn_replica(args, router_addr, idx: int,
                    tdir: str) -> subprocess.Popen:
     lifetime = args.duration * 3 + 300  # generous: parent stops at exit
+    # --slo-drill: replica-0 gets an unreachable SLO so its burn-rate
+    # alert PROVABLY fires under real load and rides its heartbeat into
+    # Fleet_Stats/fleet_top (the end-to-end alert-shipping witness).
+    slo_ms = 0.01 if args.slo_drill and idx == 0 else None
     cmd = [sys.executable, "-m", "multiverso_tpu.apps.fleet_main",
            "-fleet_role=replica",
            f"-fleet_router={router_addr[0]}:{router_addr[1]}",
@@ -821,7 +955,11 @@ def _spawn_replica(args, router_addr, idx: int,
            f"-serve_duration={lifetime}",
            f"-telemetry_dir={tdir}",
            "-telemetry_interval=2",
+           "-telemetry_alerts=true", "-telemetry_flight=true",
+           "-telemetry_ts_interval=0.25",
            "-serve_device=cpu"]
+    if slo_ms is not None:
+        cmd.append(f"-serve_slo_ms={slo_ms}")
     return subprocess.Popen(cmd, cwd=_REPO)
 
 
@@ -999,6 +1137,64 @@ def _trace_smoke_requests(args, fleet, router_addr) -> None:
         proxy_cli.close()
 
 
+def _await_fleet_alert(router_addr, match, timeout_s: float = 15.0):
+    """Poll the router's rollup until ``match(stats)`` is truthy; returns
+    ``(fired, last_stats)`` — the one poll-fetch-retry loop behind every
+    alert-shipping witness (heartbeat loss, SLO burn)."""
+    from multiverso_tpu.fleet import fetch_fleet_stats
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            st = fetch_fleet_stats(router_addr)
+        except Exception:  # noqa: BLE001 - transient mid-drill; retry
+            time.sleep(0.2)
+            continue
+        last = st
+        if match(st):
+            return True, st
+        time.sleep(0.2)
+    return False, last
+
+
+def _await_heartbeat_loss(router_addr, timeout_s: float = 15.0) -> dict:
+    """Until the router's own alert engine reports the heartbeat-loss
+    alert the kill must have caused (the dead replica cannot report its
+    own absence — detection lives on the router)."""
+    fired, st = _await_fleet_alert(
+        router_addr,
+        lambda st: any(a.get("name") == "fleet.heartbeat_loss"
+                       for a in st.get("router_alerts", [])),
+        timeout_s=timeout_s)
+    return {"fired": fired,
+            "router_alerts": (st or {}).get("router_alerts", [])}
+
+
+def _await_postmortem(tdir: str, victim_pid: int,
+                      timeout_s: float = 20.0) -> dict:
+    """Wait for the victim's postmortem dump and schema-validate it —
+    the fault drill's 'the corpse left an artifact' witness."""
+    from multiverso_tpu.telemetry import validate_postmortem
+    path = os.path.join(tdir, f"postmortem-{victim_pid}.json")
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and not os.path.exists(path):
+        time.sleep(0.1)
+    if not os.path.exists(path):
+        return {"found": False, "valid": False, "path": path}
+    try:
+        with open(path) as f:
+            pm = json.load(f)
+        validate_postmortem(pm)
+    except (OSError, ValueError) as e:
+        return {"found": True, "valid": False, "path": path,
+                "error": str(e)}
+    return {"found": True, "valid": True, "path": path,
+            "reason_kind": pm["reason"]["kind"],
+            "signal": pm["reason"].get("signal_name"),
+            "n_threads": len(pm["threads"]),
+            "n_log_lines": len(pm["flight"]["logs"])}
+
+
 def run_fleet(args) -> dict:
     from multiverso_tpu.fleet import FleetClient, fetch_fleet_stats
     from multiverso_tpu.telemetry import TraceBuffer, get_trace_buffer
@@ -1080,81 +1276,6 @@ def run_fleet(args) -> dict:
                                    - cpu0[f"replica-{i}"]) / wall, 1)
                       for i, p in enumerate(procs)}}
 
-        # Phase C — drill window: fresh load with the drain/fault drills
-        # running against it (drained + killed replicas also land in the
-        # traces, since sampling stays on).
-        drill: dict = {}
-        if args.drain_drill or (args.fault_drill and len(procs) > 1):
-            dstats = _LoadStats()
-            drill_state: dict = {}
-
-            def drills():
-                # Drain drill at 30% of the window: rolling-drain the
-                # whole fleet (wire-triggered, the operator path) while
-                # load runs; count request errors in the window.
-                if args.drain_drill:
-                    time.sleep(args.duration * 0.3)
-                    with dstats.lock:
-                        e0 = dstats.errors
-                    t0 = time.monotonic()
-                    ok = _wire_rolling_drain(router_addr, fleet,
-                                             timeout_s=60)
-                    with dstats.lock:
-                        e1 = dstats.errors
-                    drill_state["drain"] = {
-                        "completed": bool(ok),
-                        "duration_s": round(time.monotonic() - t0, 3),
-                        "failed_requests": e1 - e0,
-                    }
-                # Fault drill at 60%: SIGKILL one replica under load.
-                if args.fault_drill and len(procs) > 1:
-                    now = time.monotonic()
-                    target = args.duration * 0.6 - (now - t_start[0])
-                    if target > 0:
-                        time.sleep(target)
-                    victim = procs[-1]
-                    t_kill = time.monotonic()
-                    victim.send_signal(signal.SIGKILL)
-                    drill_state["t_kill"] = t_kill
-
-            t_start = [time.monotonic()]
-            driller = threading.Thread(target=drills, daemon=True)
-            driller.start()
-            t_start[0] = time.monotonic()
-            d_elapsed = _run_fleet_load(fleet, dstats, args.threads,
-                                        args.qps, args.duration,
-                                        args.rows, args.keys_per_req,
-                                        args.deadline_ms)
-            driller.join(timeout=120)
-
-            drill = {k: v for k, v in drill_state.items()
-                     if k != "t_kill"}
-            if "t_kill" in drill_state:
-                t_kill = drill_state["t_kill"]
-                window_s = (args.liveness_misses
-                            * args.heartbeat_ms) / 1e3
-                with dstats.lock:
-                    in_window = sum(1 for t in dstats.error_times
-                                    if t_kill <= t <= t_kill + window_s)
-                    after = sum(1 for t in dstats.error_times
-                                if t > t_kill)
-                drill["fault"] = {
-                    "killed": "replica-%d" % (len(procs) - 1),
-                    "errors_after_kill": after,
-                    "errors_in_liveness_window": in_window,
-                    "errors_past_window": after - in_window,
-                    "liveness_window_s": window_s,
-                }
-            with dstats.lock:
-                drill["window"] = {
-                    "achieved_qps": round(len(dstats.latencies)
-                                          / d_elapsed, 1)
-                    if d_elapsed > 0 else 0.0,
-                    "n_ok": len(dstats.latencies),
-                    "n_shed": dstats.sheds,
-                    "n_error": dstats.errors,
-                }
-
         # Offered-QPS sweep (one curve, one history record) — untraced,
         # after the headline windows so it cannot contaminate them.
         sweep = None
@@ -1187,10 +1308,138 @@ def run_fleet(args) -> dict:
                     pass    # a drain-lagged replica may shed one; the
                             # witness only needs one hit to land
 
-        # Guaranteed-sampled probes for the stitched-trace acceptance
-        # checks, then the router's cluster-wide rollup.
+        # Guaranteed-sampled probes + the cluster rollup BEFORE the
+        # drills (ISSUE 13 reorder): the hedged-sibling and 2-replica
+        # Fleet_Stats witnesses need the full fleet alive, and the fault
+        # drill is about to kill a replica for good.
         _trace_smoke_requests(args, fleet, router_addr)
         fleet_stats = fetch_fleet_stats(router_addr)
+
+        # SLO-burn alert shipping witness (--slo-drill): replica-0 runs
+        # with an unreachable SLO, so the headline load must have fired
+        # its burn alert — poll the ROUTER's rollup until the replica's
+        # heartbeat-shipped alert shows in Fleet_Stats.
+        slo_breach = None
+        if args.slo_drill:
+            def _r0_burn(st):
+                return any(a.get("name") == "serve.slo_burn"
+                           for a in st.get("replicas", {})
+                           .get("replica-0", {}).get("alerts", []))
+            fired, st = _await_fleet_alert(router_addr, _r0_burn,
+                                           timeout_s=20)
+            if fired:
+                slo_breach = {"fired": True, "replica": "replica-0",
+                              "alerts": st["replicas"]["replica-0"]
+                              ["alerts"],
+                              "alerts_active_fleet":
+                              st["fleet"].get("alerts_active", 0)}
+                fleet_stats = st    # the rollup WITH the alert
+            else:
+                slo_breach = {"fired": False, "replica": "replica-0",
+                              "alerts": []}
+
+        # Phase C — drill window: fresh load with the drain/fault drills
+        # running against it (drained + killed replicas also land in the
+        # traces, since sampling stays on).
+        drill: dict = {}
+        if args.drain_drill or (args.fault_drill and len(procs) > 1):
+            dstats = _LoadStats()
+            drill_state: dict = {}
+
+            def drills():
+                # Drain drill at 30% of the window: rolling-drain the
+                # whole fleet (wire-triggered, the operator path) while
+                # load runs; count request errors in the window.
+                if args.drain_drill:
+                    time.sleep(args.duration * 0.3)
+                    with dstats.lock:
+                        e0 = dstats.errors
+                    t0 = time.monotonic()
+                    ok = _wire_rolling_drain(router_addr, fleet,
+                                             timeout_s=60)
+                    with dstats.lock:
+                        e1 = dstats.errors
+                    drill_state["drain"] = {
+                        "completed": bool(ok),
+                        "duration_s": round(time.monotonic() - t0, 3),
+                        "failed_requests": e1 - e0,
+                    }
+                # Fault drill at 60%: abrupt-kill one replica under
+                # load. SIGABRT instead of SIGKILL (ISSUE 13): the
+                # victim's fatal-signal handler dumps a postmortem and
+                # then re-raises the signal with SIG_DFL, so death is
+                # exactly as abrupt (no drain, no goodbye, in-flight
+                # requests dropped — the masking story is unchanged)
+                # but the corpse leaves an artifact.
+                if args.fault_drill and len(procs) > 1:
+                    now = time.monotonic()
+                    target = args.duration * 0.6 - (now - t_start[0])
+                    if target > 0:
+                        time.sleep(target)
+                    victim = procs[-1]
+                    t_kill = time.monotonic()
+                    victim.send_signal(signal.SIGABRT)
+                    drill_state["t_kill"] = t_kill
+                    drill_state["victim_pid"] = victim.pid
+                    # Poll for the router's heartbeat-loss alert NOW,
+                    # while the load window still runs: the alert is
+                    # transient (fires once on the death, resolves after
+                    # ~5s of quiet), so a poll that only starts after a
+                    # long load window would find it already resolved
+                    # and wrongly record a detection failure.
+                    drill_state["heartbeat_loss"] = _await_heartbeat_loss(
+                        router_addr)
+
+            t_start = [time.monotonic()]
+            driller = threading.Thread(target=drills, daemon=True)
+            driller.start()
+            t_start[0] = time.monotonic()
+            d_elapsed = _run_fleet_load(fleet, dstats, args.threads,
+                                        args.qps, args.duration,
+                                        args.rows, args.keys_per_req,
+                                        args.deadline_ms)
+            driller.join(timeout=120)
+
+            drill = {k: v for k, v in drill_state.items()
+                     if k not in ("t_kill", "victim_pid",
+                                  "heartbeat_loss")}
+            if "t_kill" in drill_state:
+                t_kill = drill_state["t_kill"]
+                window_s = (args.liveness_misses
+                            * args.heartbeat_ms) / 1e3
+                with dstats.lock:
+                    in_window = sum(1 for t in dstats.error_times
+                                    if t_kill <= t <= t_kill + window_s)
+                    after = sum(1 for t in dstats.error_times
+                                if t > t_kill)
+                drill["fault"] = {
+                    "killed": "replica-%d" % (len(procs) - 1),
+                    "signal": "SIGABRT",
+                    "errors_after_kill": after,
+                    "errors_in_liveness_window": in_window,
+                    "errors_past_window": after - in_window,
+                    "liveness_window_s": window_s,
+                    # Detection + artifact evidence (ISSUE 13): the
+                    # router must ALERT on the death and the victim
+                    # must leave a parseable postmortem. The alert poll
+                    # ran in the drill thread, concurrent with the kill;
+                    # the fallback covers a drill thread that died
+                    # before storing its result.
+                    "heartbeat_loss_alert": drill_state.get(
+                        "heartbeat_loss") or _await_heartbeat_loss(
+                            router_addr),
+                    "postmortem": _await_postmortem(
+                        tdir, drill_state["victim_pid"]),
+                }
+            with dstats.lock:
+                drill["window"] = {
+                    "achieved_qps": round(len(dstats.latencies)
+                                          / d_elapsed, 1)
+                    if d_elapsed > 0 else 0.0,
+                    "n_ok": len(dstats.latencies),
+                    "n_shed": dstats.sheds,
+                    "n_error": dstats.errors,
+                }
 
         record = _make_record("serve_fleet_lookup", args, stats, elapsed,
                               _metric_families(("serve.", "fleet.")))
@@ -1207,6 +1456,34 @@ def run_fleet(args) -> dict:
                  for p in per.values()], default=0.0),
             "cache_hits": int(fleet_stats.get("fleet", {})
                               .get("cache_hits", 0)),
+        }
+        # Watchdog steady state, measured where the monitored daemon
+        # loops actually RUN — the replica + router subprocesses (the
+        # bench client process registers no watchdog handles, so its own
+        # counter can only ever read 0 and proves nothing). Trips ship
+        # on the heartbeat into the rollup; merge the pre-drill and
+        # post-drill rollups per replica (max of each) — the fault
+        # drill's victim is swept from the ring, so the final rollup
+        # alone would silently DROP any trips it reported before dying.
+        final_stats = fleet_stats
+        try:
+            final_stats = fetch_fleet_stats(router_addr)
+        except Exception:  # noqa: BLE001 - router gone at teardown edge
+            pass
+        trips_by: dict = {}
+        for st in (fleet_stats, final_stats):
+            for rid, row in st.get("replicas", {}).items():
+                trips_by[rid] = max(trips_by.get(rid, 0),
+                                    int(row.get("watchdog_trips", 0)))
+        record["observability"] = {
+            "slo_breach": slo_breach,
+            "watchdog": {
+                "fleet_trips": sum(trips_by.values()),
+                "router_trips": max(
+                    int(fleet_stats.get("router_watchdog_trips", 0)),
+                    int(final_stats.get("router_watchdog_trips", 0))),
+                "monitored_replicas": len(trips_by),
+            },
         }
         if sweep is not None:
             record["qps_sweep"] = sweep
@@ -1264,7 +1541,12 @@ def _make_record(benchmark: str, args, stats: _LoadStats,
         # v5: + decode_memory block (paged-vs-prealloc users-per-chip at
         # a fixed simulated HBM budget, prefix-reuse witness, kv-dtype
         # comparison, bitwise parity witness embedded).
-        "schema": "multiverso_tpu.bench_serve/v5",
+        # v6: + observability block (alerts/watchdog overhead A/B,
+        # synthetic SLO-breach burn-rate witness, watchdog steady
+        # state), fleet drill.fault gains heartbeat_loss_alert +
+        # postmortem (SIGABRT fault drill), fleet_stats rows carry
+        # per-replica alerts + router_alerts.
+        "schema": "multiverso_tpu.bench_serve/v6",
         "benchmark": benchmark,
         "time_unix": time.time(),
         "config": {k: (v if not isinstance(v, tuple) else list(v))
@@ -1344,7 +1626,18 @@ def main() -> int:
     p.add_argument("--drain-drill", action="store_true",
                    help="rolling-drain every replica mid-load")
     p.add_argument("--fault-drill", action="store_true",
-                   help="SIGKILL one replica mid-load")
+                   help="abrupt-kill one replica mid-load (SIGABRT: as "
+                   "sudden as SIGKILL for the fleet, but the victim's "
+                   "fatal-signal handler leaves a postmortem dump); the "
+                   "record asserts a router heartbeat-loss alert fired "
+                   "and the dump parsed")
+    p.add_argument("--slo-drill", action="store_true",
+                   help="give replica-0 an unreachable SLO so its "
+                   "burn-rate alert provably fires under load and ships "
+                   "via heartbeat into Fleet_Stats/fleet_top")
+    p.add_argument("--obs-ab", action="store_true",
+                   help="run the observability overhead A/B leg "
+                   "(alerts+watchdog on vs off) in single mode")
     p.add_argument("--baseline", default="",
                    help="previous record to compute scaleout ratio against")
     p.add_argument("--sample-rate", type=float, default=0.05,
@@ -1373,6 +1666,12 @@ def main() -> int:
             args.cache_rows = 1024
         if args.replicas:
             args.drain_drill = True
+            # ...and the observability plane (ISSUE 13): the fault
+            # drill's heartbeat-loss alert + postmortem witnesses and
+            # the SLO-burn alert-shipping witness.
+            args.slo_drill = True
+            if args.replicas >= 2:
+                args.fault_drill = True
 
     record = run_fleet(args) if args.replicas >= 1 else run_single(args)
     _emit(record, args.out)

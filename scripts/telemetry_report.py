@@ -24,6 +24,10 @@ Usage:
     python scripts/telemetry_report.py /tmp/t --stitch /tmp/one.json \\
         --trace-id 00c0ffee...   # a single request's end-to-end timeline
 
+    # read the flight recorder's crash/wedge artifacts: reason, thread
+    # stacks, watchdog ages, active alerts, log tail
+    python scripts/telemetry_report.py /tmp/t --postmortem
+
 No jax import: usable on any host, including ones without the TPU tunnel.
 """
 
@@ -159,6 +163,53 @@ def print_diff(new_dir, base_dir):
         print(f"{name:40s} {old:10.3f} {new:10.3f} {delta:+7.1f}%")
 
 
+def print_postmortems(telemetry_dir, full=False):
+    """Validate + summarize every ``postmortem-<pid>.json`` under the
+    directory (the wedge-watchdog / fatal-signal dumps,
+    ``telemetry/flight.py``). Returns the number of VALID dumps found."""
+    from multiverso_tpu.telemetry import validate_postmortem
+    paths = sorted(glob.glob(os.path.join(telemetry_dir,
+                                          "postmortem-*.json")))
+    if not paths:
+        print(f"no postmortem-*.json under {telemetry_dir}")
+        return 0
+    valid = 0
+    for path in paths:
+        print(f"== {path}")
+        try:
+            with open(path) as f:
+                pm = json.load(f)
+            validate_postmortem(pm)
+        except (OSError, ValueError) as e:
+            print(f"  INVALID: {e}", file=sys.stderr)
+            continue
+        valid += 1
+        reason = pm["reason"]
+        detail = " ".join(f"{k}={v}" for k, v in sorted(reason.items())
+                          if k != "kind")
+        print(f"  pid {pm['pid']} rank {pm['rank']}  "
+              f"reason: {reason['kind']} {detail}")
+        tripped = [n for n, w in sorted(pm["watchdogs"].items())
+                   if w.get("tripped")]
+        print(f"  threads: {len(pm['threads'])}  watchdogs: "
+              f"{len(pm['watchdogs'])} ({len(tripped)} tripped"
+              + (f": {', '.join(tripped)}" if tripped else "") + ")")
+        for alert in pm.get("alerts", []):
+            print(f"  alert firing: {alert.get('name')} "
+                  f"(value {alert.get('value')})")
+        logs = pm.get("flight", {}).get("logs", [])
+        for line in logs[-(len(logs) if full else 5):]:
+            print(f"  log| {line}")
+        if full:
+            for t in pm["threads"]:
+                print(f"  -- thread {t['name']} "
+                      f"(daemon={t.get('daemon')})")
+                for frame in t.get("stack", []):
+                    for ln in frame.splitlines():
+                        print(f"     {ln}")
+    return valid
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("telemetry_dir", help="run's -telemetry_dir")
@@ -173,7 +224,17 @@ def main():
                    "parent->child hop")
     p.add_argument("--trace-id", default="",
                    help="with --stitch: keep only this trace id (hex)")
+    p.add_argument("--postmortem", action="store_true",
+                   help="validate + summarize postmortem-*.json dumps "
+                   "(wedge watchdog / fatal signal artifacts) and exit")
+    p.add_argument("--full", action="store_true",
+                   help="with --postmortem: print every thread stack "
+                   "and the whole log tail")
     args = p.parse_args()
+
+    if args.postmortem:
+        return 0 if print_postmortems(args.telemetry_dir,
+                                      full=args.full) > 0 else 1
 
     if args.merge_trace:
         from multiverso_tpu.telemetry import merge_traces

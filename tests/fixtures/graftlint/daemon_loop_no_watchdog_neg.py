@@ -1,0 +1,64 @@
+"""Fixture: the idiomatic counterparts — every daemon loop runs under a
+watchdog scope and beats once per iteration (inline, or delegating to a
+runner helper after entering the scope — the shipped shapes)."""
+import threading
+
+from multiverso_tpu.telemetry import watchdog_register, watchdog_scope
+
+
+class Batcher:
+    """Scope-then-beat directly in the loop (canonical shape)."""
+
+    def start(self):
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def _loop(self):
+        with watchdog_scope("serve-batcher", timeout_s=60.0) as wd:
+            while self._running:
+                wd.beat()
+                batch = self._gather()
+                if batch:
+                    self._runner.run(batch)
+
+
+class Collector:
+    """Scope-then-delegate (the shipped shape for long loops): the
+    scope in the target is the evidence; the delegate carries the
+    beats, and the rule follows the delegation one level."""
+
+    def start(self):
+        threading.Thread(target=self._collect_loop, daemon=True).start()
+
+    def _collect_loop(self):
+        with watchdog_scope("serve-collector", timeout_s=60.0) as wd:
+            self._run_collect(wd)
+
+    def _run_collect(self, wd):
+        while True:
+            wd.beat()
+            item = self._fifo.popleft()
+            item.collect()
+
+
+def spawn_oneshot(work):
+    """A one-shot worker with no loop has nothing to wedge-watch."""
+    def run_once():
+        work()
+
+    t = threading.Thread(target=run_once, daemon=True)
+    t.start()
+    return t
+
+
+def spawn_heartbeat(beat_fn, stop):
+    def heartbeat_loop():
+        wd = watchdog_register("heartbeat", timeout_s=30.0)
+        while not stop.is_set():
+            wd.beat()
+            beat_fn()
+            stop.wait(0.1)
+
+    t = threading.Thread(target=heartbeat_loop, daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,58 @@
+"""Fixture: daemon service loops with no watchdog heartbeat in reach —
+a wedge in any of these stalls its plane with no trip, no postmortem."""
+import threading
+
+
+class Batcher:
+    """The batcher-worker idiom without a beat: the gather wait and the
+    runner call can both wedge, and nothing would ever notice."""
+
+    def start(self):
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def _loop(self):
+        while self._running:  # expect: daemon-loop-no-watchdog
+            batch = self._gather()
+            if batch:
+                self._runner.run(batch)
+
+
+class Collector:
+    """Pipeline-collector shape: the device sync inside collect() is the
+    canonical wedge, and this loop is exactly where it hides."""
+
+    def start(self):
+        threading.Thread(target=self._collect_loop, daemon=True).start()
+
+    def _collect_loop(self):
+        while True:  # expect: daemon-loop-no-watchdog
+            item = self._fifo.popleft()
+            item.collect()
+
+
+def spawn_heartbeat(beat_fn, stop):
+    def heartbeat_loop():
+        while not stop.is_set():  # expect: daemon-loop-no-watchdog
+            beat_fn()
+            stop.wait(0.1)
+
+    t = threading.Thread(target=heartbeat_loop, daemon=True)
+    t.start()
+    return t
+
+
+class DelegatingDispatcher:
+    """The loop hides ONE delegation hop down from the Thread target —
+    still no watchdog anywhere in reach, still invisible to postmortems
+    (the rule follows in-file delegates one level)."""
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        self._run()
+
+    def _run(self):
+        while self._running:  # expect: daemon-loop-no-watchdog
+            self._dispatch_one()

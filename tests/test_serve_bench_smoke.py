@@ -28,8 +28,9 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["benchmark"] == "serve_lookup"
     record = json.loads(out.read_text())
-    # v5: + decode_memory block (paged KV / prefix / kv-dtype witnesses)
-    assert record["schema"] == "multiverso_tpu.bench_serve/v5"
+    # v6: + observability block (alerts/watchdog A/B, SLO-breach
+    # witness, watchdog steady state)
+    assert record["schema"] == "multiverso_tpu.bench_serve/v6"
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
     assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
@@ -68,6 +69,22 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     # path, and peak pages resident must stay BELOW max-shape backing
     # for every slot (the decode memory hierarchy cannot silently
     # regress to preallocation).
+    # ISSUE-13 acceptance witnesses: the observability plane measured
+    # its own cost (A/B legs recorded — the number is box-noisy on 1
+    # core, so the smoke bounds it loosely; full runs gate at 1%), the
+    # synthetic SLO breach drove the shipped burn-rate state machine
+    # through quiet -> tolerated spike -> fired-within-fast-window ->
+    # resolved, and a stuck-free steady state tripped NO watchdog.
+    obs = record["observability"]
+    assert obs["ab"]["qps_plain"] > 0 and obs["ab"]["qps_observed"] > 0
+    assert obs["ab"]["overhead_pct"] < 15.0, obs["ab"]
+    slo = obs["slo_breach"]
+    assert slo["baseline_quiet"] is True
+    assert slo["spike_tolerated"] is True
+    assert slo["fired"] is True
+    assert slo["fired_within_fast_window"] is True, slo
+    assert slo["resolved"] is True
+    assert obs["watchdog"]["trips"] == 0, obs["watchdog"]
     dm = record["decode_memory"]
     wit = dm["witness"]
     assert wit["paged_f32_bitwise_vs_drain"] is True, dm
