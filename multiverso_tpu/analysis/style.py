@@ -5,12 +5,19 @@
 route output through ``utils/log.py`` or ``Dashboard.display(echo=True)``),
 now enforced through the shared engine so it gains suppressions, the
 baseline, and the JSON report for free.
+
+``unbounded-metric-name`` polices metric-name cardinality: the registry
+never drops entries, every metric becomes a timeseries ring, and every
+exported name lands in snapshots forever — a name formatted from an
+unbounded runtime value (request id, row key, msg id) is a slow-motion
+memory leak of the observability plane itself.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+import re
+from typing import Iterator, Optional
 
 from multiverso_tpu.analysis import astutil
 from multiverso_tpu.analysis.core import FileContext, Finding, Rule, register
@@ -44,3 +51,115 @@ class BarePrint(Rule):
                     ctx, node,
                     "bare print() in framework code — route through "
                     "utils/log.py or Dashboard.display(echo=True)")
+
+
+# Metric-name factories: module-level helpers AND registry methods
+# (reg.counter / get_registry().gauge / utils.dashboard.monitor).
+_METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram", "monitor"})
+
+# Deliberate bounded-index family shapes: a literal chunk ending in one
+# of these may interpolate a value (worker index, table id, batcher
+# slot) — the repo's documented convention for small fixed populations.
+_ALLOWED_FAMILIES = ("worker_", "table_", "batcher_", "member_",
+                     "shard_", "rank_", "replica_")
+
+_FORMAT_PLACEHOLDER = re.compile(r"\{[^{}]*\}")
+_PERCENT_PLACEHOLDER = re.compile(r"%[#0\- +]*[\d.*]*[sdifxXr]")
+
+
+def _family_ok(prefix: str) -> bool:
+    return prefix.endswith(_ALLOWED_FAMILIES)
+
+
+def _literal_violations(literal: str, placeholder_re) -> bool:
+    """True if the format literal interpolates anywhere NOT covered by a
+    bounded family prefix."""
+    pos = 0
+    for m in placeholder_re.finditer(literal):
+        if not _family_ok(literal[pos:m.start()]):
+            return True
+        pos = m.end()
+    return False
+
+
+@register
+class UnboundedMetricName(Rule):
+    id = "unbounded-metric-name"
+    severity = "error"
+    rationale = (
+        "A metric name formatted from an unbounded runtime value "
+        "(request id, row key, msg id) explodes registry AND timeseries "
+        "cardinality: the registry never drops entries, every name "
+        "becomes a ring-buffered series and a snapshot key forever. "
+        "Keep cardinality in span/trace ATTRIBUTES, or use a bounded "
+        "index family (worker_<w>, table_<t>, batcher_<i>, ...) whose "
+        "population is fixed by construction.")
+
+    def _formatted_unbounded(self, arg: ast.AST) -> Optional[str]:
+        """Why this name expression is a violation, or None."""
+        if isinstance(arg, ast.JoinedStr):
+            prefix = ""
+            for part in arg.values:
+                if isinstance(part, ast.Constant) and \
+                        isinstance(part.value, str):
+                    prefix += part.value
+                elif isinstance(part, ast.FormattedValue):
+                    if isinstance(part.value, ast.Constant):
+                        prefix += str(part.value.value)
+                        continue    # a literal interpolation is bounded
+                    if not _family_ok(prefix):
+                        return "f-string"
+                    prefix = ""
+            return None
+        if isinstance(arg, ast.Call) and \
+                isinstance(arg.func, ast.Attribute) and \
+                arg.func.attr == "format" and \
+                isinstance(arg.func.value, ast.Constant) and \
+                isinstance(arg.func.value.value, str):
+            if _literal_violations(arg.func.value.value,
+                                   _FORMAT_PLACEHOLDER):
+                return "str.format"
+            return None
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Mod) \
+                and isinstance(arg.left, ast.Constant) \
+                and isinstance(arg.left.value, str):
+            if _literal_violations(arg.left.value, _PERCENT_PLACEHOLDER):
+                return "percent-format"
+            return None
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+            # "prefix." + something_dynamic — treat like one trailing
+            # placeholder after the left literal.
+            if isinstance(arg.left, ast.Constant) \
+                    and isinstance(arg.left.value, str) \
+                    and not isinstance(arg.right, ast.Constant) \
+                    and not _family_ok(arg.left.value):
+                return "concatenation"
+            return None
+        return None
+
+    def _is_metric_call(self, ctx: FileContext, node: ast.Call) -> bool:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            name = ctx.aliases.get(fn.id, fn.id)
+            return name.rsplit(".", 1)[-1] in _METRIC_FACTORIES
+        if isinstance(fn, ast.Attribute):
+            # reg.counter(...) / get_registry().histogram(...); monitor
+            # excluded in attribute form — too generic a method name.
+            return fn.attr in (_METRIC_FACTORIES - {"monitor"})
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if not self._is_metric_call(ctx, node):
+                continue
+            why = self._formatted_unbounded(node.args[0])
+            if why:
+                yield self.finding(
+                    ctx, node,
+                    f"metric name built by {why} from a runtime value — "
+                    "unbounded names explode registry/timeseries "
+                    "cardinality; put the value in attributes or use a "
+                    "bounded family shape "
+                    f"({', '.join(_ALLOWED_FAMILIES)})")

@@ -59,16 +59,22 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
     stamp = time.strftime("%H:%M:%S",
                           time.localtime(stats.get("time_unix", 0)))
     router_alerts = stats.get("router_alerts") or []
+    # Fleet-wide data-plane load on the banner: total keys/sec, the
+    # p99-to-mean shard-load ratio (1.0 = balanced), and the single
+    # hottest key merged across replicas (traffic sketch).
+    hot = fleet.get("hot_keys") or []
+    hot_cell = f"  hot_key={hot[0][0]}" if hot else ""
     lines.append(f"fleet_top  v{stats.get('version', 0)}  {stamp}  "
                  f"replicas={fleet.get('replicas', 0)}  "
                  f"qps={fleet.get('qps', 0.0):.1f}  "
+                 f"keys/s={fleet.get('keys_rate', 0.0):.0f}  "
                  f"shed={100 * fleet.get('shed_rate', 0.0):.2f}%  "
                  f"slo_burn={fleet.get('slo_violations', 0)}  "
-                 f"alerts={fleet.get('alerts_active', 0)}")
+                 f"alerts={fleet.get('alerts_active', 0)}{hot_cell}")
     header = (f"{'MEMBER':24s} {'HEALTH':>7s} {'QPS':>8s} {'SHED%':>7s} "
               f"{'QUEUE':>6s} {'INFL':>5s} {'P50ms':>9s} {'P95ms':>9s} "
               f"{'P99ms':>9s} {'SLO':>6s} {'DRAINS':>6s} {'STATE':>8s} "
-              f"{'ALERTS':>15s}")
+              f"{'SKEW%':>6s} {'ALERTS':>15s}")
     lines.append(header)
     for mid in sorted(replicas):
         r = replicas[mid]
@@ -85,11 +91,14 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
             f"{_fmt_ms(total.get('p99', 0.0))} "
             f"{r.get('slo_violations', 0):6d} "
             f"{r.get('drains_completed', 0):6d} {state:>8s} "
+            f"{100 * r.get('skew', 0.0):6.1f} "
             f"{_fmt_alerts(r.get('alerts')):>15s}")
     ftotal = fleet.get("stages", {}).get("total", {})
     # The router's own alerts (heartbeat loss fires on the ROUTER — a
     # dead replica cannot report its own absence) render on the FLEET
-    # row: they are fleet-scoped, not any one member's.
+    # row: they are fleet-scoped, not any one member's. The FLEET SKEW%
+    # cell shows the shard-load ratio instead: xR.RR = the hottest
+    # shard serves R times the mean (the imbalance alert's input).
     lines.append(
         f"{'FLEET':24s} {'':7s} {fleet.get('qps', 0.0):8.1f} "
         f"{100 * fleet.get('shed_rate', 0.0):7.2f} "
@@ -100,6 +109,7 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
         f"{_fmt_ms(ftotal.get('p99', 0.0))} "
         f"{fleet.get('slo_violations', 0):6d} "
         f"{'':6s} {'n=%d' % fleet.get('replicas', 0):>8s} "
+        f"{'x%.2f' % fleet.get('shard_load_ratio', 1.0):>6s} "
         f"{_fmt_alerts(router_alerts):>15s}")
     return "\n".join(lines)
 

@@ -47,6 +47,7 @@ from multiverso_tpu.serving.client import (ReplicaUnavailableError,
                                            connect_with_backoff)
 from multiverso_tpu.telemetry import counter, emit_span, histogram
 from multiverso_tpu.telemetry import context as trace_context
+from multiverso_tpu.telemetry.sketch import record_keys
 from multiverso_tpu.telemetry.context import TraceContext
 from multiverso_tpu.utils.log import check, log
 
@@ -474,6 +475,10 @@ class FleetClient:
         rows = np.asarray(rows, dtype=np.int32).reshape(-1)
         table = self.routing()
         self._c_lookup.inc()
+        # Router-/client-side half of the traffic microscope: the key
+        # stream AS ROUTED (affinity + split fan-out), before any cache
+        # or shed — what key-affinity rebalancing would re-shard by.
+        record_keys("fleet.route", rows, rows.nbytes)
         if not split or rows.size == 0:
             self.request_async(rows, self._affinity_pref(rows, table),
                                on_done, deadline_ms, runner_id)

@@ -125,6 +125,8 @@ def metrics_payload() -> Dict:
         slo_ms = float(get_flag("serve_slo_ms"))
     except Exception:  # noqa: BLE001 - flags not parsed (bare library use)
         slo_ms = 50.0
+    # Literal three-member enum: bounded by construction.
+    # graftlint: disable=unbounded-metric-name
     shed = sum(reg.counter(f"serve.shed.{r}").value
                for r in ("queue_full", "deadline", "oversize"))
     stages: Dict[str, Dict] = {}
@@ -135,9 +137,22 @@ def metrics_payload() -> Dict:
                        "p95": round(snap["p95"], 4),
                        "p99": round(snap["p99"], 4)}
     from multiverso_tpu.telemetry import active_alert_summaries
+    from multiverso_tpu.telemetry.sketch import get_sketch_hub
+    # Data-plane load: this replica's served-key stream (traffic sketch,
+    # docs/OBSERVABILITY.md "Data-plane load"). flush() folds any
+    # pending per-thread buffers first, so the heartbeat ships numbers
+    # as fresh as the tick's; the router differentiates `keys` into a
+    # per-replica rate and derives the fleet's shard-imbalance ratio.
+    hub = get_sketch_hub()
+    hub.flush()
+    traffic = hub.summary("serve.lookup", topn=5)
     return {
         "requests": reg.counter("serve.requests").value,
         "replies": reg.counter("serve.replies").value,
+        "keys": int(traffic["keys"]),
+        "key_bytes": int(traffic["bytes"]),
+        "top1_share": float(traffic["top1_share"]),
+        "hot_keys": [[k, c] for k, c, _ in traffic["topk"]],
         # Firing alerts from this replica's in-process engine
         # (telemetry/alerts.py; [] when no engine runs): the rollup's
         # ALERTS column rides the heartbeat, no new wire messages.

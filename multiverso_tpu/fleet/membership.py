@@ -70,7 +70,8 @@ class MemberInfo:
         self.metrics = metrics
         self.history.append((now, float(metrics.get("requests", 0)),
                              float(metrics.get("replies", 0)),
-                             float(metrics.get("shed", 0))))
+                             float(metrics.get("shed", 0)),
+                             float(metrics.get("keys", 0))))
         # Keep at least TWO samples even when the heartbeat interval
         # exceeds the window — rates() needs a baseline, and a sparse
         # heartbeat must degrade to "rate over one beat", not to zeros.
@@ -79,18 +80,21 @@ class MemberInfo:
             self.history.popleft()
 
     def rates(self) -> Dict[str, float]:
-        """QPS / shed-rate over the retained window (zeros until two
-        samples exist — rates need a baseline, not a guess)."""
+        """QPS / shed-rate / served-keys rate over the retained window
+        (zeros until two samples exist — rates need a baseline, not a
+        guess)."""
         if len(self.history) < 2:
-            return {"qps": 0.0, "request_rate": 0.0, "shed_rate": 0.0}
-        t0, req0, rep0, shed0 = self.history[0]
-        t1, req1, rep1, shed1 = self.history[-1]
+            return {"qps": 0.0, "request_rate": 0.0, "shed_rate": 0.0,
+                    "keys_rate": 0.0}
+        t0, req0, rep0, shed0, keys0 = self.history[0]
+        t1, req1, rep1, shed1, keys1 = self.history[-1]
         dt = max(t1 - t0, 1e-6)
         d_req = max(req1 - req0, 0.0)
         d_shed = max(shed1 - shed0, 0.0)
         return {"qps": round(max(rep1 - rep0, 0.0) / dt, 3),
                 "request_rate": round(d_req / dt, 3),
-                "shed_rate": round(d_shed / max(d_req + d_shed, 1.0), 5)}
+                "shed_rate": round(d_shed / max(d_req + d_shed, 1.0), 5),
+                "keys_rate": round(max(keys1 - keys0, 0.0) / dt, 3)}
 
     @property
     def draining(self) -> bool:
@@ -194,6 +198,25 @@ class ReplicaGroup:
                         mid, self.liveness_misses)
         return dead
 
+    def publish_load_gauges(self) -> Dict[str, float]:
+        """Per-replica served-key rates -> the two registry gauges the
+        shard-imbalance alert rule reads (``fleet.shard_load_ratio`` /
+        ``fleet.shard_keys_rate``). Called from the router's sweep loop
+        so the ratio series advances whether or not anyone pulls
+        ``Fleet_Stats``; live (non-draining) members only — a draining
+        replica's fading rate is a planned event, not skew."""
+        from multiverso_tpu.telemetry.sketch import load_ratio
+        with self._lock:
+            members = [m for m in self._members.values()
+                       if not m.draining]
+        rates = {m.id: m.rates()["keys_rate"] for m in members}
+        total = sum(rates.values())
+        ratio = load_ratio(list(rates.values())) if len(rates) >= 2 \
+            else 1.0
+        gauge("fleet.shard_keys_rate").set(total)
+        gauge("fleet.shard_load_ratio").set(ratio)
+        return rates
+
     # -- control -------------------------------------------------------------
     def drain(self, member_id: str) -> None:
         """Queue a drain directive; delivered on the next heartbeat."""
@@ -284,6 +307,14 @@ class ReplicaGroup:
                 "qps": rates["qps"],
                 "request_rate": rates["request_rate"],
                 "shed_rate": rates["shed_rate"],
+                # Data-plane load (traffic sketch, shipped on the
+                # heartbeat): served-keys rate = this replica's shard
+                # load, skew = its top-1 key's traffic share, hot_keys
+                # = its heaviest hitters [[key, count], ...].
+                "keys_rate": rates["keys_rate"],
+                "keys": int(met.get("keys", 0)),
+                "skew": float(met.get("top1_share", 0.0)),
+                "hot_keys": list(met.get("hot_keys", [])),
                 "requests": int(met.get("requests", 0)),
                 "replies": int(met.get("replies", 0)),
                 "shed": int(met.get("shed", 0)),
@@ -324,6 +355,23 @@ class ReplicaGroup:
         total = fleet["requests"] + fleet["shed"]
         fleet["shed_rate"] = round(fleet["shed"] / total, 5) if total \
             else 0.0
+        # Fleet-wide data-plane load: total served-keys rate, the
+        # p99-to-mean shard-load ratio (1.0 = balanced; the imbalance
+        # alert's input), and the heaviest hitters merged across
+        # replicas (counts sum per key — SpaceSaving's merge rule).
+        from multiverso_tpu.telemetry.sketch import load_ratio
+        member_rates = [p["keys_rate"] for p in per.values()
+                        if not p["draining"]] or \
+            [p["keys_rate"] for p in per.values()]
+        fleet["keys_rate"] = round(sum(p["keys_rate"]
+                                       for p in per.values()), 3)
+        fleet["shard_load_ratio"] = round(load_ratio(member_rates), 4)
+        merged_hot: Dict[int, int] = {}
+        for p in per.values():
+            for key, count in p["hot_keys"]:
+                merged_hot[key] = merged_hot.get(key, 0) + int(count)
+        fleet["hot_keys"] = sorted(([k, c] for k, c in merged_hot.items()),
+                                   key=lambda kc: -kc[1])[:5]
         # The ROUTER's own alert engine (heartbeat-loss fires HERE — the
         # dead replica cannot report its own absence) plus the sum of
         # replica-reported firing alerts: fleet_top's ALERTS column.

@@ -274,6 +274,10 @@ class FleetRouter:
             while not self._sweep_stop.wait(interval):
                 wd.beat()
                 self.group.sweep()
+                # Shard-load gauges for the imbalance alert rule: the
+                # sweeper already runs at heartbeat cadence, so the
+                # ratio series is as fresh as liveness itself.
+                self.group.publish_load_gauges()
 
     def _reply_json(self, conn: socket.socket, msg: Message,
                     reply_type: int, payload: Dict) -> None:

@@ -158,6 +158,8 @@ def measured_policy_latency_ms(nbytes: int, mesh=None, world: int = 1,
 
 def _log_decision(table: str, policy: str, reason: str,
                   probe: Optional[Dict[str, float]] = None) -> None:
+    # `policy` is the three-member CommPolicy enum: bounded.
+    # graftlint: disable=unbounded-metric-name
     counter(f"comm.policy.resolve.{policy}").inc()
     entry = {"table": table, "policy": policy, "reason": reason}
     if probe is not None:
@@ -235,9 +237,13 @@ def record(plane: str, nbytes: int, ms: Optional[float] = None) -> None:
     """Count one communication op on ``plane`` (bytes moved + optional
     latency). Factories are looked up per call so telemetry resets between
     tests never detach the counters."""
+    # `plane` is the three-member policy enum: bounded.
+    # graftlint: disable=unbounded-metric-name
     counter(f"comm.{plane}.bytes").inc(int(nbytes))
+    # graftlint: disable=unbounded-metric-name
     counter(f"comm.{plane}.ops").inc()
     if ms is not None:
+        # graftlint: disable=unbounded-metric-name
         histogram(f"comm.{plane}.latency_ms").observe(float(ms))
 
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import functools
 import os
 import queue as _queue_mod
 import selectors
@@ -45,6 +46,7 @@ from multiverso_tpu.parallel.mesh import reference_server_offsets
 from multiverso_tpu.parallel.net import recv_message, send_message
 from multiverso_tpu.runtime.ffi import DeltaBuffer
 from multiverso_tpu.telemetry import gauge
+from multiverso_tpu.telemetry.sketch import record_keys
 from multiverso_tpu.utils.configure import get_flag
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check, log
@@ -136,6 +138,14 @@ STALE_GET_KEY = -3
 # UpdateGetState branch, :244-253). Reply carries the served rows' GLOBAL
 # ids so the client knows which of its cached rows were refreshed.
 STALE_ROWS_GET_KEY = -4
+
+
+@functools.lru_cache(maxsize=256)
+def _sketch_surface(table_id: int, kind: str) -> str:
+    """Cached traffic-sketch surface name for one table shard's op
+    stream (no per-request f-string on the dispatch path; surface
+    cardinality = 2 x registered tables, hub-bounded)."""
+    return f"ps.table_{table_id}.{kind}"
 
 
 class _SparseShardState:
@@ -751,13 +761,21 @@ class PSService:
                 opt = self._maybe_stamp_staleness(store, opt)
                 if raw_wire:
                     store.apply_rows(keys, msg.data[2], opt)
+                    record_keys(_sketch_surface(msg.table_id, "add"),
+                                keys, msg.data[2].nbytes)
                 elif keys.size == 0:
                     delta = unpack_payload(msg.data[2:])  # FilterOut analog
                     store.apply_dense(delta, opt)
+                    record_keys(_sketch_surface(msg.table_id, "add"),
+                                keys, delta.nbytes)
                 else:
                     local = keys.astype(np.int64) - row_offset
                     delta = unpack_payload(msg.data[2:])
                     store.apply_rows(local.astype(np.int32), delta, opt)
+                    # GLOBAL row ids into the traffic sketch: hot keys
+                    # surface in the id space operators route/shard by.
+                    record_keys(_sketch_surface(msg.table_id, "add"),
+                                keys, delta.nbytes)
                     st = self._sparse.get(msg.table_id)
                     if st is not None:
                         st.on_add(local, opt.worker_id)
@@ -785,6 +803,8 @@ class PSService:
                 with monitor("PS_SERVICE_GET"):
                     rows = st.take_stale_among(wid, req)
                     values = np.asarray(store.read_rows(rows))
+                record_keys(_sketch_surface(msg.table_id, "get"),
+                            rows + np.int64(row_offset), values.nbytes)
                 reply = msg.create_reply()
                 reply.data = [rows + np.int32(row_offset),
                               *pack_payload(values, _reply_mode(mode),
@@ -802,6 +822,8 @@ class PSService:
                 with monitor("PS_SERVICE_GET"):
                     rows = st.take_stale(wid)
                     values = np.asarray(store.read_rows(rows))
+                record_keys(_sketch_surface(msg.table_id, "get"),
+                            rows + np.int64(row_offset), values.nbytes)
                 reply = msg.create_reply()
                 reply.data = [rows + np.int32(row_offset),
                               *pack_payload(values, _reply_mode(mode),
@@ -815,6 +837,8 @@ class PSService:
                 else:
                     values = np.asarray(store.read_rows(
                         keys.astype(np.int32) - row_offset))
+            record_keys(_sketch_surface(msg.table_id, "get"), keys,
+                        values.nbytes)
             reply = msg.create_reply()
             if raw_wire:
                 reply.data = [np.ascontiguousarray(values)]

@@ -84,6 +84,54 @@ class BucketLadder:
         return self.buckets[-1]
 
 
+# ---------------------------------------------------------------------------
+# Per-batcher queue gauges. `serve.queue_depth`/`serve.queue_bound` used
+# to be single last-writer-wins gauges: with several batchers in one
+# process (multi-runner services, in-process tests) the LAST-constructed
+# bound clobbered the rest, so the queue-saturation alert could compare
+# one batcher's depth against another's bound. Each live batcher now owns
+# a slot-indexed gauge pair (`serve.queue_{depth,bound}.batcher_<i>` —
+# the bounded `_<i>` family shape; slots are REUSED on close, so gauge
+# cardinality is bounded by peak concurrent batchers, not by churn) and
+# the unlabeled process-wide gauges are SUMS across live batchers — the
+# coherent aggregate the SaturationRule reads.
+# ---------------------------------------------------------------------------
+_slots_lock = threading.Lock()
+_slots: dict = {}
+_totals = {"depth": 0, "bound": 0}   # running sums over live batchers
+
+
+def _acquire_batcher_slot(batcher) -> int:
+    with _slots_lock:
+        idx = 0
+        while idx in _slots:
+            idx += 1
+        _slots[idx] = batcher
+        return idx
+
+
+def _release_batcher_slot(idx: int) -> None:
+    with _slots_lock:
+        _slots.pop(idx, None)
+
+
+def _adjust_queue_totals(d_depth: int, d_bound: int = 0) -> None:
+    """O(1) delta maintenance of the process-wide sums — the per-request
+    path must not re-sum every live batcher under a global lock. Each
+    batcher's own delta is exact (computed under its cv), so the running
+    totals stay exact; clamped at 0 as a belt against a torn shutdown.
+    Gauge factories are looked up per call so telemetry resets between
+    tests never detach the published values."""
+    with _slots_lock:
+        _totals["depth"] = max(0, _totals["depth"] + int(d_depth))
+        _totals["bound"] = max(0, _totals["bound"] + int(d_bound))
+        # Publish INSIDE the lock: compute-then-publish outside lets two
+        # concurrent adjustments land out of order and leave the summed
+        # gauges stale at the older value until the next adjustment.
+        gauge("serve.queue_depth").set(_totals["depth"])
+        gauge("serve.queue_bound").set(_totals["bound"])
+
+
 @dataclasses.dataclass
 class ServeRequest:
     """One queued request. ``on_done`` receives either the result row
@@ -149,14 +197,17 @@ class DynamicBatcher:
         # the serialized path (runner lacks dispatch/collect, or depth<2).
         self._pipeline = make_pipeline(runner, pipeline_depth)
         # Telemetry (docs/OBSERVABILITY.md catalog, serve.* family).
-        self._g_depth = gauge("serve.queue_depth")
-        # The admission bound as a gauge: the saturation alert rule
-        # (telemetry/alerts.py) compares queue_depth against it. Like
-        # serve.queue_depth itself this is process-global — with several
-        # batchers in one process (in-process tests) the last-
-        # constructed bound wins and the alert is best-effort; the
-        # deployed shape is one serving service per process.
-        gauge("serve.queue_bound").set(self.max_queue)
+        # Each batcher owns a slot-labeled depth/bound gauge pair; the
+        # unlabeled serve.queue_depth/serve.queue_bound the saturation
+        # alert reads are the SUMS across live batchers (see the module
+        # comment — the old single gauges were last-writer-wins).
+        self._depth = 0
+        self._slot = _acquire_batcher_slot(self)
+        self._g_depth = gauge(f"serve.queue_depth.batcher_{self._slot}")
+        self._g_depth.set(0)
+        self._g_bound = gauge(f"serve.queue_bound.batcher_{self._slot}")
+        self._g_bound.set(self.max_queue)
+        _adjust_queue_totals(0, self.max_queue)
         self._g_inflight = gauge("serve.inflight")
         self._c_requests = counter("serve.requests")
         self._c_batches = counter("serve.batches")
@@ -176,6 +227,17 @@ class DynamicBatcher:
         """Resolved dispatch-window depth (0 = serialized path) — what
         the fleet heartbeat reports next to the occupancy gauge."""
         return self._pipeline.depth if self._pipeline is not None else 0
+
+    def _set_depth(self, depth: int) -> None:
+        """This batcher's labeled depth gauge + an exact delta into the
+        process-wide sum (callers hold this batcher's cv, so the delta
+        against the previous value cannot race itself)."""
+        depth = int(depth)
+        delta = depth - self._depth
+        self._depth = depth
+        self._g_depth.set(depth)
+        if delta:
+            _adjust_queue_totals(delta)
 
     # -- submission ---------------------------------------------------------
     def submit(self, payload: np.ndarray,
@@ -233,7 +295,7 @@ class DynamicBatcher:
                 shed.append((req, ShedError("closed", "batcher is closed")))
             else:
                 self._admit_locked(req, now, shed)
-                self._g_depth.set(len(self._queue))
+                self._set_depth(len(self._queue))
                 self._cv.notify()
         for victim, err in shed:
             victim.on_done(err)
@@ -261,7 +323,7 @@ class DynamicBatcher:
             try:
                 self._queue.remove(req)
                 removed = True
-                self._g_depth.set(len(self._queue))
+                self._set_depth(len(self._queue))
             except ValueError:
                 removed = False
         if removed:
@@ -375,7 +437,7 @@ class DynamicBatcher:
                 # batch is on its way to dispatch — that window is exactly
                 # the straddling batch the drain barrier exists to stop.
                 self._busy = True
-            self._g_depth.set(len(self._queue))
+            self._set_depth(len(self._queue))
         now = time.monotonic()
         live: List[ServeRequest] = []
         for r in batch:
@@ -547,6 +609,13 @@ class DynamicBatcher:
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:
         with self._cv:
+            # Idempotent: a second close (explicit close + service
+            # close is a normal shutdown sequence) must not subtract
+            # this batcher's bound from the shared totals again, nor
+            # re-free a slot a newer batcher may have since reused.
+            if getattr(self, "_closed", False):
+                return
+            self._closed = True
             self._running = False
             pending = list(self._queue)
             self._queue.clear()
@@ -554,3 +623,13 @@ class DynamicBatcher:
         for r in pending:
             self._safe_done(r, ShedError("closed", "batcher is closed"))
         self._worker.join(timeout=10)
+        # Leave the aggregate gauges coherent: subtract this batcher
+        # from the sums and zero its labeled gauges BEFORE freeing the
+        # slot — release-first would let a concurrent construction
+        # reuse the index and have its freshly-set bound clobbered to 0.
+        residual = self._depth
+        self._depth = 0
+        self._g_depth.set(0)
+        self._g_bound.set(0)
+        _adjust_queue_totals(-residual, -self.max_queue)
+        _release_batcher_slot(self._slot)

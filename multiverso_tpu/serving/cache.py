@@ -21,6 +21,12 @@ trainer: the clock IS the invalidation broadcast. Checkpoint replicas
 
 Telemetry: ``serve.cache.hit`` / ``serve.cache.miss`` / ``serve.cache.stale``
 counters + ``serve.cache.rows`` gauge (docs/OBSERVABILITY.md catalog).
+Hit-path keys feed the ``serve.lookup`` traffic sketch (misses feed it at
+runner dispatch), so the hot-key view covers the FULL key stream; the
+cache also registers the sketch hub's **headroom advisor** — each
+telemetry tick publishes the hit rate the stream's frequency CDF says
+this capacity could achieve next to the measured one
+(``serve.cache.advisor.*`` gauges).
 """
 
 from __future__ import annotations
@@ -32,6 +38,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from multiverso_tpu.telemetry import counter, gauge
+from multiverso_tpu.telemetry.sketch import get_sketch_hub, record_keys
 
 
 class StampedRows(np.ndarray):
@@ -69,6 +76,16 @@ class HotRowCache:
         self._c_miss = counter("serve.cache.miss")
         self._c_stale = counter("serve.cache.stale")
         self._g_rows = gauge("serve.cache.rows")
+        # Headroom advisor feed (telemetry/sketch.py): each flush reads
+        # this cache's counters + capacity and publishes predicted-vs-
+        # measured hit rates. Last-registered cache wins the surface —
+        # the deployed shape is one lookup cache per process.
+        get_sketch_hub().register_advisor(
+            "serve.lookup",
+            lambda: {"capacity": self.capacity,
+                     "hits": self._c_hit.value,
+                     "misses": self._c_miss.value,
+                     "stale": self._c_stale.value})
 
     def _fresh(self, stamp: float, now_clock: float) -> bool:
         # No clock (static table / frozen replica): entries live until
@@ -102,7 +119,12 @@ class HotRowCache:
         self._c_hit.inc()
         if not out:
             return None                       # empty request: device path
-        return StampedRows.wrap(np.stack(out), stamp)
+        rows = np.stack(out)
+        # Hit-path half of the key stream (the miss path records at
+        # runner dispatch — together the sketch sees EVERY served key).
+        record_keys("serve.lookup", np.asarray(keys).reshape(-1).copy(),
+                    rows.nbytes)
+        return StampedRows.wrap(rows, stamp)
 
     def put_rows(self, keys: np.ndarray, rows: np.ndarray,
                  clock: float) -> None:

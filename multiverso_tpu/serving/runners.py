@@ -39,6 +39,7 @@ from multiverso_tpu.serving.cache import HotRowCache
 from multiverso_tpu.serving.paged import PagePool, page_plan, pages_of
 from multiverso_tpu.serving.quant import (decode_rows, encode_rows,
                                           storage_dtype)
+from multiverso_tpu.telemetry.sketch import record_keys
 from multiverso_tpu.utils.log import check
 
 try:                     # 3.8+ typing.Protocol
@@ -77,6 +78,16 @@ class ServingRunner(Protocol):
     # serialized fallback. ``try_cached(payload)`` (optional) may answer
     # a request host-side at admission (hot-row cache) — None means
     # "take the device path".
+
+
+def _batch_keys(batch: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """The REAL keys of a padded batch (pad rows/columns excluded) — what
+    the traffic sketch must see: pad id 0 is a legitimate row id, so the
+    stream is cut by lengths, never by value."""
+    parts = [batch[i, :int(n)] for i, n in enumerate(lengths) if n]
+    if not parts:
+        return np.empty(0, dtype=batch.dtype)
+    return np.concatenate(parts) if len(parts) > 1 else parts[0]
 
 
 def _make_gather():
@@ -158,6 +169,11 @@ class SparseLookupRunner:
         # staleness-0 hit would then serve stale bytes as fresh). The
         # conservative stamp only costs an early refetch.
         clock = self.current_clock()
+        # Device-path half of the key stream (cache hits record at the
+        # cache): hot-key sketch, docs/OBSERVABILITY.md "Data-plane load".
+        keys = _batch_keys(batch, lengths)
+        record_keys("serve.lookup", keys,
+                    keys.size * int(self.store.padded_shape[1]) * 4)
         flat = (batch.astype(np.int64) - self.row_offset).reshape(-1)
         # Negative ids (pad rows under a nonzero offset) clip to row 0.
         flat = np.maximum(flat, 0).astype(np.int32)
@@ -227,6 +243,9 @@ class ReplicaLookupRunner:
     def dispatch(self, batch: np.ndarray, lengths: np.ndarray):
         snap = self.replica.snapshot()
         data, scale = snap.storage(self.table)
+        keys = _batch_keys(batch, lengths)
+        record_keys("serve.lookup", keys,
+                    keys.size * int(data.shape[1]) * 4)
         flat = np.clip(batch.reshape(-1), 0, data.shape[0] - 1)
         if scale is None and data.dtype == jnp.float32:
             # f32 storage: EXACTLY the pre-quantization gather (the

@@ -17,6 +17,7 @@ See docs/OBSERVABILITY.md for the metric catalog and schemas.
 
 from multiverso_tpu.telemetry.alerts import (AlertEngine, AlertManager,
                                              AlertRule, BurnRateRule,
+                                             ImbalanceRule,
                                              SaturationRule, StragglerRule,
                                              ThresholdRule,
                                              active_alert_summaries,
@@ -39,6 +40,11 @@ from multiverso_tpu.telemetry.flight import (POSTMORTEM_SCHEMA,
                                              watchdog_handles,
                                              watchdog_register,
                                              watchdog_scope)
+from multiverso_tpu.telemetry.sketch import (CountMinSketch, SketchHub,
+                                             SpaceSaving, TrafficSketch,
+                                             coverage_at, get_sketch_hub,
+                                             load_ratio, record_keys,
+                                             set_sketch_enabled)
 from multiverso_tpu.telemetry.timeseries import TimeseriesStore
 from multiverso_tpu.telemetry.export import (SNAPSHOT_SCHEMA,
                                              TelemetryExporter,
@@ -71,7 +77,10 @@ __all__ = [
     "TraceContext", "activate", "child_of", "current_context",
     "maybe_new_root", "new_root",
     "AlertEngine", "AlertManager", "AlertRule", "BurnRateRule",
-    "SaturationRule", "StragglerRule", "ThresholdRule",
+    "ImbalanceRule", "SaturationRule", "StragglerRule", "ThresholdRule",
+    "CountMinSketch", "SketchHub", "SpaceSaving", "TrafficSketch",
+    "coverage_at", "get_sketch_hub", "load_ratio", "record_keys",
+    "set_sketch_enabled",
     "active_alert_summaries", "default_serving_rules",
     "maybe_start_observability_from_flags", "start_alert_engine",
     "stop_alert_engine",

@@ -47,7 +47,7 @@ from multiverso_tpu.telemetry.timeseries import TimeseriesStore
 from multiverso_tpu.utils.log import log
 
 __all__ = ["AlertRule", "BurnRateRule", "SaturationRule", "ThresholdRule",
-           "StragglerRule", "AlertManager", "AlertEngine",
+           "StragglerRule", "ImbalanceRule", "AlertManager", "AlertEngine",
            "start_alert_engine", "stop_alert_engine", "engine",
            "active_alert_summaries", "default_serving_rules",
            "maybe_start_observability_from_flags"]
@@ -183,6 +183,37 @@ class StragglerRule(AlertRule):
             yield (f"{self.name}.{suffix}", value > self.above,
                    round(value, 3),
                    f"{series}={value:.2f} > {self.above}")
+
+
+class ImbalanceRule(AlertRule):
+    """Shard-load imbalance: a load-ratio series (p99-to-mean across
+    shards, ``sketch.load_ratio`` — the router publishes it from the
+    per-replica key rates its heartbeats already carry) sustained at/over
+    ``ratio``, gated by a volume series so an idle fleet's noise never
+    pages. The base state machine supplies the fire/resolve hysteresis:
+    one skewed window is a routing blip, N consecutive ones are a hot
+    shard worth rebalancing."""
+
+    def __init__(self, name: str, ratio_series: str, volume_series: str,
+                 ratio: float = 1.7, min_volume: float = 100.0, **kw):
+        kw.setdefault("for_windows", 3)
+        super().__init__(name, **kw)
+        self.ratio_series = str(ratio_series)
+        self.volume_series = str(volume_series)
+        self.ratio = float(ratio)
+        self.min_volume = float(min_volume)
+
+    def evaluate(self, store):
+        ratio = store.latest(self.ratio_series)
+        if ratio is None:
+            return      # no shard-load feed in this process: dormant
+        volume = store.latest(self.volume_series) or 0.0
+        # The volume guard gates only the FIRING direction: a skew that
+        # persists into a traffic trough still resolves.
+        bad = ratio >= self.ratio and volume >= self.min_volume
+        yield (self.name, bad, round(ratio, 3),
+               f"{self.ratio_series}={ratio:.2f} >= {self.ratio} "
+               f"at {volume:.0f} keys/s (floor {self.min_volume:.0f})")
 
 
 # ---------------------------------------------------------------------------
@@ -450,6 +481,13 @@ def default_serving_rules(interval_s: Optional[float] = None
             "ps.straggler", "gauge.ps_service.staleness.worker_",
             above=32.0, severity="warn",
             for_windows=windows(3.0), clear_windows=windows(3.0)),
+        ImbalanceRule(
+            "fleet.shard_imbalance", "gauge.fleet.shard_load_ratio",
+            "gauge.fleet.shard_keys_rate",
+            ratio=float(_flag_or("fleet_imbalance_ratio", 1.7)),
+            min_volume=float(_flag_or("fleet_imbalance_min_keys", 100.0)),
+            severity="warn",
+            for_windows=windows(2.0), clear_windows=windows(3.0)),
     ]
 
 

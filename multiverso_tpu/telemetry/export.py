@@ -87,6 +87,14 @@ def metrics_snapshot(buckets: bool = True, seq: int = 0) -> Dict:
             snap["timeseries"] = eng.store.snapshot(last_n=30)
     except Exception:  # noqa: BLE001 - the alert embed is attribution;
         pass           # a broken engine must not cost the base snapshot
+    try:
+        from multiverso_tpu.telemetry.sketch import get_sketch_hub
+        hub = get_sketch_hub()
+        hub.flush()     # unticked processes still export fresh sketches
+        if hub.surfaces():
+            snap["sketches"] = hub.snapshot()
+    except Exception:  # noqa: BLE001 - additive section, same contract
+        pass
     return snap
 
 
@@ -422,9 +430,11 @@ def reset_telemetry() -> None:
     drop every metric, span, and flight event."""
     from multiverso_tpu.telemetry.alerts import stop_alert_engine
     from multiverso_tpu.telemetry.flight import reset_flight
+    from multiverso_tpu.telemetry.sketch import reset_sketches
     stop_alert_engine()
     reset_flight()
     stop_exporter()
+    reset_sketches()
     get_registry().reset()
     buf = get_trace_buffer()
     buf.clear()

@@ -105,6 +105,12 @@ class TimeseriesStore:
         from multiverso_tpu.telemetry.spans import get_trace_buffer
         reg.gauge("telemetry.spans.dropped").set(
             get_trace_buffer().dropped)
+        # Fold the per-thread hot-key buffers into the traffic sketches
+        # and publish their derived load metrics (sketch.<surface>.*)
+        # BEFORE the registry read below, so rows/sec and skew series
+        # advance on the same tick cadence as everything else.
+        from multiverso_tpu.telemetry.sketch import get_sketch_hub
+        get_sketch_hub().flush()
         hists, counters, gauges = reg.metrics()
         # Snapshot the raw material first (per-metric locks), then fold
         # into the rings under this store's lock.

@@ -375,3 +375,27 @@ define_bool("telemetry_flight", True, "arm the flight recorder's wedge "
 define_double("telemetry_ts_interval", 1.0, "seconds between timeseries "
               "ticks / alert rule evaluations (the downsampled window "
               "width burn rates are computed over)")
+# Data-plane traffic sketches (telemetry/sketch.py; docs/OBSERVABILITY.md
+# "Data-plane load").
+define_bool("telemetry_sketch", True, "record streaming hot-key sketches "
+            "(Count-Min + Space-Saving) on every data-plane key surface: "
+            "ps_service row ops, serving lookups incl. cache hits, fleet "
+            "key-affinity routing — the hot path is one list-append, "
+            "folded in on the telemetry tick")
+define_int("telemetry_sketch_width", 1024, "Count-Min counters per hash "
+           "row: frequency over-estimate bounded by 2*stream/width per "
+           "row (8 KiB of int64 per row at the default)")
+define_int("telemetry_sketch_depth", 4, "Count-Min hash rows: the "
+           "over-estimate bound holds with probability 1 - 2^-depth")
+define_int("telemetry_sketch_topk", 128, "Space-Saving heavy-hitter "
+           "capacity per surface: every key above stream/topk frequency "
+           "is guaranteed tracked (fleet_top hot-keys + the cache "
+           "advisor's CDF read from these)")
+# Shard-imbalance alerting (fed by the router's per-replica key rates).
+define_double("fleet_imbalance_ratio", 1.7, "p99-to-mean per-replica "
+              "key-rate ratio at/over which the router's "
+              "fleet.shard_imbalance alert turns bad (1.0 = perfectly "
+              "balanced)")
+define_double("fleet_imbalance_min_keys", 100.0, "minimum fleet-wide "
+              "keys/sec before the shard-imbalance rule may fire (an "
+              "idle fleet's noise must not page)")

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Perf-regression gate over the serving trend file.
+
+Every ``serve_bench`` run appends its record to BENCH_SERVE_HISTORY.jsonl;
+nothing ever *read* the trend. This gate compares the NEWEST record's
+``achieved_qps`` against the trailing median of comparable history (same
+benchmark, replica count, dry-run flag, table size) with a noise
+tolerance band — a silent 20% serving regression now fails a command
+instead of waiting for a human to eyeball the JSONL.
+
+Box honesty: committed records span machines (the many-core record box
+vs the 1-core CI box), and QPS across boxes is not a regression signal.
+Each v7+ record carries a ``box`` fingerprint (cores/machine/python);
+the gate compares strictly ONLY against history from the same box and
+degrades to **warn, never fail** when the newest record's box differs
+from its history (or predates the fingerprint).
+
+Exit codes: 0 = ok / warned / insufficient history, 1 = regression
+against same-box history, 2 = usage or unreadable history.
+
+    python scripts/bench_guard.py                      # repo history
+    python scripts/bench_guard.py --history PATH --tolerance 0.2
+    python scripts/bench_guard.py --dry-run            # self-test (CI)
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_HISTORY = os.path.join(_REPO, "BENCH_SERVE_HISTORY.jsonl")
+
+
+def load_history(path):
+    """Records in file order; unparseable lines are warned about and
+    skipped (a truncated last line from a killed bench must not wedge
+    the gate)."""
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                print(f"warning: {path}:{i}: unparseable record skipped",
+                      file=sys.stderr)
+    return records
+
+
+def comparable_key(record):
+    """What must match for two records' QPS to be comparable at all:
+    benchmark leg, replica count, dry-run flag, table size, AND the
+    load shape. Offered QPS and the client/workload knobs ARE part of
+    the key — a run offered half the load achieves roughly half the
+    QPS for workload reasons, and gating it against full-load history
+    would exit 1 "regression" with no code change at all."""
+    cfg = record.get("config", {})
+    return (record.get("benchmark"),
+            int(cfg.get("replicas", 0) or 0),
+            bool(cfg.get("dry_run", False)),
+            int(cfg.get("rows", 0) or 0),
+            float(cfg.get("qps", 0) or 0),
+            int(cfg.get("threads", 0) or 0),
+            int(cfg.get("keys_per_req", 0) or 0),
+            int(cfg.get("max_batch", 0) or 0),
+            int(cfg.get("cache_rows", 0) or 0),
+            float(cfg.get("hot_frac", 0) or 0),
+            float(cfg.get("zipf", 0) or 0))
+
+
+def box_fingerprint(record):
+    """(cores, machine) or None for pre-v7 records without one."""
+    box = record.get("box")
+    if not isinstance(box, dict):
+        return None
+    return (box.get("cores"), box.get("machine"))
+
+
+def evaluate(records, tolerance=0.15, window=8, min_history=3):
+    """The gate decision for the NEWEST record against its trailing
+    history. Returns a dict with ``status`` in
+    {"ok", "regression", "warn_box_mismatch", "insufficient_history",
+    "empty"} plus the numbers behind it — pure function, unit-testable,
+    shared by the CLI and its --dry-run self-test."""
+    if not records:
+        return {"status": "empty"}
+    newest = records[-1]
+    key = comparable_key(newest)
+    box = box_fingerprint(newest)
+    prior = [r for r in records[:-1] if comparable_key(r) == key]
+    same_box = [r for r in prior if box_fingerprint(r) == box
+                and box is not None]
+    strict = len(same_box) >= min_history
+    basis = same_box if strict else prior
+    basis = basis[-window:]
+    out = {
+        "benchmark": newest.get("benchmark"),
+        "achieved_qps": round(float(newest.get("achieved_qps", 0.0)), 1),
+        "n_history": len(prior),
+        "n_same_box": len(same_box),
+        "window": len(basis),
+        "tolerance": tolerance,
+    }
+    if len(basis) < min_history:
+        out["status"] = "insufficient_history"
+        return out
+    med = statistics.median(float(r.get("achieved_qps", 0.0))
+                            for r in basis)
+    floor = med * (1.0 - tolerance)
+    out["trailing_median_qps"] = round(med, 1)
+    out["floor_qps"] = round(floor, 1)
+    regressed = out["achieved_qps"] < floor
+    if not regressed:
+        out["status"] = "ok"
+    elif strict:
+        out["status"] = "regression"
+    else:
+        # Cross-box (or fingerprint-less) comparison: the 1-core CI box
+        # against committed many-core records measures the BOX, not the
+        # code — say so loudly, fail nothing.
+        out["status"] = "warn_box_mismatch"
+    return out
+
+
+def _fake(qps, benchmark="serve_lookup", cores=4, rows=1000):
+    return {"benchmark": benchmark, "achieved_qps": qps,
+            "box": {"cores": cores, "machine": "x86_64"},
+            "config": {"replicas": 0, "dry_run": False, "rows": rows}}
+
+
+def self_test():
+    """--dry-run: exercise the three gate outcomes on synthetic history
+    written through the real file path (the tier-1 smoke drives this)."""
+    steady = [_fake(q) for q in (500.0, 510.0, 495.0, 505.0, 500.0)]
+    cases = [
+        ("steady history passes",
+         steady + [_fake(502.0)], "ok"),
+        ("20% drop on the same box fails",
+         steady + [_fake(400.0)], "regression"),
+        ("same drop on a DIFFERENT box only warns",
+         steady + [_fake(400.0, cores=1)], "warn_box_mismatch"),
+        ("fingerprint-less history only warns",
+         [dict(_fake(q), box=None) for q in (500.0, 510.0, 495.0)]
+         + [_fake(400.0)], "warn_box_mismatch"),
+        ("too little history abstains",
+         steady[:2] + [_fake(400.0)], "insufficient_history"),
+    ]
+    failures = 0
+    for name, records, want in cases:
+        with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                         delete=False) as f:
+            path = f.name
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        try:
+            got = evaluate(load_history(path))["status"]
+        finally:
+            os.unlink(path)
+        ok = got == want
+        failures += 0 if ok else 1
+        print(f"{'PASS' if ok else 'FAIL'}: {name} "
+              f"(want {want}, got {got})")
+    print(json.dumps({"self_test": "bench_guard",
+                      "cases": len(cases), "failures": failures}))
+    return 0 if failures == 0 else 1
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--history", default=_HISTORY,
+                   help="BENCH_SERVE_HISTORY.jsonl to gate on")
+    p.add_argument("--tolerance", type=float, default=0.15,
+                   help="allowed fractional drop below the trailing "
+                   "median before the gate fails (noise band)")
+    p.add_argument("--window", type=int, default=8,
+                   help="trailing comparable records the median spans")
+    p.add_argument("--min-history", type=int, default=3,
+                   help="comparable records required before gating at "
+                   "all (fewer = abstain with exit 0)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="self-test the gate logic on synthetic history "
+                   "and exit (the tier-1 smoke)")
+    args = p.parse_args()
+
+    if args.dry_run:
+        return self_test()
+    if not os.path.exists(args.history):
+        print(f"error: no history file at {args.history}",
+              file=sys.stderr)
+        return 2
+    result = evaluate(load_history(args.history),
+                      tolerance=args.tolerance, window=args.window,
+                      min_history=args.min_history)
+    print(json.dumps(result, indent=1))
+    status = result["status"]
+    if status == "regression":
+        print(f"FAIL: achieved_qps {result['achieved_qps']} fell below "
+              f"{result['floor_qps']} (trailing median "
+              f"{result['trailing_median_qps']} - "
+              f"{100 * result['tolerance']:.0f}%) on the same box",
+              file=sys.stderr)
+        return 1
+    if status == "warn_box_mismatch":
+        print("warning: newest record regressed vs history from a "
+              "DIFFERENT box fingerprint — cross-box QPS measures the "
+              "box, not the code; not failing", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

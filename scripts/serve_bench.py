@@ -54,6 +54,7 @@ import argparse
 import glob
 import json
 import os
+import platform
 import signal
 import subprocess
 import sys
@@ -144,13 +145,32 @@ class _LoadStats:
 
 
 def _key_sampler(rows: int, keys_per_req: int, hot_frac: float,
-                 hot_keys: int):
+                 hot_keys: int, zipf_alpha: float = 0.0):
     """Per-request key draw: uniform over the table, except a
     ``hot_frac`` fraction of requests draws all its keys from a fixed
     ``hot_keys``-row hot set (the workload skew a hot-row cache exists
     for; 0.0 = the original uniform workload, bitwise-comparable with
-    older records)."""
+    older records).
+
+    ``zipf_alpha > 1`` switches to a Zipf(alpha) key stream over the
+    whole table — the power-law shape real user/item traffic follows —
+    with frequency ranks mapped through a FIXED permutation so the
+    planted hot keys are specific, known row ids scattered across the
+    table (``sample.hot_ids``: the true hottest ids, rank order). The
+    hot-key sketch recovery witness asserts against these."""
     hot = min(max(int(hot_keys), 1), rows)
+
+    if zipf_alpha > 0.0:
+        if zipf_alpha <= 1.0:
+            raise SystemExit("--zipf ALPHA must be > 1 (Zipf exponent)")
+        perm = np.random.default_rng(0xC0FFEE).permutation(rows) \
+            .astype(np.int32)
+
+        def sample(r: np.random.Generator) -> np.ndarray:
+            ranks = (r.zipf(zipf_alpha, keys_per_req) - 1) % rows
+            return perm[ranks]
+        sample.hot_ids = perm[:16].tolist()
+        return sample
 
     def sample(r: np.random.Generator) -> np.ndarray:
         if hot_frac > 0.0 and r.random() < hot_frac:
@@ -358,20 +378,31 @@ def _observability_ab(args, run_window) -> dict:
     BEATS run in both legs (they are unconditional attribute stores in
     the daemon loops); the A/B isolates the ticker + monitor threads —
     the part ``-telemetry_alerts``/``-telemetry_flight`` can turn off."""
-    from multiverso_tpu.telemetry import (start_alert_engine,
+    from multiverso_tpu.telemetry import (set_sketch_enabled,
+                                          start_alert_engine,
                                           start_watchdog,
                                           stop_alert_engine,
                                           stop_watchdog)
+    from multiverso_tpu.telemetry.sketch import get_sketch_hub
     dur = max(args.duration / 2, 1.0)
     n = {"plain": 0, "observed": 0}
     elapsed = {"plain": 0.0, "observed": 0.0}
+    # Restore the operator's flag choice after each leg, not a
+    # hardcoded True — `-telemetry_sketch=false` must survive the A/B.
+    sketch_was_enabled = get_sketch_hub().enabled
     for _round in range(2):
         for mode in ("plain", "observed"):
             if mode == "observed":
                 start_alert_engine(interval_s=0.25)
                 start_watchdog()
+            # The traffic sketch records in-line on the serving hot
+            # paths (one list-append per batch/hit); the plain leg turns
+            # THAT off too, so the A/B bounds the whole ISSUE-14 plane —
+            # appends AND tick-time folding — not just the ticker.
+            set_sketch_enabled(mode == "observed" and sketch_was_enabled)
             stats = _LoadStats()
             el = run_window(stats, dur)
+            set_sketch_enabled(sketch_was_enabled)
             if mode == "observed":
                 stop_alert_engine()
                 stop_watchdog()
@@ -444,6 +475,69 @@ def _slo_breach_probe(args) -> dict:
             "fired_within_fast_window": fired
             and windows_to_fire + 1 <= fast,
             "resolved": not mgr.active()}
+
+
+def _hotkey_probe(args, do_request) -> dict:
+    """Traffic-microscope recovery witness (ISSUE 14): drive a Zipf key
+    stream with KNOWN planted hot keys through the LIVE serving path
+    (admission -> cache -> device), then ask the sketch hub which keys
+    were hot. The record asserts >= 9 of the 10 planted hottest ids were
+    recovered and sketch memory stayed under its configured bound —
+    through the full pipeline, cache hits included, not a unit harness."""
+    from multiverso_tpu.serving import ShedError
+    from multiverso_tpu.telemetry import get_sketch_hub
+
+    alpha = args.zipf if args.zipf > 1.0 else 1.5
+    sampler = _key_sampler(args.rows, args.keys_per_req, 0.0, 1,
+                           zipf_alpha=alpha)
+    planted = [int(k) for k in sampler.hot_ids[:10]]
+    hub = get_sketch_hub()
+    base = hub.summary("serve.lookup")["keys"]
+    r = np.random.default_rng(7)
+    n_req = 1500
+    deadline = time.monotonic() + 30.0
+    sent = 0
+    # Unpaced closed loop: the probe wants key VOLUME, not a QPS number.
+    while sent < n_req and time.monotonic() < deadline:
+        try:
+            do_request(sampler(r))
+        except ShedError:
+            pass        # shed keys still went through admission; fine
+        sent += 1
+    hub.flush()
+    traffic = hub.summary("serve.lookup", topn=max(
+        32, 2 * len(planted)))
+    recovered = [k for k, _, _ in
+                 (tuple(row) for row in traffic["topk"])
+                 if k in set(planted)]
+    advisor = hub.advise("serve.lookup", max(args.cache_rows, 1))
+    from multiverso_tpu.telemetry import get_registry
+    reg = get_registry()
+    hits = reg.counter("serve.cache.hit").value
+    lookups = hits + reg.counter("serve.cache.miss").value \
+        + reg.counter("serve.cache.stale").value
+    return {
+        "alpha": alpha,
+        "n_requests": sent,
+        "keys_observed": traffic["keys"] - base,
+        "planted": planted,
+        "recovered": sorted(recovered),
+        "recovered_count": len(recovered),
+        "top1_share": traffic["top1_share"],
+        "memory_bytes": hub.memory_bytes(),
+        "memory_bound": hub.memory_bound(),
+        "memory_ok": hub.memory_bytes() <= hub.memory_bound(),
+        # Cache-headroom advisor next to the measured rate: the CDF-
+        # predicted hit rate of the CURRENT -serve_cache_rows capacity.
+        "advisor": {
+            "cache_rows": args.cache_rows,
+            "predicted_hit_rate": advisor.get("predicted_hit_rate", 0.0),
+            "predicted_hit_rate_2x": advisor.get(
+                "predicted_hit_rate_2x", 0.0),
+            "measured_hit_rate": round(hits / lookups, 4)
+            if lookups else 0.0,
+        },
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -703,7 +797,7 @@ def run_single(args) -> dict:
     from multiverso_tpu.telemetry import TraceBuffer, get_trace_buffer
     get_trace_buffer().set_capacity(TraceBuffer.EXPORT_CAPACITY)
     sampler = _key_sampler(args.rows, args.keys_per_req, args.hot_frac,
-                           args.hot_keys)
+                           args.hot_keys, zipf_alpha=args.zipf)
     stats_un, stats = _LoadStats(), _LoadStats()
     elapsed_un = elapsed = 0.0
     cpu0 = _proc_cpu_s(os.getpid())
@@ -760,6 +854,12 @@ def run_single(args) -> dict:
             },
         }
 
+    # Hot-key sketch recovery + cache-headroom advisor witness
+    # (ISSUE 14): planted-Zipf stream through the live serving path.
+    hotkeys = None
+    if args.dry_run or args.zipf > 0.0:
+        hotkeys = _hotkey_probe(args, do_request)
+
     for cli in clients:
         cli.close()
     service.close()
@@ -778,6 +878,8 @@ def run_single(args) -> dict:
     record["pipeline"] = probe
     if observability is not None:
         record["observability"] = observability
+    if hotkeys is not None:
+        record["hotkeys"] = hotkeys
     if sweep is not None:
         record["qps_sweep"] = sweep
     if decode_block is not None:
@@ -1170,6 +1272,52 @@ def _await_heartbeat_loss(router_addr, timeout_s: float = 15.0) -> dict:
             "router_alerts": (st or {}).get("router_alerts", [])}
 
 
+def _skew_drill(args, fleet, router_addr) -> dict:
+    """Shard-imbalance detection witness (ISSUE 14): drive a window
+    where EVERY request carries the same key set, so ring affinity
+    routes the whole stream to one owner replica. The replicas'
+    heartbeat-shipped key rates diverge, the router's sweep publishes a
+    p99-to-mean shard-load ratio near the replica count, and its
+    ``fleet.shard_imbalance`` rule must FIRE and ship into
+    ``Fleet_Stats`` (``router_alerts``) while the skew lasts. The alert
+    poll runs concurrently with the load — the alert is transient, it
+    resolves once the skew stops."""
+    from multiverso_tpu.serving import ShedError
+
+    hot = np.arange(min(args.keys_per_req, 8), dtype=np.int32)
+    result: dict = {}
+
+    def poll():
+        fired, st = _await_fleet_alert(
+            router_addr,
+            lambda st: any(a.get("name") == "fleet.shard_imbalance"
+                           for a in st.get("router_alerts", [])),
+            timeout_s=25.0)
+        result["fired"] = fired
+        if st is not None:
+            result["router_alerts"] = st.get("router_alerts", [])
+            result["shard_load_ratio"] = st.get("fleet", {}).get(
+                "shard_load_ratio", 0.0)
+            result["per_replica_keys_rate"] = {
+                rid: row.get("keys_rate", 0.0)
+                for rid, row in st.get("replicas", {}).items()}
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    deadline = time.monotonic() + 25.0
+    n = 0
+    while time.monotonic() < deadline and poller.is_alive():
+        try:
+            fleet.lookup(hot, deadline_ms=args.deadline_ms, timeout=30)
+        except Exception:  # noqa: BLE001 - sheds/timeouts don't matter:
+            pass           # the drill needs key VOLUME, not clean QPS
+        n += 1
+    poller.join(timeout=30)
+    result.setdefault("fired", False)
+    result["skewed_requests"] = n
+    return result
+
+
 def _await_postmortem(tdir: str, victim_pid: int,
                       timeout_s: float = 20.0) -> dict:
     """Wait for the victim's postmortem dump and schema-validate it —
@@ -1240,7 +1388,8 @@ def run_fleet(args) -> dict:
         parity_ok = _parity_check(fleet, table, args.rows,
                                   args.keys_per_req)
         sampler = _key_sampler(args.rows, args.keys_per_req,
-                               args.hot_frac, args.hot_keys)
+                               args.hot_frac, args.hot_keys,
+                               zipf_alpha=args.zipf)
 
         # Interleaved untraced/traced load windows (A,B,A,B), all
         # DRILL-FREE: traced-vs-untraced QPS measures sampling overhead
@@ -1337,6 +1486,15 @@ def run_fleet(args) -> dict:
             else:
                 slo_breach = {"fired": False, "replica": "replica-0",
                               "alerts": []}
+
+        # Shard-imbalance drill (ISSUE 14): skew the whole key stream
+        # onto one ring owner; the router's imbalance alert must fire
+        # and ship into Fleet_Stats. BEFORE the fault drill — the skew
+        # needs every replica alive to have a balanced baseline to
+        # diverge from.
+        skew = None
+        if args.skew_drill:
+            skew = _skew_drill(args, fleet, router_addr)
 
         # Phase C — drill window: fresh load with the drain/fault drills
         # running against it (drained + killed replicas also land in the
@@ -1477,6 +1635,7 @@ def run_fleet(args) -> dict:
                                     int(row.get("watchdog_trips", 0)))
         record["observability"] = {
             "slo_breach": slo_breach,
+            "skew": skew,
             "watchdog": {
                 "fleet_trips": sum(trips_by.values()),
                 "router_trips": max(
@@ -1546,9 +1705,18 @@ def _make_record(benchmark: str, args, stats: _LoadStats,
         # state), fleet drill.fault gains heartbeat_loss_alert +
         # postmortem (SIGABRT fault drill), fleet_stats rows carry
         # per-replica alerts + router_alerts.
-        "schema": "multiverso_tpu.bench_serve/v6",
+        # v7: + hotkeys block (planted-Zipf sketch recovery +
+        # cache-headroom advisor), observability.skew (shard-imbalance
+        # detect-and-ship drill), fleet_stats rows carry keys_rate/
+        # skew/hot_keys + fleet shard_load_ratio, and a `box`
+        # fingerprint (scripts/bench_guard.py warns instead of failing
+        # when the box changed under a record).
+        "schema": "multiverso_tpu.bench_serve/v7",
         "benchmark": benchmark,
         "time_unix": time.time(),
+        "box": {"cores": os.cpu_count(),
+                "machine": platform.machine(),
+                "python": platform.python_version()},
         "config": {k: (v if not isinstance(v, tuple) else list(v))
                    for k, v in vars(args).items()},
         "offered_qps": args.qps,
@@ -1597,6 +1765,15 @@ def main() -> int:
                    "uniform workload for record comparability)")
     p.add_argument("--hot-keys", type=int, default=64,
                    help="size of the hot key set --hot-frac draws from")
+    p.add_argument("--zipf", type=float, default=0.0,
+                   help="ALPHA > 1: draw keys Zipf(ALPHA) over the whole "
+                   "table through a fixed rank permutation — the "
+                   "power-law stream real traffic follows; also arms "
+                   "the hot-key sketch recovery witness (0 = off)")
+    p.add_argument("--skew-drill", action="store_true",
+                   help="fleet mode: route a whole window to ONE ring "
+                   "owner and assert the router's fleet.shard_imbalance "
+                   "alert fires and ships into Fleet_Stats")
     p.add_argument("--prefix-frac", type=float, default=0.0,
                    help="decode-memory leg: fraction of decode requests "
                    "repeating one shared prompt (0 = leg default 0.5)")
@@ -1672,6 +1849,10 @@ def main() -> int:
             args.slo_drill = True
             if args.replicas >= 2:
                 args.fault_drill = True
+                # ...and the traffic microscope (ISSUE 14): the
+                # shard-imbalance detect-and-ship witness needs >= 2
+                # replicas for a ratio to exist.
+                args.skew_drill = True
 
     record = run_fleet(args) if args.replicas >= 1 else run_single(args)
     _emit(record, args.out)

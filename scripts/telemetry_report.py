@@ -28,6 +28,11 @@ Usage:
     # stacks, watchdog ages, active alerts, log tail
     python scripts/telemetry_report.py /tmp/t --postmortem
 
+    # data-plane hot keys: per-surface traffic-sketch tables (keys,
+    # bytes, top-1/top-K share, the heavy hitters with error bounds)
+    # merged across the run's processes
+    python scripts/telemetry_report.py /tmp/t --hotkeys
+
 No jax import: usable on any host, including ones without the TPU tunnel.
 """
 
@@ -210,6 +215,46 @@ def print_postmortems(telemetry_dir, full=False):
     return valid
 
 
+def print_hotkeys(telemetry_dir, snapshots, topn=10):
+    """Per-surface hot-key tables from the snapshots' ``sketches``
+    sections (telemetry/sketch.py), merged across processes: counts of
+    the same key SUM (each process saw a disjoint slice of the stream —
+    the Space-Saving merge rule), totals sum, shares re-derive from the
+    merged numbers. Returns the number of surfaces printed."""
+    surfaces = {}
+    for snap in snapshots:
+        for name, s in snap.get("sketches", {}).get("surfaces",
+                                                    {}).items():
+            agg = surfaces.setdefault(name, {"keys": 0, "bytes": 0,
+                                             "topk": {}})
+            agg["keys"] += int(s.get("keys", 0))
+            agg["bytes"] += int(s.get("bytes", 0))
+            for key, count, err in s.get("topk", []):
+                cur = agg["topk"].get(int(key), (0, 0))
+                agg["topk"][int(key)] = (cur[0] + int(count),
+                                         cur[1] + int(err))
+    if not surfaces:
+        print(f"no sketches section in any snapshot under "
+              f"{telemetry_dir} (was -telemetry_sketch off, or no "
+              f"data-plane traffic?)")
+        return 0
+    for name in sorted(surfaces):
+        agg = surfaces[name]
+        total = max(agg["keys"], 1)
+        top = sorted(agg["topk"].items(), key=lambda kv: -kv[1][0])[:topn]
+        top1 = top[0][1][0] if top else 0
+        topk_sum = sum(c for _, (c, _) in top)
+        print(f"== {name}: {agg['keys']} keys, {agg['bytes']} bytes, "
+              f"top1 {100 * top1 / total:.1f}%, "
+              f"top{len(top)} {100 * topk_sum / total:.1f}%")
+        print(f"   {'key':>12s} {'count':>10s} {'max_err':>8s} "
+              f"{'share%':>7s}")
+        for key, (count, err) in top:
+            print(f"   {key:12d} {count:10d} {err:8d} "
+                  f"{100 * count / total:7.2f}")
+    return len(surfaces)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("telemetry_dir", help="run's -telemetry_dir")
@@ -227,6 +272,10 @@ def main():
     p.add_argument("--postmortem", action="store_true",
                    help="validate + summarize postmortem-*.json dumps "
                    "(wedge watchdog / fatal signal artifacts) and exit")
+    p.add_argument("--hotkeys", action="store_true",
+                   help="print per-surface data-plane hot-key tables "
+                   "from the snapshots' traffic-sketch sections "
+                   "(merged across processes) and exit")
     p.add_argument("--full", action="store_true",
                    help="with --postmortem: print every thread stack "
                    "and the whole log tail")
@@ -235,6 +284,15 @@ def main():
     if args.postmortem:
         return 0 if print_postmortems(args.telemetry_dir,
                                       full=args.full) > 0 else 1
+
+    if args.hotkeys:
+        snapshots = latest_snapshots(args.telemetry_dir)
+        if not snapshots:
+            print(f"no metrics-*.json under {args.telemetry_dir}",
+                  file=sys.stderr)
+            return 1
+        return 0 if print_hotkeys(args.telemetry_dir, snapshots) > 0 \
+            else 1
 
     if args.merge_trace:
         from multiverso_tpu.telemetry import merge_traces

@@ -1,0 +1,29 @@
+"""Fixture: idiomatic counterparts — plain literal names, the bounded
+index family shapes (worker_<w>, table_<t>, batcher_<i>: populations
+fixed at init), and formatted strings that are not metric names."""
+from multiverso_tpu.telemetry import counter, gauge, histogram
+from multiverso_tpu.utils.dashboard import monitor
+from multiverso_tpu.utils.log import log
+
+
+def literal_names():
+    counter("serve.requests").inc()
+    gauge("serve.queue_depth").set(3)
+    histogram("serve.latency.total").observe(1.0)
+    monitor("PS_SERVICE_ADD")
+
+
+def bounded_families(w, table_id, slot):
+    # The deliberate bounded `<family>_<i>` shapes: worker/table/batcher
+    # indices are fixed small populations, the documented convention.
+    gauge(f"ps_service.staleness.worker_{w}").set(0.0)
+    gauge(f"async_engine.queue_depth.table_{table_id}").set(1)
+    gauge(f"serve.queue_bound.batcher_{slot}").set(64)
+    counter(f"fleet.shard_keys.member_{slot}").inc()
+
+
+def formatted_but_not_a_metric(request_id):
+    # f-strings with runtime values are fine anywhere EXCEPT a metric
+    # name — logs and exceptions are per-event, not per-name state.
+    log.info(f"serving request {request_id}")
+    raise ValueError("bad request %d" % request_id)

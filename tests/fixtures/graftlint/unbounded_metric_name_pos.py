@@ -1,0 +1,21 @@
+"""Fixture: metric names formatted from unbounded runtime values —
+every one of these leaks a registry entry + a timeseries ring per
+distinct value (request ids, row keys), forever."""
+from multiverso_tpu.telemetry import counter, gauge, histogram
+from multiverso_tpu.telemetry.metrics import get_registry
+from multiverso_tpu.utils.dashboard import monitor
+
+
+def per_request(request_id, key, msg_id, reg):
+    counter(f"serve.request.{request_id}").inc()  # expect: unbounded-metric-name
+    gauge("row.load.{}".format(key)).set(1.0)  # expect: unbounded-metric-name
+    histogram("reply.%d.latency" % msg_id).observe(1.0)  # expect: unbounded-metric-name
+    reg.counter(f"cancel.{msg_id}").inc()  # expect: unbounded-metric-name
+    get_registry().gauge("conn." + str(msg_id)).set(0)  # expect: unbounded-metric-name
+    monitor(f"REQUEST_{request_id}")  # expect: unbounded-metric-name
+
+
+def family_prefix_not_at_the_hole(worker, key):
+    # A family word somewhere in the name does NOT bless a different,
+    # unbounded interpolation elsewhere in it.
+    counter(f"ps.worker_{worker}.key.{key}").inc()  # expect: unbounded-metric-name

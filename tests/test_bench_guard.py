@@ -1,0 +1,85 @@
+"""Tier-1 smoke + unit tests for the serving perf-regression gate
+(``scripts/bench_guard.py``): the gate function's decisions on synthetic
+history, and the CLI's --dry-run self-test end to end."""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_GUARD = os.path.join(_REPO, "scripts", "bench_guard.py")
+
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+import bench_guard  # noqa: E402
+
+
+def _rec(qps, cores=4, benchmark="serve_lookup", replicas=0, rows=1000):
+    return {"benchmark": benchmark, "achieved_qps": qps,
+            "box": {"cores": cores, "machine": "x86_64"},
+            "config": {"replicas": replicas, "dry_run": False,
+                       "rows": rows}}
+
+
+def test_gate_passes_within_tolerance():
+    records = [_rec(q) for q in (500, 505, 495, 490, 510)] + [_rec(470)]
+    out = bench_guard.evaluate(records, tolerance=0.15)
+    assert out["status"] == "ok"
+    assert out["trailing_median_qps"] == 500.0
+
+
+def test_gate_fails_same_box_regression():
+    records = [_rec(q) for q in (500, 505, 495, 490, 510)] + [_rec(350)]
+    out = bench_guard.evaluate(records, tolerance=0.15)
+    assert out["status"] == "regression"
+    assert out["floor_qps"] == 425.0
+
+
+def test_gate_warns_not_fails_on_box_mismatch():
+    """The 1-core CI box against committed many-core records measures
+    the box, not the code — warn-don't-fail (satellite requirement)."""
+    records = [_rec(q, cores=16) for q in (500, 505, 495, 490)] \
+        + [_rec(350, cores=1)]
+    out = bench_guard.evaluate(records, tolerance=0.15)
+    assert out["status"] == "warn_box_mismatch"
+    # Pre-v7 records without a fingerprint degrade the same way.
+    legacy = [dict(_rec(q), box=None) for q in (500, 505, 495)] \
+        + [_rec(350)]
+    assert bench_guard.evaluate(legacy)["status"] == "warn_box_mismatch"
+
+
+def test_gate_only_compares_comparable_records():
+    """Fleet records never gate a single-process record and vice versa."""
+    records = [_rec(1000, replicas=2, benchmark="serve_fleet_lookup")
+               for _ in range(5)] + [_rec(300)]
+    out = bench_guard.evaluate(records)
+    assert out["status"] == "insufficient_history"
+    assert out["n_history"] == 0
+
+
+def test_gate_abstains_below_min_history():
+    records = [_rec(500), _rec(505)] + [_rec(10)]
+    assert bench_guard.evaluate(records)["status"] == \
+        "insufficient_history"
+
+
+def test_cli_dry_run_self_test():
+    proc = subprocess.run([sys.executable, _GUARD, "--dry-run"],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["self_test"] == "bench_guard"
+    assert line["failures"] == 0
+
+
+def test_cli_against_repo_history():
+    """The gate must RUN against the real trend file (ok or warn — the
+    CI box legitimately differs from committed record boxes; exit 1
+    would mean a same-box regression, which tier-1 should surface)."""
+    history = os.path.join(_REPO, "BENCH_SERVE_HISTORY.jsonl")
+    if not os.path.exists(history):
+        return
+    proc = subprocess.run([sys.executable, _GUARD,
+                           f"--history={history}"],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -43,7 +43,7 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert line["replicas"] == 2
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v6"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v7"
     assert record["replicas"] == 2
 
     # Routed lookups bitwise-equal to the direct table gather.
@@ -84,6 +84,20 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert wd["monitored_replicas"] == 2, wd
     assert wd["fleet_trips"] == 0, wd
     assert wd["router_trips"] == 0, wd
+
+    # -- ISSUE-14 shard-imbalance drill: a window where every request
+    # routes to ONE ring owner must drive the replicas' heartbeat-
+    # shipped key rates apart, fire the ROUTER's fleet.shard_imbalance
+    # rule, and ship it into Fleet_Stats (router_alerts) while the skew
+    # lasts.
+    skew = record["observability"]["skew"]
+    assert skew["fired"] is True, skew
+    assert any(a["name"] == "fleet.shard_imbalance"
+               for a in skew["router_alerts"]), skew
+    rates = skew["per_replica_keys_rate"]
+    assert len(rates) == 2
+    assert max(rates.values()) > 2 * max(min(rates.values()), 1.0), \
+        f"drill did not actually skew the shard load: {rates}"
 
     # The load window itself served cleanly.
     assert record["n_error"] == 0
@@ -150,6 +164,14 @@ def test_serve_bench_fleet_dry_run(tmp_path):
         assert "alerts" in r
     assert fleet["alerts_active"] >= 1
     assert "router_alerts" in stats
+    # ...and the data-plane load columns (ISSUE 14): per-replica key
+    # rates + skew + hot keys ride the heartbeat; the fleet block
+    # carries the merged hot keys and the shard-load ratio fleet_top's
+    # SKEW column renders.
+    for r in per.values():
+        assert "keys_rate" in r and "skew" in r and "hot_keys" in r
+    assert "shard_load_ratio" in fleet and "hot_keys" in fleet
+    assert fleet["keys_rate"] >= 0.0
 
     # -- PR-9 serving optimizations engaged across the fleet --------------
     # Replica heartbeats carry dispatch-window occupancy; the dry run's

@@ -28,7 +28,8 @@ RULES = ("implicit-host-sync", "block-until-ready-in-loop",
          "retrace-hazard", "missing-donation", "host-jnp-in-loop",
          "lock-order-cycle", "unlocked-registry-mutation",
          "bare-thread-no-join", "bare-print", "unbounded-queue-append",
-         "span-in-traced-fn", "daemon-loop-no-watchdog")
+         "span-in-traced-fn", "daemon-loop-no-watchdog",
+         "unbounded-metric-name")
 
 
 def _expected_lines(path, rule):

@@ -28,9 +28,10 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["benchmark"] == "serve_lookup"
     record = json.loads(out.read_text())
-    # v6: + observability block (alerts/watchdog A/B, SLO-breach
-    # witness, watchdog steady state)
-    assert record["schema"] == "multiverso_tpu.bench_serve/v6"
+    # v7: + hotkeys block (planted-Zipf sketch recovery + cache-headroom
+    # advisor), box fingerprint (bench_guard's warn-don't-fail key)
+    assert record["schema"] == "multiverso_tpu.bench_serve/v7"
+    assert record["box"]["cores"] >= 1
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
     assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
@@ -85,6 +86,22 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     assert slo["fired_within_fast_window"] is True, slo
     assert slo["resolved"] is True
     assert obs["watchdog"]["trips"] == 0, obs["watchdog"]
+    # ISSUE-14 acceptance witnesses: the hot-key sketch recovered the
+    # planted Zipf hot keys through the LIVE serving path (admission ->
+    # cache -> device), its memory stayed under the configured bound,
+    # and the cache-headroom advisor reported predicted-vs-measured hit
+    # rates. The A/B above now also brackets the sketch's record()
+    # appends (the plain leg disables them), so the <=1% full-run
+    # acceptance covers this plane too.
+    hk = record["hotkeys"]
+    assert hk["recovered_count"] >= 9, hk
+    assert hk["memory_ok"] is True, hk
+    assert hk["memory_bytes"] <= hk["memory_bound"]
+    assert hk["keys_observed"] > 0
+    adv = hk["advisor"]
+    assert 0.0 < adv["predicted_hit_rate"] <= 1.0, adv
+    assert adv["predicted_hit_rate_2x"] >= adv["predicted_hit_rate"]
+    assert "measured_hit_rate" in adv
     dm = record["decode_memory"]
     wit = dm["witness"]
     assert wit["paged_f32_bitwise_vs_drain"] is True, dm

@@ -228,3 +228,68 @@ def test_close_releases_queued_requests():
     b.close()
     with pytest.raises(ShedError):
         tail.wait(10)
+
+
+def test_queue_bound_sums_across_batchers_and_shrinks_on_close():
+    """ISSUE-14 satellite: `serve.queue_bound`/`serve.queue_depth` used
+    to be last-writer-wins — with two batchers the saturation alert
+    compared one batcher's depth against the OTHER's bound. The
+    unlabeled gauges are now sums over live batchers (each also exports
+    a slot-labeled `.batcher_<i>` pair), and a closed batcher leaves
+    the aggregate coherent."""
+    from multiverso_tpu.telemetry import get_registry
+    reg = get_registry()
+    a = DynamicBatcher(RecordingRunner(), buckets=(4,), max_queue=64)
+    b = DynamicBatcher(RecordingRunner(), buckets=(4,), max_queue=16)
+    try:
+        assert reg.gauge("serve.queue_bound").last == 64 + 16
+        labels = {a._slot, b._slot}
+        assert len(labels) == 2, "each batcher owns a distinct slot"
+        assert reg.gauge(
+            f"serve.queue_bound.batcher_{a._slot}").last == 64
+        assert reg.gauge(
+            f"serve.queue_bound.batcher_{b._slot}").last == 16
+    finally:
+        b.close()
+    assert reg.gauge("serve.queue_bound").last == 64, \
+        "closing a batcher must shrink the summed bound"
+    slot_b = [s for s in labels if s != a._slot][0]
+    assert reg.gauge(f"serve.queue_bound.batcher_{slot_b}").last == 0
+    # The freed slot is REUSED: labeled-gauge cardinality is bounded by
+    # peak concurrency, not by batcher churn.
+    c = DynamicBatcher(RecordingRunner(), buckets=(4,), max_queue=8)
+    try:
+        assert c._slot == slot_b
+        assert reg.gauge("serve.queue_bound").last == 64 + 8
+    finally:
+        c.close()
+        a.close()
+    assert reg.gauge("serve.queue_bound").last == 0
+
+
+def test_double_close_keeps_queue_totals_and_slots_coherent():
+    """close() is idempotent: an explicit close followed by a service
+    close (a normal shutdown sequence) must not subtract the batcher's
+    bound from the shared totals twice, nor re-free a slot a NEWER
+    batcher has since reused."""
+    from multiverso_tpu.telemetry import get_registry
+    reg = get_registry()
+    a = DynamicBatcher(RecordingRunner(), buckets=(4,), max_queue=64)
+    b = DynamicBatcher(RecordingRunner(), buckets=(4,), max_queue=16)
+    a.close()
+    c = DynamicBatcher(RecordingRunner(), buckets=(4,), max_queue=8)
+    assert c._slot == a._slot, "c reuses a's freed slot"
+    a.close()   # second close: must be a no-op
+    try:
+        assert reg.gauge("serve.queue_bound").last == 16 + 8, \
+            "double close must not subtract a's bound twice"
+        # a second acquisition must NOT be handed c's still-live slot
+        d = DynamicBatcher(RecordingRunner(), buckets=(4,), max_queue=4)
+        try:
+            assert d._slot != c._slot
+        finally:
+            d.close()
+    finally:
+        b.close()
+        c.close()
+    assert reg.gauge("serve.queue_bound").last == 0

@@ -1,0 +1,341 @@
+"""Unit tests for the data-plane traffic microscope (ISSUE 14):
+Count-Min / Space-Saving sketch math, cross-thread/cross-process merge,
+the hub's bounded memory, the cache-headroom advisor's CDF, and the
+shard-imbalance rule's fire/resolve hysteresis — all deterministic
+(seeded streams, manual ticks), no sleeping against live engines."""
+
+import collections
+import json
+import threading
+
+import numpy as np
+
+from multiverso_tpu.telemetry import get_registry
+from multiverso_tpu.telemetry.alerts import AlertManager, ImbalanceRule
+from multiverso_tpu.telemetry.sketch import (CountMinSketch, SketchHub,
+                                             SpaceSaving, TrafficSketch,
+                                             coverage_at, load_ratio)
+from multiverso_tpu.telemetry.timeseries import TimeseriesStore
+
+
+def _zipf_stream(n, rows=100_000, alpha=1.3, seed=0):
+    r = np.random.default_rng(seed)
+    return ((r.zipf(alpha, n) - 1) % rows).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Count-Min
+# ---------------------------------------------------------------------------
+def test_cms_error_bound_on_zipf_stream(mv_env):
+    """Estimates never under-count, and the over-count respects the
+    Count-Min guarantee (<= 2N/width per key, modulo the 2^-depth
+    failure probability — asserted with headroom on a fixed seed)."""
+    n, width = 200_000, 2048
+    keys = _zipf_stream(n)
+    cms = CountMinSketch(width=width, depth=4)
+    cms.update(keys)
+    assert cms.total == n
+    true = collections.Counter(keys.tolist())
+    probe = np.asarray(sorted(true, key=true.get, reverse=True)[:200]
+                       + list(true)[:200], dtype=np.int64)
+    est = cms.estimate(probe)
+    truth = np.asarray([true[int(k)] for k in probe])
+    assert (est >= truth).all(), "Count-Min must never under-count"
+    assert (est - truth).max() <= 2 * n / width, \
+        f"over-count {int((est - truth).max())} beyond the CMS bound"
+
+
+def test_cms_update_with_explicit_counts(mv_env):
+    cms = CountMinSketch(width=64, depth=3)
+    cms.update(np.asarray([5, 9]), np.asarray([10, 3]))
+    est = cms.estimate(np.asarray([5, 9, 7]))
+    assert est[0] >= 10 and est[1] >= 3
+    assert cms.total == 13
+
+
+# ---------------------------------------------------------------------------
+# Space-Saving
+# ---------------------------------------------------------------------------
+def test_spacesaving_topk_recovery_and_error_bounds(mv_env):
+    """Every true top-10 key of a Zipf stream is recovered by a 128-slot
+    sketch, and each tracked count brackets the truth:
+    count - error <= true <= count."""
+    keys = _zipf_stream(100_000, alpha=1.5, seed=1)
+    ss = SpaceSaving(128)
+    ss.update(keys)
+    assert len(ss) <= 128
+    true = collections.Counter(keys.tolist())
+    true_top10 = {k for k, _ in true.most_common(10)}
+    sketched = {k for k, _, _ in ss.topk(20)}
+    assert true_top10 <= sketched, \
+        f"missed hot keys: {true_top10 - sketched}"
+    for k, count, err in ss.topk():
+        assert count - err <= true[k] <= count, (k, count, err, true[k])
+
+
+def test_spacesaving_guarantee_threshold(mv_env):
+    """Any key above total/capacity frequency is guaranteed tracked."""
+    keys = np.concatenate([np.full(500, 7), np.arange(1000) + 100])
+    ss = SpaceSaving(64)
+    ss.update(keys)
+    assert 7 in {k for k, _, _ in ss.topk()}
+
+
+# ---------------------------------------------------------------------------
+# Merge: across threads and (serialized) across processes
+# ---------------------------------------------------------------------------
+def test_merge_associative_across_thread_shards(mv_env):
+    """Three thread-local sketches over disjoint stream slices merge to
+    the same answer regardless of merge order: Count-Min EXACTLY (adds
+    commute), Space-Saving's recovered heavy hitters and totals."""
+    keys = _zipf_stream(60_000, alpha=1.4, seed=2)
+    shards = np.array_split(keys, 3)
+    sketches = [TrafficSketch(width=512, depth=4, topk=128)
+                for _ in shards]
+    threads = [threading.Thread(target=sk.update, args=(part,))
+               for sk, part in zip(sketches, shards)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    def fresh(i):
+        sk = TrafficSketch(width=512, depth=4, topk=128)
+        sk.merge(sketches[i])
+        return sk
+
+    ab_c = fresh(0)
+    ab_c.merge(sketches[1])
+    ab_c.merge(sketches[2])
+    bc = fresh(1)
+    bc.merge(sketches[2])
+    a_bc = fresh(0)
+    a_bc.merge(bc)
+    assert (ab_c.cms.rows == a_bc.cms.rows).all()
+    assert ab_c.keys == a_bc.keys == len(keys)
+    top = lambda sk: {k for k, _, _ in sk.heavy.topk(10)}  # noqa: E731
+    true = collections.Counter(keys.tolist())
+    true_top = {k for k, _ in true.most_common(10)}
+    assert true_top <= top(ab_c) and true_top <= top(a_bc)
+    # ...and both merge orders equal one sketch over the whole stream.
+    single = TrafficSketch(width=512, depth=4, topk=128)
+    single.update(keys)
+    assert (single.cms.rows == ab_c.cms.rows).all()
+
+
+def test_merge_across_processes_via_state_roundtrip(mv_env):
+    """Cross-process merge = JSON state out of one process, merged in
+    another; the round trip is lossless for both sketches."""
+    a, b = TrafficSketch(), TrafficSketch()
+    keys = _zipf_stream(20_000, alpha=1.5, seed=3)
+    a.update(keys[:10_000], nbytes=111)
+    b.update(keys[10_000:], nbytes=222)
+    wire = json.dumps(b.to_state())                 # the "other process"
+    b2 = TrafficSketch.from_state(json.loads(wire))
+    assert (b2.cms.rows == b.cms.rows).all()
+    assert b2.heavy.topk() == b.heavy.topk()
+    a.merge(b2)
+    assert a.keys == len(keys) and a.bytes == 333
+    single = TrafficSketch()
+    single.update(keys)
+    assert (a.cms.rows == single.cms.rows).all()
+
+
+# ---------------------------------------------------------------------------
+# Bounded memory
+# ---------------------------------------------------------------------------
+def test_bounded_memory_under_1m_distinct_keys(mv_env):
+    """1M distinct keys through one sketch: memory stays at the fixed
+    geometry (CMS rows + capped heavy-hitter table), not O(keys)."""
+    sk = TrafficSketch(width=1024, depth=4, topk=128)
+    for lo in range(0, 1_000_000, 100_000):
+        sk.update(np.arange(lo, lo + 100_000, dtype=np.int64))
+    assert sk.keys == 1_000_000
+    assert len(sk.heavy) <= 128
+    fixed = 1024 * 4 * 8 + 128 * 96
+    assert sk.nbytes <= fixed, (sk.nbytes, fixed)
+
+
+def test_hub_memory_bound_and_surface_cap(mv_env):
+    hub = SketchHub(width=256, depth=4, topk=32)
+    for i in range(hub.MAX_SURFACES + 8):
+        hub.record(f"s{i}", np.arange(4))
+    hub.flush()
+    assert len(hub.surfaces()) == hub.MAX_SURFACES
+    assert hub.memory_bytes() <= hub.memory_bound()
+
+
+# ---------------------------------------------------------------------------
+# Hub: record -> tick -> registry metrics
+# ---------------------------------------------------------------------------
+def test_hub_flush_publishes_metrics_from_many_threads(mv_env):
+    hub = SketchHub(width=512, depth=4, topk=64)
+    keys = _zipf_stream(30_000, alpha=1.5, seed=4)
+    shards = np.array_split(keys, 4)
+
+    def worker(part):
+        for chunk in np.array_split(part, 10):
+            hub.record("serve.lookup", chunk, int(chunk.size) * 256)
+
+    threads = [threading.Thread(target=worker, args=(p,))
+               for p in shards]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    hub.flush()
+    s = hub.summary("serve.lookup")
+    assert s["keys"] == len(keys)
+    assert s["bytes"] == len(keys) * 256
+    assert s["top1_share"] > 0.2        # zipf 1.5: rank-1 ~38%
+    reg = get_registry()
+    assert reg.counter("sketch.serve.lookup.keys").value == len(keys)
+    assert reg.counter("sketch.serve.lookup.bytes").value \
+        == len(keys) * 256
+    assert reg.gauge("sketch.serve.lookup.top1_share").last > 0.2
+
+
+def test_tick_drives_flush_into_rate_series(mv_env):
+    from multiverso_tpu.telemetry.sketch import record_keys
+    store = TimeseriesStore()
+    store.tick(now=0.0)
+    record_keys("ps.table_0.get", np.arange(50), 800)
+    store.tick(now=1.0)
+    record_keys("ps.table_0.get", np.arange(100), 1600)
+    store.tick(now=2.0)
+    # rows/sec and bytes/sec per surface land as timeseries rates.
+    assert store.latest("rate.sketch.ps.table_0.get.keys") == 100.0
+    assert store.latest("rate.sketch.ps.table_0.get.bytes") == 1600.0
+
+
+def test_record_disabled_is_a_noop(mv_env):
+    hub = SketchHub()
+    hub.enabled = False
+    hub.record("s", np.arange(10))
+    hub.flush()
+    assert hub.surfaces() == []
+
+
+# ---------------------------------------------------------------------------
+# Cache-headroom advisor math
+# ---------------------------------------------------------------------------
+def test_coverage_cdf_predicts_zipf_hit_share(mv_env):
+    """The fitted-tail CDF prediction for a cache of C rows tracks the
+    empirical share of traffic the true top-C keys carry — within a few
+    points, which is what sizing a cache needs."""
+    keys = _zipf_stream(300_000, rows=50_000, alpha=1.3, seed=5)
+    ss = SpaceSaving(128)
+    ss.update(keys)
+    counts = ss.reliable_counts()
+    true = collections.Counter(keys.tolist())
+    for capacity in (64, 1024, 8192):
+        predicted = coverage_at(counts, len(keys), capacity)
+        empirical = sum(c for _, c in true.most_common(capacity)) \
+            / len(keys)
+        assert abs(predicted - empirical) < 0.08, \
+            (capacity, predicted, empirical)
+    # Within the tracked K the read is direct, not extrapolated.
+    direct = coverage_at(counts, len(keys), 10)
+    emp10 = sum(c for _, c in true.most_common(10)) / len(keys)
+    assert abs(direct - emp10) < 0.02
+
+
+def test_coverage_edge_cases(mv_env):
+    assert coverage_at([], 0, 100) == 0.0
+    assert coverage_at([10], 10, 1) == 1.0
+    assert coverage_at([5, 3], 8, 100) <= 1.0
+
+
+def test_advisor_gauges_published_for_registered_cache(mv_env):
+    """A HotRowCache registers itself; the flush after traffic publishes
+    predicted-vs-measured hit-rate gauges."""
+    from multiverso_tpu.serving import HotRowCache
+    from multiverso_tpu.telemetry.sketch import get_sketch_hub
+    cache = HotRowCache(capacity=32)
+    hot = np.arange(4)
+    cache.put_rows(hot, np.ones((4, 8), np.float32), clock=0.0)
+    hub = get_sketch_hub()
+    for _ in range(20):
+        got = cache.get_rows(hot, now_clock=0.0)        # hits -> sketch
+        assert got is not None
+    cache.get_rows(np.asarray([99]), now_clock=0.0)     # one miss
+    hub.flush()
+    reg = get_registry()
+    predicted = reg.gauge(
+        "serve.cache.advisor.predicted_hit_rate").snapshot()
+    measured = reg.gauge(
+        "serve.cache.advisor.measured_hit_rate").snapshot()
+    assert predicted["samples"] >= 1 and measured["samples"] >= 1
+    # 4 distinct keys, capacity 32: the CDF says ~everything could hit.
+    assert predicted["last"] > 0.9
+    assert 0.9 < measured["last"] < 1.0     # 20 hits / 21 lookups
+
+
+# ---------------------------------------------------------------------------
+# Shard-imbalance rule: fire/resolve hysteresis (satellite 4)
+# ---------------------------------------------------------------------------
+def _drive(store, mgr, ratio, volume, now):
+    from multiverso_tpu.telemetry import gauge
+    gauge("fleet.shard_load_ratio").set(ratio)
+    gauge("fleet.shard_keys_rate").set(volume)
+    store.tick(now=now)
+    mgr.evaluate()
+
+
+def test_shard_imbalance_fire_resolve_hysteresis(mv_env):
+    store = TimeseriesStore()
+    rule = ImbalanceRule("fleet.shard_imbalance",
+                         "gauge.fleet.shard_load_ratio",
+                         "gauge.fleet.shard_keys_rate",
+                         ratio=1.7, min_volume=100.0,
+                         for_windows=3, clear_windows=2)
+    mgr = AlertManager(store, [rule], shared_telemetry=False)
+    now = [0.0]
+
+    def window(ratio, volume):
+        now[0] += 1.0
+        _drive(store, mgr, ratio, volume, now[0])
+
+    for _ in range(5):
+        window(1.05, 5000.0)                    # balanced baseline
+    assert not mgr.active()
+    window(2.0, 5000.0)                         # one skewed window:
+    window(1.0, 5000.0)                         # a blip, then recovery
+    assert not mgr.active(), "a single spike must never fire"
+    window(2.0, 5000.0)
+    window(2.0, 5000.0)
+    assert not mgr.active(), "needs for_windows consecutive bad"
+    window(2.0, 5000.0)                         # 3rd consecutive: fires
+    assert [a["name"] for a in mgr.active()] == ["fleet.shard_imbalance"]
+    window(1.1, 5000.0)                         # one good window is not
+    assert mgr.active(), "resolve hysteresis: clear_windows needed"
+    window(1.1, 5000.0)                         # 2nd good: resolves
+    assert not mgr.active()
+
+
+def test_shard_imbalance_volume_guard(mv_env):
+    store = TimeseriesStore()
+    rule = ImbalanceRule("fleet.shard_imbalance",
+                         "gauge.fleet.shard_load_ratio",
+                         "gauge.fleet.shard_keys_rate",
+                         ratio=1.7, min_volume=100.0,
+                         for_windows=2, clear_windows=2)
+    mgr = AlertManager(store, [rule], shared_telemetry=False)
+    for i in range(6):
+        _drive(store, mgr, 3.0, 10.0, float(i + 1))     # skewed, idle
+    assert not mgr.active(), "an idle fleet's skew must not page"
+    # ...but a FIRING alert resolves through a trough (guard gates only
+    # the firing direction).
+    for i in range(3):
+        _drive(store, mgr, 3.0, 5000.0, float(10 + i))
+    assert mgr.active()
+    for i in range(2):
+        _drive(store, mgr, 1.0, 10.0, float(20 + i))
+    assert not mgr.active()
+
+
+def test_load_ratio_shapes(mv_env):
+    assert load_ratio([]) == 1.0
+    assert load_ratio([100.0, 100.0]) == 1.0
+    assert load_ratio([0.0, 200.0]) == 2.0
+    assert abs(load_ratio([1.0] * 99 + [101.0]) - 50.5) < 1.0
