@@ -11,6 +11,13 @@ never drops entries, every metric becomes a timeseries ring, and every
 exported name lands in snapshots forever — a name formatted from an
 unbounded runtime value (request id, row key, msg id) is a slow-motion
 memory leak of the observability plane itself.
+
+``non-atomic-durable-write`` polices the durability plane (ISSUE 15):
+checkpoints, manifests, and WAL segments are the files crash recovery
+stands on, and a bare open-write-close publishes torn bytes at the final
+path on any crash mid-write. Durable writes must be tmp + fsync +
+atomic-rename (the ``utils/stream._AtomicLocalStream`` shape) and
+durable appends must fsync (the WAL group commit).
 """
 
 from __future__ import annotations
@@ -80,6 +87,109 @@ def _literal_violations(literal: str, placeholder_re) -> bool:
             return True
         pos = m.end()
     return False
+
+
+# Durability-critical package scope: the modules whose files crash
+# recovery restores from. Fixture/test files (role != "package") are
+# always checked so the rule stays testable, same pattern as
+# unbounded-queue-append's scope.
+_DURABLE_SCOPE = ("multiverso_tpu/core/", "multiverso_tpu/utils/stream")
+
+
+@register
+class NonAtomicDurableWrite(Rule):
+    id = "non-atomic-durable-write"
+    severity = "error"
+    rationale = (
+        "A durability-critical file (checkpoint payload, manifest, WAL "
+        "segment) published by bare open-write-close is torn bytes at "
+        "the final path the moment a crash lands mid-write — the exact "
+        "window crash recovery exists for. Truncating writes need tmp + "
+        "fsync + os.replace (utils/stream's atomic write path, which "
+        "open_stream('...', 'w') already is); journal appends need an "
+        "fsync on their commit path.")
+
+    #: evidence calls: anything.fsync/.fdatasync(...) proves a commit
+    #: path; os.replace/os.rename prove atomic publication.
+    _FSYNC = frozenset({"fsync", "fdatasync"})
+    _RENAME = frozenset({"replace", "rename"})
+
+    def _mode_of(self, node: ast.Call) -> Optional[str]:
+        """'w'/'a' for constant write/append modes, None for reads or
+        statically-unknown modes (a variable mode is someone else's
+        dispatch layer — utils/stream — not a call site to police)."""
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if mode is None:
+            return None                      # default 'r'
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)):
+            return None
+        if "w" in mode.value:
+            return "w"
+        if "a" in mode.value:
+            return "a"
+        return None
+
+    @staticmethod
+    def _evidence_scope(node: ast.AST) -> ast.AST:
+        """Where commit evidence may live: the enclosing CLASS when there
+        is one (a journal opens in __init__ and fsyncs in flush()), else
+        the enclosing function, else the module."""
+        return (astutil.enclosing_class(node)
+                or astutil.enclosing_function(node))
+
+    def _has_evidence(self, scope: Optional[ast.AST], ctx: FileContext,
+                      names: frozenset) -> bool:
+        for tree in ([scope] if scope is not None else [ctx.tree]):
+            for sub in ast.walk(tree):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in names:
+                    return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.role == "script":
+            return          # scripts write reports/logs, not recovery state
+        if ctx.role == "package" and \
+                not any(s in ctx.rel for s in _DURABLE_SCOPE):
+            return
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Name) and fn.id == "open"
+                    and fn.id not in ctx.aliases):
+                continue
+            owner = astutil.enclosing_function(node)
+            if owner is not None and astutil._assigns_name(owner, "open"):
+                continue                    # locally shadowed
+            mode = self._mode_of(node)
+            if mode is None:
+                continue
+            scope = self._evidence_scope(node)
+            fsync = self._has_evidence(scope, ctx, self._FSYNC)
+            rename = self._has_evidence(scope, ctx, self._RENAME)
+            if mode == "w" and not (fsync and rename):
+                yield self.finding(
+                    ctx, node,
+                    "durability-critical truncating write without "
+                    "fsync + atomic rename in reach — a crash mid-write "
+                    "tears the file at its final path; write tmp, "
+                    "fsync, os.replace (or route through "
+                    "utils/stream.open_stream)")
+            elif mode == "a" and not fsync:
+                yield self.finding(
+                    ctx, node,
+                    "durability-critical append with no fsync in reach "
+                    "— journal records that never hit the platter are "
+                    "silent acked-write loss on the next crash; group "
+                    "commit with fsync (core/wal.py is the shape)")
 
 
 @register

@@ -132,6 +132,18 @@ def net_bind(host: str = "127.0.0.1", port: int = 0):
     check(zoo.started, "call mv.init() first")
     check(zoo.ps_service is None, "service already bound")
     zoo.ps_service = PSService(host, port)
+    # Durability (-wal; docs/DURABILITY.md): arm the write-ahead delta
+    # log before any table registers. Per-rank subdirectory so N ranks
+    # sharing -wal_dir never interleave segments.
+    from multiverso_tpu.utils.configure import flag_or
+    if bool(flag_or("wal", False)):
+        wal_dir = str(flag_or("wal_dir", ""))
+        check(bool(wal_dir), "-wal=true requires -wal_dir=DIR")
+        import os as _os
+        zoo.ps_service.attach_wal(
+            _os.path.join(wal_dir, f"rank{int(flag_or('rank', 0))}"),
+            flush_interval_ms=float(flag_or("wal_flush_ms", 25.0)),
+            sync_acks=bool(flag_or("wal_sync_acks", False)))
     return zoo.ps_service.address
 
 

@@ -194,6 +194,12 @@ def fleet_config() -> dict:
         "synthetic": synthetic,
         "proxy": bool(get_flag("fleet_proxy")),
         "drain_timeout_s": float(get_flag("fleet_drain_timeout_s")),
+        "supervise": bool(get_flag("fleet_supervise")),
+        "min_replicas": int(get_flag("fleet_min_replicas")),
+        "max_replicas": int(get_flag("fleet_max_replicas")),
+        "supervisor_cooldown_s":
+            float(get_flag("fleet_supervisor_cooldown_s")),
+        "scale_quiet_s": float(get_flag("fleet_scale_quiet_s")),
     }
 
 

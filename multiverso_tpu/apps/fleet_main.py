@@ -13,7 +13,11 @@ Three roles (``-fleet_role``):
 * ``local``   — dev/bench topology in one command: an in-process router
   plus ``-fleet_replicas`` spawned replica processes (each pinned to CPU
   unless ``-serve_device=default`` — N local replicas must not fight
-  over one chip).
+  over one chip). With ``-fleet_supervise`` the spawned fleet is
+  SELF-HEALING (docs/DURABILITY.md): a dead or heartbeat-lost replica
+  is respawned through the same spawn path, firing SLO-burn /
+  queue-saturation alerts grow the fleet (to ``-fleet_max_replicas``),
+  and a long quiet period drains supervisor-grown replicas back down.
 
 * ``drain``   — operator command against a RUNNING fleet: sends
   ``Fleet_Drain`` to the router and waits for the rolling cycle (each
@@ -213,10 +217,11 @@ def _router_body(cfg: dict) -> int:
 
 
 def _spawn_replicas(cfg: dict, router_addr, args: List[str],
-                    count: int) -> List:
+                    count: int, first_slot: int = 0) -> List:
     """Re-exec this module once per replica, pointed at the router. Each
     child defaults to CPU pinning (N local replicas would otherwise fight
-    for one accelerator)."""
+    for one accelerator). ``first_slot`` numbers the member ids — the
+    supervisor respawns/scales individual slots through the same path."""
     import subprocess
 
     base = [a for a in args
@@ -224,12 +229,13 @@ def _spawn_replicas(cfg: dict, router_addr, args: List[str],
                                              "fleet_replicas=",
                                              "fleet_port=",
                                              "fleet_addr_file=",
+                                             "fleet_supervise=",
                                              "serve_addr_file=",
                                              "serve_port="))]
     if not any(a.lstrip("-").startswith("serve_device=") for a in base):
         base.append("-serve_device=cpu")
     procs = []
-    for r in range(count):
+    for r in range(first_slot, first_slot + count):
         cmd = [sys.executable, "-m", "multiverso_tpu.apps.fleet_main",
                "-fleet_role=replica",
                f"-fleet_router={router_addr[0]}:{router_addr[1]}",
@@ -249,6 +255,7 @@ def _local_body(cfg: dict, remaining_args: List[str]) -> int:
     _write_addr_file(cfg["addr_file"], router.address)
     procs = _spawn_replicas(cfg, router.address, remaining_args,
                             cfg["replicas"])
+    supervisor = None
     try:
         deadline = time.monotonic() + 120
         while len(router.group.member_ids()) < cfg["replicas"]:
@@ -259,8 +266,37 @@ def _local_body(cfg: dict, remaining_args: List[str]) -> int:
             time.sleep(0.05)
         log.info("fleet up: %d replicas behind %s:%d",
                  cfg["replicas"], *router.address)
+        if cfg["supervise"]:
+            # Self-healing (-fleet_supervise; docs/DURABILITY.md): the
+            # supervisor owns the replica processes from here — a dead
+            # or heartbeat-lost member is RESPAWNED through the same
+            # spawn path, and firing SLO-burn/queue-saturation alerts
+            # grow the fleet (quiet periods shrink it back).
+            from multiverso_tpu.fleet import (LocalFleetView,
+                                              ReplicaSupervisor)
+
+            def spawn_one(slot: int):
+                return _spawn_replicas(cfg, router.address,
+                                       remaining_args, 1,
+                                       first_slot=slot)[0]
+
+            supervisor = ReplicaSupervisor(
+                LocalFleetView(router), spawn_one,
+                min_replicas=cfg["min_replicas"],
+                max_replicas=cfg["max_replicas"],
+                cooldown_s=cfg["supervisor_cooldown_s"],
+                scale_quiet_s=cfg["scale_quiet_s"])
+            for i, p in enumerate(procs):
+                supervisor.adopt(i, p)
+            supervisor.start()
+            log.info("fleet supervisor armed (min=%d max=%d cooldown=%.1fs)",
+                     cfg["min_replicas"], cfg["max_replicas"],
+                     cfg["supervisor_cooldown_s"])
         _wait_duration()
     finally:
+        if supervisor is not None:
+            supervisor.stop()
+            procs = list(supervisor.slots().values())
         for p in procs:
             p.terminate()
         for p in procs:

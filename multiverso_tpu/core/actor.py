@@ -68,9 +68,16 @@ class MsgType(enum.IntEnum):
 
 
 class Message:
-    """Header + payload (ref message.h:26-68)."""
+    """Header + payload (ref message.h:26-68).
 
-    __slots__ = ("src", "dst", "type", "table_id", "msg_id", "data")
+    ``raw`` (optional) is the exact wire frame this message was parsed
+    from — the PS service's IO loop pins it on WAL-armed services so the
+    delta log appends the received bytes verbatim instead of paying a
+    re-serialization on the dispatch hot path. Never set on constructed
+    (outbound) messages."""
+
+    __slots__ = ("src", "dst", "type", "table_id", "msg_id", "data",
+                 "raw")
 
     def __init__(self, src: int = -1, dst: int = -1,
                  type: int = MsgType.Request_Get, table_id: int = -1,
@@ -81,6 +88,7 @@ class Message:
         self.table_id = table_id
         self.msg_id = msg_id
         self.data = data if data is not None else []
+        self.raw: Optional[bytes] = None
 
     def create_reply(self) -> "Message":
         """Reply inverts src/dst and negates the type (ref message.h:51-59)."""

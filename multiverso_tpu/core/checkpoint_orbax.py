@@ -74,6 +74,13 @@ class AsyncSaveHandle:
             tmp = os.path.join(self._staging, "manifest.json.tmp")
             with open(tmp, "w") as f:
                 json.dump({"tables": self._tables, "time": _time.time()}, f)
+                # fsync BEFORE the rename: the manifest is the durability
+                # marker restore selects on, and a rename can land while
+                # the bytes are still page-cache-only — power loss would
+                # leave a committed dir with a torn marker (found by the
+                # non-atomic-durable-write lint).
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self._staging, "manifest.json"))
             # From here the STAGING dir is itself a complete, manifested,
             # restorable checkpoint (restore selection accepts manifested

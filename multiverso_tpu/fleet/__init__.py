@@ -16,7 +16,11 @@ taken to its fleet conclusion). Layers:
 * ``router``     — ``FleetRouter``: the control-plane service, an
   optional data-plane proxy, and the rolling-drain driver;
 * ``client``     — ``FleetClient``: ring-routed lookups, health-balanced
-  decode, hedging + typed-failover.
+  decode, hedging + typed-failover;
+* ``supervisor`` — ``ReplicaSupervisor``: the actuation half of the
+  self-healing fleet — alert-driven replacement of dead members and
+  spawn/drain autoscaling with hysteresis + cooldown
+  (docs/DURABILITY.md "Supervisor").
 
 See docs/SERVING.md ("Fleet") for topology and tuning, and
 docs/OBSERVABILITY.md for the ``fleet.*`` metric catalog.
@@ -32,10 +36,14 @@ from multiverso_tpu.fleet.hedge import (AdaptiveDelay, HedgedCall,
 from multiverso_tpu.fleet.membership import (FleetMember, MemberInfo,
                                              ReplicaGroup)
 from multiverso_tpu.fleet.router import FleetRouter
+from multiverso_tpu.fleet.supervisor import (LocalFleetView,
+                                             RemoteFleetView,
+                                             ReplicaSupervisor)
 
 __all__ = [
     "AdaptiveDelay", "FleetClient", "FleetMember", "FleetRouter",
-    "HashRing", "HedgeScheduler", "HedgedCall", "MemberInfo",
-    "ReplicaGroup", "RoutingTable", "STAT_FIELDS", "fetch_fleet_stats",
-    "health_score", "local_stats", "metrics_payload", "request_drain",
+    "HashRing", "HedgeScheduler", "HedgedCall", "LocalFleetView",
+    "MemberInfo", "RemoteFleetView", "ReplicaGroup", "ReplicaSupervisor",
+    "RoutingTable", "STAT_FIELDS", "fetch_fleet_stats", "health_score",
+    "local_stats", "metrics_payload", "request_drain",
 ]

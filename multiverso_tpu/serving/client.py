@@ -51,14 +51,43 @@ _TRANSIENT_CONNECT = (ConnectionRefusedError, ConnectionResetError,
                       ConnectionAbortedError, socket.timeout)
 
 
+#: Backoff cap and jitter fraction for :func:`connect_with_backoff`.
+#: Jitter is load-bearing, not cosmetic: after a router/replica restart
+#: EVERY disconnected client re-dials on the same schedule — identical
+#: deterministic delays synchronize the whole fleet into reconnect
+#: stampedes that land on the freshly-bound listener's backlog together
+#: (and refused connects re-synchronize the next wave). Each retry
+#: sleeps a uniform draw from ``[(1 - jitter) * delay, delay]`` so the
+#: waves decorrelate while the CAP still bounds total dial time.
+BACKOFF_CAP_S = 0.5
+BACKOFF_JITTER = 0.5
+
+
+def backoff_delays(attempts: int, base_delay_s: float = 0.05,
+                   cap_s: float = BACKOFF_CAP_S,
+                   jitter: float = BACKOFF_JITTER,
+                   rng=None) -> "List[float]":
+    """The retry-sleep schedule ``connect_with_backoff`` uses, exposed as
+    a pure function so tests pin the envelope: delay ``i`` is uniform in
+    ``[(1 - jitter) * d_i, d_i]`` with ``d_i = min(base * 2^i, cap)``."""
+    import random as _random
+    rng = rng or _random
+    out = []
+    for i in range(max(0, int(attempts) - 1)):
+        d = min(base_delay_s * (2 ** i), cap_s)
+        out.append(d * (1.0 - jitter * rng.random()))
+    return out
+
+
 def connect_with_backoff(host: str, port: int, attempts: int = 4,
                          base_delay_s: float = 0.05,
                          timeout_s: float = 30.0) -> socket.socket:
-    """``socket.create_connection`` with capped exponential backoff over
-    transient refusals. Raises :class:`ReplicaUnavailableError` once the
-    attempts are spent — the caller knows it is a DEAD REPLICA, not a bad
-    request."""
+    """``socket.create_connection`` with capped exponential backoff —
+    JITTERED (see :data:`BACKOFF_JITTER`) — over transient refusals.
+    Raises :class:`ReplicaUnavailableError` once the attempts are spent —
+    the caller knows it is a DEAD REPLICA, not a bad request."""
     attempts = max(1, int(attempts))
+    delays = backoff_delays(attempts, base_delay_s)
     last: Optional[BaseException] = None
     for i in range(attempts):
         try:
@@ -66,7 +95,7 @@ def connect_with_backoff(host: str, port: int, attempts: int = 4,
         except _TRANSIENT_CONNECT as e:
             last = e
             if i + 1 < attempts:
-                time.sleep(min(base_delay_s * (2 ** i), 0.5))
+                time.sleep(delays[i])
     raise ReplicaUnavailableError(
         f"replica {host}:{port} unavailable after {attempts} connect "
         f"attempts: {last}")

@@ -324,6 +324,36 @@ define_bool("fleet_proxy", True, "router also proxies plain Serve_Request "
             "traffic (clients that don't speak the routing protocol)")
 define_double("fleet_drain_timeout_s", 30.0, "drain barrier: max wait for "
               "in-flight batches before the checkpoint swap proceeds")
+# PS-shard durability: write-ahead delta log + crash recovery
+# (core/wal.py, parallel/ps_service.py; docs/DURABILITY.md).
+define_bool("wal", False, "arm the PS shard write-ahead delta log: every "
+            "accepted Request_Add appends a CRC-framed record; recovery = "
+            "latest checkpoint + replay the log tail (docs/DURABILITY.md)")
+define_string("wal_dir", "", "WAL segment directory (per process — a "
+              "rank<k> subdirectory is appended when the CLI knows its "
+              "rank); required when -wal=true")
+define_double("wal_flush_ms", 25.0, "group-commit interval: staged records "
+              "are written+fsynced together every this many ms (an abrupt "
+              "kill loses at most this window of ACKED adds; -wal_sync_acks "
+              "closes the window entirely at per-record fsync cost)")
+define_bool("wal_sync_acks", False, "fsync each add's record BEFORE its "
+            "reply: no acked-write-loss window, at per-record fsync cost "
+            "on the dispatch thread (the recovery drill's mode)")
+# Fleet supervisor: the ACTUATION half of the self-healing fleet
+# (fleet/supervisor.py; docs/DURABILITY.md "Supervisor").
+define_bool("fleet_supervise", False, "local fleet role: watch spawned "
+            "replicas and respawn on death/heartbeat loss; scale up on "
+            "firing serve.slo_burn / serve.queue_saturation alerts and "
+            "back down after a quiet period (hysteresis + cooldown)")
+define_int("fleet_min_replicas", 1, "supervisor floor: scale-down never "
+           "goes below this many replicas")
+define_int("fleet_max_replicas", 8, "supervisor ceiling: scale-up never "
+           "goes above this many replicas")
+define_double("fleet_supervisor_cooldown_s", 10.0, "minimum seconds "
+              "between ANY two supervisor scaling actions (anti-flap)")
+define_double("fleet_scale_quiet_s", 30.0, "how long every scale alert "
+              "must stay resolved before the supervisor drains a "
+              "scale-up replica back down")
 # Per-table communication policy (parallel/comm_policy.py;
 # docs/DESIGN.md "CommPolicy").
 define_string("comm_policy", "", "per-table communication policy: '' = "

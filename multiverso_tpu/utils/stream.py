@@ -28,12 +28,78 @@ def _parse_uri(uri: str) -> Tuple[str, str]:
     return "file", uri
 
 
+class _AtomicLocalStream(io.FileIO):
+    """Durable local write stream: bytes land in a sibling temp file and
+    only a CLEAN close publishes them — flush, ``fsync``, atomic
+    ``os.replace``, then an fsync of the parent directory so the rename
+    itself survives power loss. A crash (or an exception in the ``with``
+    body, which aborts) leaves the previous file intact and at worst a
+    stray ``.tmp-*`` — never a torn checkpoint/manifest at the final
+    path. This is the write shape the ``non-atomic-durable-write`` lint
+    enforces (docs/DURABILITY.md)."""
+
+    def __init__(self, path: str):
+        self._final = os.path.abspath(path)
+        self._tmp = f"{self._final}.tmp-{os.getpid()}-{id(self):x}"
+        self._aborted = False
+        super().__init__(self._tmp, "wb")
+
+    def abort(self) -> None:
+        """Discard: close() unlinks the temp instead of publishing."""
+        self._aborted = True
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.abort()
+        return super().__exit__(exc_type, exc, tb)
+
+    def __del__(self) -> None:
+        # GC finalization must NEVER publish: an abandoned stream (an
+        # exception unwound past a with-less writer) holds a PARTIAL
+        # payload, and IOBase's finalizer calls close() — which would
+        # replace the intact previous file with the torn temp, the
+        # exact outcome this class exists to prevent. Publication is
+        # an explicit-close privilege.
+        self._aborted = True
+        try:
+            super().__del__()
+        except Exception:  # noqa: BLE001 - finalizers must not raise
+            pass
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        try:
+            if not self._aborted:
+                self.flush()
+                os.fsync(self.fileno())
+        finally:
+            super().close()
+        if self._aborted:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+            return
+        os.replace(self._tmp, self._final)
+        dfd = os.open(os.path.dirname(self._final) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+
 def _open_local(path: str, mode: str) -> BinaryIO:
     if "w" in mode or "a" in mode:
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
     if "b" not in mode:
         mode += "b"
+    if "w" in mode:
+        # Checkpoints/manifests ride this path: publish atomically or
+        # not at all (a torn meta.json would defeat the durability
+        # marker latest_checkpoint selects on).
+        return _AtomicLocalStream(path)
     return open(path, mode)
 
 

@@ -1,0 +1,57 @@
+"""Fixture: idiomatic durable writes — atomic publication and fsynced
+journals — plus the shapes the rule must not chase (reads, dispatch
+layers with variable modes, shadowed open)."""
+import json
+import os
+
+
+def save_manifest_atomically(root, meta):
+    """The blessed truncating shape: tmp + fsync + os.replace."""
+    final = os.path.join(root, "meta.json")
+    tmp = final + f".tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(meta))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+
+
+class GroupCommitJournal:
+    """Append journal whose commit path fsyncs — evidence may live in a
+    DIFFERENT method of the same class (open in __init__, fsync in
+    flush), the WAL shape."""
+
+    def __init__(self, path):
+        self._f = open(path, "ab")
+        self._pending = 0
+
+    def append(self, rec):
+        self._f.write(rec)
+        self._pending += 1
+
+    def flush(self):
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._pending = 0
+
+
+def read_payload(path):
+    with open(path, "rb") as f:     # reads are not publications
+        return f.read()
+
+
+def default_mode_read(path):
+    with open(path) as f:           # default 'r'
+        return f.read()
+
+
+def dispatch_layer(path, mode):
+    # A variable mode is a dispatch layer (utils/stream's factory), not
+    # a call site the rule can statically judge.
+    return open(path, mode)
+
+
+def shadowed_open(path):
+    def open(p, m):                 # noqa: A001 - deliberate shadow
+        return [p, m]
+    return open(path, "w")

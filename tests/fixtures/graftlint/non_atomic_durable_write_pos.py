@@ -1,0 +1,43 @@
+"""Fixture: durability-critical files published without the tmp + fsync
++ atomic-rename shape — torn bytes at the final path on a crash."""
+import json
+import os
+
+
+def save_manifest(root, meta):
+    # Truncating write straight at the final path: a crash mid-write
+    # leaves a torn meta.json — the durability marker itself.
+    with open(os.path.join(root, "meta.json"), "w") as f:  # expect: non-atomic-durable-write
+        f.write(json.dumps(meta))
+
+
+def save_payload_binary(path, blob):
+    f = open(path, "wb")  # expect: non-atomic-durable-write
+    f.write(blob)
+    f.close()
+
+
+def rename_without_fsync(path, blob):
+    # Rename alone is not durable publication: the temp's BYTES may
+    # still be in cache when the rename lands — fsync must precede it.
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:  # expect: non-atomic-durable-write
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+class Journal:
+    """Append-mode journal whose commit path never fsyncs: every
+    'durable' record is acked-write loss waiting for a crash."""
+
+    def __init__(self, path):
+        self._f = open(path, "ab")  # expect: non-atomic-durable-write
+
+    def append(self, rec):
+        self._f.write(rec)
+        self._f.flush()     # flush() reaches the page cache, not disk
+
+
+def keyword_mode_write(path, blob):
+    with open(path, mode="wb") as f:  # expect: non-atomic-durable-write
+        f.write(blob)

@@ -108,6 +108,127 @@ def test_rolling_restart_fuzz(mv_env, tmp_path):
         s.close()
 
 
+def test_supervisor_kill_respawn_fuzz(mv_env):
+    """Kill-respawn chaos over the SUPERVISOR (ISSUE 15 satellite): an
+    in-process fleet of serving replicas under a ReplicaSupervisor; a
+    seeded schedule abruptly kills random victims at random times (no
+    leave, no drain — heartbeats just stop, exactly a SIGKILL's shape).
+    Invariants: the fleet converges back to FULL membership after every
+    round, every respawn is supervisor-driven (counted + event-logged),
+    lookups answer correct bytes at the end, and no monitored daemon
+    loop wedged (watchdog trips 0)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from multiverso_tpu.core.table import ServerStore
+    from multiverso_tpu.core.updater import get_updater
+    from multiverso_tpu.fleet import (FleetClient, FleetRouter,
+                                      FleetMember, LocalFleetView,
+                                      ReplicaSupervisor)
+    from multiverso_tpu.serving import ServingService, SparseLookupRunner
+    from multiverso_tpu.telemetry import get_registry
+    from multiverso_tpu.telemetry.flight import start_watchdog
+
+    start_watchdog()
+    trips0 = get_registry().counter("telemetry.watchdog.trips").value
+    ROWS, COLS, N = 256, 8, 3
+    rng = np.random.default_rng(42)
+    data = np.random.default_rng(0).normal(
+        size=(ROWS, COLS)).astype(np.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("server",))
+    router = FleetRouter(heartbeat_ms=40.0, liveness_misses=4)
+
+    class InProcReplica:
+        """Supervisor handle over an in-process service+member pair."""
+
+        def __init__(self, slot: int):
+            store = ServerStore(f"fuzz_t{slot}", (ROWS, COLS), np.float32,
+                                get_updater(np.float32, "default"), mesh,
+                                num_workers=1, init_array=data.copy())
+            self.service = ServingService()
+            self.service.register_runner(SparseLookupRunner(store),
+                                         buckets=(4, 8), max_batch=4,
+                                         max_wait_ms=1.0)
+            self.service.warmup()
+            self.member = FleetMember(router.address, self.service,
+                                      member_id=f"replica-{slot}").start()
+            self.dead = False
+
+        def poll(self):
+            return 1 if self.dead else None
+
+        def kill_abruptly(self):
+            """SIGKILL shape: heartbeats stop mid-cadence, no goodbye."""
+            self.dead = True
+            self.member._stop.set()
+            self.member._close_sock()
+            self.service.close()
+
+        def terminate(self):
+            self.dead = True
+            self.member.close()
+            self.service.close()
+
+    sup = ReplicaSupervisor(LocalFleetView(router), InProcReplica,
+                            min_replicas=N, max_replicas=N,
+                            cooldown_s=0.5, poll_s=0.05,
+                            join_grace_s=20.0)
+    replicas = {i: InProcReplica(i) for i in range(N)}
+    for i, r in replicas.items():
+        sup.adopt(i, r)
+
+    def await_members(n, deadline_s):
+        deadline = time.monotonic() + deadline_s
+        while len(router.group.member_ids()) != n:
+            assert time.monotonic() < deadline, \
+                (f"fleet never converged to {n} members; have "
+                 f"{router.group.member_ids()}; events {sup.events()}")
+            time.sleep(0.02)
+
+    await_members(N, 20)
+    sup.start()
+    cli = FleetClient(router.address, refresh_s=0.05, hedge="off")
+    try:
+        kills = 0
+        for round_i in range(4):
+            victims = {int(rng.integers(0, N))}
+            if rng.random() < 0.4:          # sometimes a double kill
+                victims.add(int(rng.integers(0, N)))
+            time.sleep(float(rng.random() * 0.3))
+            for v in victims:
+                sup.slots()[v].kill_abruptly()
+                kills += 1
+            # Convergence: sweep reaps the corpses, the supervisor
+            # respawns the slots (counted), fresh members warm + rejoin.
+            deadline = time.monotonic() + 60
+            while sup.status()["respawns"] < kills:
+                assert time.monotonic() < deadline, \
+                    (f"supervisor never respawned round {round_i} "
+                     f"victims {victims}: {sup.status()}")
+                time.sleep(0.02)
+            await_members(N, 60)
+        status = sup.status()
+        assert status["respawns"] >= kills, status
+        assert sorted(status["slots"]) == list(range(N))
+        respawn_events = [e for e in status["events"]
+                          if e["kind"] == "respawn"]
+        assert {e["trigger"] for e in respawn_events} <= \
+            {"process_exit", "heartbeat_loss", "missing_timeout"}
+        # The healed fleet still serves correct bytes from every owner.
+        for _ in range(8):
+            rows = rng.integers(0, ROWS, 4).astype(np.int32)
+            got = cli.lookup(rows, deadline_ms=10_000, timeout=30)
+            np.testing.assert_array_equal(got, data[rows])
+        trips = get_registry().counter("telemetry.watchdog.trips").value
+        assert trips == trips0, "a daemon loop wedged during the chaos"
+    finally:
+        cli.close()
+        sup.stop()
+        for handle in sup.slots().values():
+            handle.terminate()
+        router.close()
+
+
 def test_restart_restore_before_announce_keeps_acked_writes(mv_env,
                                                             tmp_path):
     """The acked-write-loss race the fuzz caught, pinned deterministically:

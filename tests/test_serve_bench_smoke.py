@@ -30,7 +30,7 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     record = json.loads(out.read_text())
     # v7: + hotkeys block (planted-Zipf sketch recovery + cache-headroom
     # advisor), box fingerprint (bench_guard's warn-don't-fail key)
-    assert record["schema"] == "multiverso_tpu.bench_serve/v7"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v8"
     assert record["box"]["cores"] >= 1
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
