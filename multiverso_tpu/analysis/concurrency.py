@@ -14,6 +14,10 @@ lock-acquisition graph catches before a 600-second wedge does:
   for its module-level dict/list registries must take it on every write;
 * ``bare-thread-no-join``     — a non-daemon Thread that nobody joins
   outlives shutdown ordering and wedges interpreter exit.
+* ``blocking-call-no-timeout`` — a connect/recv/wait that can park a
+  fleet thread forever against a peer that was just SIGKILLed; the
+  recoverable-fleet planes must bound every block so the retry/hedge
+  machinery gets a turn.
 """
 
 from __future__ import annotations
@@ -672,6 +676,111 @@ class BareThreadNoJoin(Rule):
                                 isinstance(anc.iter, ast.Name) and \
                                 anc.iter.id == target:
                             return True
+        return False
+
+
+@register
+class BlockingCallNoTimeout(Rule):
+    id = "blocking-call-no-timeout"
+    severity = "warning"
+    rationale = (
+        "A connect/recv/wait with no deadline parks its thread against a "
+        "peer that may have just been SIGKILLed — in the recoverable "
+        "fleet the peer's REPLACEMENT comes up at a NEW address, so a "
+        "block on the old one never returns and the park is forever, "
+        "silently exempt from the park-and-retry/hedge machinery the "
+        "chaos drill proves out. Scoped to the planes that talk to "
+        "killable peers (multiverso_tpu/fleet/ + multiverso_tpu/"
+        "parallel/): every block there must carry a timeout (or a "
+        "non-constant one — the owner decided), or suppress with a "
+        "reason when liveness is owned elsewhere (e.g. a reader whose "
+        "socket close is the wakeup).")
+
+    _SCOPED = ("multiverso_tpu/fleet/", "multiverso_tpu/parallel/")
+    #: Zero-arg blockers: Event/Condition.wait() and Queue.get() (a
+    #: zero-arg dict .get is a TypeError, so no dict false positives)
+    #: block forever; Popen.wait() blocks until a child that may be
+    #: SIGSTOPed exits.
+    _WAITERS = {"wait", "get"}
+    #: Socket reads that honor settimeout: flagged when no settimeout
+    #: evidence is in reach of the receiver's scope.
+    _RECVS = {"recv", "recv_into", "recvfrom"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.role == "script":
+            return      # benches own their wall clock
+        if ctx.role == "package" and \
+                not any(s in ctx.rel for s in self._SCOPED):
+            return
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = astutil.resolve_name(node.func, ctx.aliases)
+            if resolved == "socket.create_connection":
+                # timeout is the 2nd positional; absent both ways, the
+                # connect inherits the global default of None (forever).
+                if len(node.args) < 2 and not any(
+                        k.arg == "timeout" for k in node.keywords):
+                    yield self.finding(
+                        ctx, node,
+                        "socket.create_connection(...) without a timeout "
+                        "blocks forever against a partitioned peer — "
+                        "pass timeout= (the fleet idiom: a short, "
+                        "retry-wrapped connect)")
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+            recv = node.func.value
+            if attr in self._WAITERS and not node.args \
+                    and not node.keywords:
+                if isinstance(recv, ast.Name) and \
+                        recv.id.lstrip("_")[:1].isupper():
+                    continue    # Zoo.get()-style classmethod accessor
+                yield self.finding(
+                    ctx, node,
+                    f".{attr}() with no timeout blocks forever if the "
+                    "peer/event never arrives (a SIGKILLed shard's "
+                    "reply, a respawned worker's signal) — pass a "
+                    "timeout and handle the expiry")
+            elif attr in self._RECVS:
+                base = self._base_key(recv)
+                if base is None:
+                    continue
+                scope = (astutil.enclosing_class(node)
+                         if base[0] == "self"
+                         else astutil.enclosing_function(node))
+                if scope is None or not self._timeout_evidence(scope):
+                    yield self.finding(
+                        ctx, node,
+                        f".{attr}(...) on a socket with no settimeout "
+                        "evidence in scope: a peer SIGSTOPed (or "
+                        "SIGKILLed mid-frame) parks this read forever — "
+                        "settimeout() the socket or create it with "
+                        "create_connection(..., timeout=...)")
+
+    @staticmethod
+    def _base_key(expr: ast.expr):
+        if isinstance(expr, ast.Name):
+            return ("name", expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            return ("self", expr.attr)
+        return None
+
+    @staticmethod
+    def _timeout_evidence(scope: ast.AST) -> bool:
+        """Any settimeout(...) call or a timeout= kwarg on a connect in
+        the evidence scope: the socket's read deadline is owned there."""
+        for sub in ast.walk(scope):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "settimeout":
+                return True
+            if any(k.arg == "timeout" for k in sub.keywords):
+                return True
         return False
 
 

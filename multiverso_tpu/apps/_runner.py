@@ -200,6 +200,9 @@ def fleet_config() -> dict:
         "supervisor_cooldown_s":
             float(get_flag("fleet_supervisor_cooldown_s")),
         "scale_quiet_s": float(get_flag("fleet_scale_quiet_s")),
+        "rpc_timeout_ms": float(get_flag("rpc_timeout_ms")),
+        "ps_shards": int(get_flag("ps_fleet_shards")),
+        "ps_dir": str(get_flag("ps_fleet_dir")),
     }
 
 

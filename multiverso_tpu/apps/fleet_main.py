@@ -25,6 +25,13 @@ Three roles (``-fleet_role``):
   checkpoint, re-warms, rejoins; the ring never loses more than one
   member and no request is dropped).
 
+* ``ps_fleet`` — supervised multi-shard PS topology
+  (``fleet/ps_fleet.py``): ``-ps_fleet_shards`` durable WAL'd
+  parameter-server seats of one table, each journaled, periodically
+  checkpointed, and respawned through the checkpoint+WAL-replay
+  recovery path when it dies (docs/DURABILITY.md "Fleet topology &
+  fault matrix").
+
     python -m multiverso_tpu.apps.fleet_main -fleet_role=local \\
         -checkpoint_dir=/ckpts -fleet_replicas=3 -serve_duration=600
     # ...training lands a new checkpoint...
@@ -169,7 +176,8 @@ def _drain_body(cfg: dict) -> int:
     check(cfg["router"] is not None,
           "-fleet_router=host:port is required for the drain role")
     target = cfg["member_id"] or None
-    cli = FleetClient(cfg["router"], hedge="off")
+    cli = FleetClient(cfg["router"], hedge="off",
+                      rpc_timeout_ms=cfg["rpc_timeout_ms"] or None)
     try:
         before = {m["id"]: int(m.get("drains_completed", 0))
                   for m in cli.routing().members}
@@ -198,6 +206,40 @@ def _drain_body(cfg: dict) -> int:
         return 1
     finally:
         cli.close()
+
+
+def _ps_fleet_body(cfg: dict) -> int:
+    """Supervised multi-shard PS topology (docs/DURABILITY.md "Fleet
+    topology & fault matrix"): N durable WAL'd ps_shard seats under one
+    ReplicaSupervisor, with the client seat (rank 0) held by this
+    process. Runs until -serve_duration elapses; a killed shard is
+    respawned through the recovery path the whole time."""
+    from multiverso_tpu.fleet import PSShardFleet
+    from multiverso_tpu.utils.configure import flag_or
+
+    # Seats must outlive the owning window (they exit via close(), not
+    # their own timer): pad a bounded window, cap an unbounded one.
+    duration = float(flag_or("serve_duration", 0.0))
+    seat_duration = duration + 120.0 if duration > 0 else 86400.0
+    fleet = PSShardFleet(
+        shards=cfg["ps_shards"],
+        table_id=int(flag_or("ps_table_id", 912)),
+        table_size=int(flag_or("ps_table_size", 10000)),
+        table_kind=str(flag_or("ps_table_kind", "array")),
+        table_cols=int(flag_or("ps_table_cols", 8)),
+        workdir=cfg["ps_dir"] or None,
+        sync_acks=bool(flag_or("wal_sync_acks", True)),
+        wal_flush_ms=float(flag_or("wal_flush_ms", 25.0)),
+        checkpoint_every_s=float(flag_or("ps_checkpoint_every_s", 1.0)),
+        serve_duration=seat_duration,
+        supervise=True).start()
+    log.info("ps fleet serving: %d shard(s), workdir %s",
+             fleet.shards, fleet.workdir)
+    try:
+        _wait_duration()
+    finally:
+        fleet.close()
+    return 0
 
 
 def _router_body(cfg: dict) -> int:
@@ -327,8 +369,10 @@ def main(argv=None) -> int:
             return _router_body(cfg)
         if role == "drain":
             return _drain_body(cfg)
+        if role == "ps_fleet":
+            return _ps_fleet_body(cfg)
         check(role == "local",
-              f"-fleet_role must be local|router|replica|drain, "
+              f"-fleet_role must be local|router|replica|drain|ps_fleet, "
               f"got '{role}'")
         return _local_body(cfg, raw_args)
 

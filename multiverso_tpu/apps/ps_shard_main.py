@@ -56,6 +56,7 @@ def _body(remaining: List[str]) -> int:
 
     from multiverso_tpu.core import checkpoint as ckpt
     from multiverso_tpu.parallel.ps_service import (DistributedArrayTable,
+                                                    DistributedMatrixTable,
                                                     PSService)
     from multiverso_tpu.utils.configure import flag_or
 
@@ -76,15 +77,36 @@ def _body(remaining: List[str]) -> int:
         svc.attach_wal(os.path.join(wal_dir, f"rank{rank}"),
                        flush_interval_ms=float(get_flag("wal_flush_ms")),
                        sync_acks=bool(get_flag("wal_sync_acks")))
+        fsync_delay_ms = float(flag_or("wal_fsync_delay_ms", 0.0))
+        if fsync_delay_ms > 0:
+            # Chaos drill's slow-disk seat: every commit fsync stretches
+            # by this much, so sync acks slow but stay durable.
+            from multiverso_tpu.core import wal as wal_mod
+            wal_mod.set_fsync_delay(fsync_delay_ms / 1e3)
+            log.info("ps_shard: CHAOS slow disk armed (%.0fms/fsync)",
+                     fsync_delay_ms)
     peers[rank] = svc.address
     # Recovery protocol (docs/DURABILITY.md): the table registers its
     # shard but does NOT announce until state is restored — an early
     # announce lets a peer's retried add land on the fresh shard and be
     # overwritten by the restore (the acked-write loss the elastic fuzz
     # pinned).
-    table = DistributedArrayTable(int(get_flag("ps_table_id")),
-                                  int(get_flag("ps_table_size")),
-                                  svc, peers, rank=rank, announce=False)
+    kind = str(flag_or("ps_table_kind", "array"))
+    check(kind in ("array", "matrix"),
+          f"-ps_table_kind={kind} (want array|matrix)")
+    if kind == "matrix":
+        # Sparse row-sharded seat: the ISSUE-16 drill extends the WAL
+        # parity witness to DistributedMatrixTable shards.
+        table = DistributedMatrixTable(int(get_flag("ps_table_id")),
+                                       int(get_flag("ps_table_size")),
+                                       int(flag_or("ps_table_cols", 8)),
+                                       svc, peers, rank=rank,
+                                       announce=False)
+    else:
+        table = DistributedArrayTable(int(get_flag("ps_table_id")),
+                                      int(get_flag("ps_table_size")),
+                                      svc, peers, rank=rank,
+                                      announce=False)
     ckpt_dir = str(get_flag("checkpoint_dir"))
     uri = _shard_uri(ckpt_dir, rank) if ckpt_dir else ""
     from multiverso_tpu.utils.stream import exists

@@ -37,8 +37,13 @@ class MsgType(enum.IntEnum):
     Control_Barrier = 33
     Control_Register = 34
     Control_Lookup = 35
+    # Elastic membership announce (MXNET-MPI, PAPERS.md 1801.03855): a
+    # worker joins/leaves a table's LIVE server-side clock group. Payload
+    # is the net.py JSON control codec.
+    Control_Elastic = 36
     Reply_Register = -34
     Reply_Lookup = -35
+    Reply_Elastic = -36
     # Serving plane (multiverso_tpu/serving): request-level inference reads
     # over the same framing. In the server range so to_server routing holds.
     Serve_Request = 21

@@ -45,13 +45,14 @@ import threading
 import time
 from typing import List, Tuple
 
-from multiverso_tpu.telemetry import gauge, histogram
-from multiverso_tpu.utils.log import check
+from multiverso_tpu.telemetry import counter, gauge, histogram
+from multiverso_tpu.utils.log import check, log
 
 
 class VectorClock:
     """Per-worker monotonic counters with infinity masking
-    (ref src/server.cpp:81-139)."""
+    (ref src/server.cpp:81-139). Growable: elastic membership
+    (MXNET-MPI, PAPERS.md 1801.03855) adds slots to a LIVE clock group."""
 
     INF = float("inf")
 
@@ -72,11 +73,38 @@ class VectorClock:
     def value(self, i: int) -> float:
         return self._clock[i]
 
+    def size(self) -> int:
+        return len(self._clock)
+
+    def set(self, i: int, value: float) -> None:
+        self._clock[i] = value
+
+    def add_slot(self, value: float = 0.0) -> int:
+        """Append one worker slot at ``value``; returns its index."""
+        self._clock.append(value)
+        return len(self._clock) - 1
+
 
 class SyncCoordinator:
-    """One per table in sync mode; gates worker threads per the BSP rule."""
+    """One per table in sync mode; gates worker threads per the BSP rule.
 
-    def __init__(self, num_workers: int, name: str = ""):
+    **Elastic membership** (MXNET-MPI, PAPERS.md 1801.03855): workers may
+    :meth:`join` and :meth:`leave` a LIVE clock group. A join takes effect
+    at the current epoch floor — the newcomer's clocks initialize to the
+    minimum of the active clocks, equivalent to having joined at the epoch
+    boundary the slowest worker is still in, so no existing gate predicate
+    regresses at the instant of join. A graceful leave retires the
+    worker's clocks to infinity (the ``finish_train`` algebra) and frees
+    the slot for reuse. **Quorum fallback** (``leave_timeout_s > 0``): a
+    worker that goes SILENT — SIGKILL-shaped, no leave, its ops just stop
+    — would wedge every peer's gate forever under plain BSP; with the
+    fallback armed, a gate stalled past the leave-timeout evicts workers
+    not seen within the window and the surviving quorum proceeds.
+    Workers blocked IN a gate beat their own liveness each wait slice, so
+    a healthy waiter is never named as left."""
+
+    def __init__(self, num_workers: int, name: str = "",
+                 leave_timeout_s: float = 0.0):
         check(num_workers >= 1, "need at least one worker")
         self.num_workers = num_workers
         self._adds = VectorClock(num_workers)
@@ -86,6 +114,14 @@ class SyncCoordinator:
         # src/server.cpp ProcessGet).
         self._inflight_adds = [0] * num_workers
         self._cv = threading.Condition()
+        # -- elastic membership state --------------------------------------
+        self._leave_timeout_s = max(0.0, float(leave_timeout_s))
+        self._active = set(range(num_workers))
+        self._free: List[int] = []          # retired slots reusable by joins
+        now = time.monotonic()
+        self._last_seen = [now] * num_workers
+        self.membership_version = 0
+        self.quorum_evictions = 0
         # Telemetry: gate wait time (the BSP barrier tax) + per-worker
         # vector-clock lag — how many rounds each worker trails the most
         # advanced worker, so the STRAGGLER reads positive (same polarity
@@ -100,6 +136,7 @@ class SyncCoordinator:
         # are fixed at init — not the cardinality hazard the
         # unbounded-metric-name lint exists for.
         prefix = f"sync.{name}." if name else "sync."
+        self._prefix = prefix
         # graftlint: disable=unbounded-metric-name
         self._h_add_wait = histogram(f"{prefix}gate_wait.add")
         # graftlint: disable=unbounded-metric-name
@@ -110,6 +147,15 @@ class SyncCoordinator:
         # graftlint: disable=unbounded-metric-name
         self._g_get_staleness = [gauge(f"{prefix}staleness.get.worker_{w}")
                                  for w in range(num_workers)]
+        # Elastic-membership telemetry: group size + reform count + the
+        # quorum-fallback evictions (each one is a masked fault).
+        # graftlint: disable=unbounded-metric-name
+        self._g_world = gauge(f"{prefix}world")
+        self._g_world.set(num_workers)
+        # graftlint: disable=unbounded-metric-name
+        self._c_evictions = counter(f"{prefix}quorum_evictions")
+        # graftlint: disable=unbounded-metric-name
+        self._g_version = gauge(f"{prefix}membership_version")
 
     def _sample_staleness_locked(self, clock: VectorClock,
                                  gauges: List) -> None:
@@ -122,6 +168,63 @@ class SyncCoordinator:
             if vals[w] != VectorClock.INF:
                 g.set(hi - vals[w])
 
+    # -- elastic wait plumbing ---------------------------------------------
+    def _gate_wait_locked(self, worker_id: int, predicate,
+                          timeout: float) -> bool:
+        """Wait (holding ``self._cv``) until ``predicate`` holds. With the
+        quorum fallback armed, the wait runs in bounded slices: each slice
+        beats this worker's own liveness (a BLOCKED worker is alive, not
+        left) and then evicts any member not seen inside the
+        leave-timeout — so a SIGKILL-shaped leave degrades the group to
+        the surviving quorum instead of wedging every peer forever."""
+        deadline = time.monotonic() + timeout
+        while not predicate():
+            now = time.monotonic()
+            remaining = deadline - now
+            if remaining <= 0:
+                return False
+            self._last_seen[worker_id] = now
+            slice_s = remaining
+            if self._leave_timeout_s > 0:
+                slice_s = min(slice_s, self._leave_timeout_s / 4.0, 1.0)
+            self._cv.wait(slice_s)
+            if self._leave_timeout_s > 0:
+                self._evict_stale_locked(worker_id)
+        self._last_seen[worker_id] = time.monotonic()
+        return True
+
+    def _evict_stale_locked(self, waiter: int) -> None:
+        """Quorum fallback: retire every ACTIVE worker whose last liveness
+        beat is older than the leave-timeout. Only ever called from inside
+        a stalled gate — a silent worker with no one blocked behind it
+        costs nothing and is left alone until it does."""
+        now = time.monotonic()
+        stale = [w for w in self._active
+                 if w != waiter
+                 and now - self._last_seen[w] > self._leave_timeout_s]
+        for w in stale:
+            log.warning("sync: worker %d silent for %.1fs with peers "
+                        "gated — degrading to surviving quorum "
+                        "(%d workers)", w,
+                        now - self._last_seen[w], len(self._active) - 1)
+            self._retire_locked(w, free_slot=True)
+            self.quorum_evictions += 1
+            self._c_evictions.inc()
+        if stale:
+            self._cv.notify_all()
+
+    def _retire_locked(self, worker_id: int, free_slot: bool) -> None:
+        self._adds.finish(worker_id)
+        self._gets.finish(worker_id)
+        self._inflight_adds[worker_id] = 0
+        if worker_id in self._active:
+            self._active.discard(worker_id)
+            if free_slot:
+                self._free.append(worker_id)
+            self.membership_version += 1
+            self._g_version.set(self.membership_version)
+            self._g_world.set(len(self._active))
+
     # -- gates -------------------------------------------------------------
     # Two-phase: acquire_* blocks until the op is in-clock; commit_* ticks
     # AFTER the op has been dispatched against the store. Ticking early would
@@ -132,7 +235,8 @@ class SyncCoordinator:
         t0 = time.perf_counter()
         try:
             with self._cv:
-                ok = self._cv.wait_for(
+                ok = self._gate_wait_locked(
+                    worker_id,
                     lambda: self._gets.min() >= self._gets.value(worker_id)
                     or self._adds.value(worker_id) == VectorClock.INF,
                     timeout)
@@ -146,6 +250,7 @@ class SyncCoordinator:
     def commit_add(self, worker_id: int) -> None:
         with self._cv:
             self._adds.tick(worker_id)
+            self._last_seen[worker_id] = time.monotonic()
             self._inflight_adds[worker_id] -= 1
             self._sample_staleness_locked(self._adds, self._g_add_staleness)
             self._cv.notify_all()
@@ -164,7 +269,8 @@ class SyncCoordinator:
         t0 = time.perf_counter()
         try:
             with self._cv:
-                ok = self._cv.wait_for(
+                ok = self._gate_wait_locked(
+                    worker_id,
                     lambda: (self._adds.min() >= self._adds.value(worker_id)
                              and not any(self._inflight_adds)) or
                     self._gets.value(worker_id) == VectorClock.INF,
@@ -176,6 +282,7 @@ class SyncCoordinator:
     def commit_get(self, worker_id: int) -> None:
         with self._cv:
             self._gets.tick(worker_id)
+            self._last_seen[worker_id] = time.monotonic()
             self._sample_staleness_locked(self._gets, self._g_get_staleness)
             self._cv.notify_all()
 
@@ -185,6 +292,90 @@ class SyncCoordinator:
             self._adds.finish(worker_id)
             self._gets.finish(worker_id)
             self._cv.notify_all()
+
+    # -- elastic membership -------------------------------------------------
+    def join(self, timeout: float = 60.0) -> int:
+        """Admit one worker into the LIVE clock group; returns its id.
+
+        The join drains to the epoch floor: it waits out any in-flight
+        (admitted-but-uncommitted) adds so the newcomer can never split a
+        half-applied round, then initializes the new slot's clocks to the
+        MINIMUM of the active clocks — the round the slowest survivor is
+        still in. Every gate predicate compares against that min, so
+        nothing regresses at the instant of join; the group has re-formed
+        at the new world size the moment this returns."""
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: not any(self._inflight_adds), timeout)
+            check(ok, "elastic join timed out draining in-flight adds")
+            add_floor = self._adds.min()
+            get_floor = self._gets.min()
+            if add_floor == VectorClock.INF:    # group fully retired:
+                add_floor, get_floor = 0.0, 0.0  # newcomer restarts it
+            else:
+                # Taking each vector's min INDEPENDENTLY can synthesize a
+                # mid-round hybrid no worker occupies (add clock from a
+                # worker already past its round's add, get clock from one
+                # still before its get). A joiner initialized there and
+                # entering at the top of a homogeneous loop issues one
+                # extra add and the gates deadlock: the joiner waits in
+                # its get gate for adds the peers can't commit because
+                # their add gates wait on the joiner's get (the elastic
+                # membership fuzz caught this). Join at the last round the
+                # slowest worker fully COMPLETED — both clocks at the
+                # common floor, a state every loop actually passes
+                # through — and the group stays live in either phase
+                # order (add-first or get-first).
+                add_floor = get_floor = min(add_floor, get_floor)
+            if self._free:
+                w = self._free.pop()
+                self._adds.set(w, add_floor)
+                self._gets.set(w, get_floor)
+                self._inflight_adds[w] = 0
+            else:
+                w = self._adds.add_slot(add_floor)
+                self._gets.add_slot(get_floor)
+                self._inflight_adds.append(0)
+                self._last_seen.append(0.0)
+                self.num_workers = self._adds.size()
+                # Bounded family shape (worker_<w>): the population is
+                # the slot count, which only grows when the PEAK world
+                # size does — rejoins reuse freed slots.
+                self._g_add_staleness.append(
+                    # graftlint: disable=unbounded-metric-name
+                    gauge(f"{self._prefix}staleness.add.worker_{w}"))
+                self._g_get_staleness.append(
+                    # graftlint: disable=unbounded-metric-name
+                    gauge(f"{self._prefix}staleness.get.worker_{w}"))
+            self._last_seen[w] = time.monotonic()
+            self._active.add(w)
+            self.membership_version += 1
+            self._g_version.set(self.membership_version)
+            self._g_world.set(len(self._active))
+            self._cv.notify_all()
+            return w
+
+    def leave(self, worker_id: int) -> None:
+        """Graceful leave: retire the worker's clocks (the finish_train
+        algebra — peers' gates stop waiting on it immediately) and free
+        its slot for a later :meth:`join` to reuse."""
+        with self._cv:
+            self._retire_locked(worker_id, free_slot=True)
+            self._cv.notify_all()
+
+    def active_workers(self) -> List[int]:
+        with self._cv:
+            return sorted(self._active)
+
+    def status(self) -> dict:
+        """Membership snapshot for drills and rollups."""
+        with self._cv:
+            return {"world": len(self._active),
+                    "slots": self._adds.size(),
+                    "active": sorted(self._active),
+                    "version": self.membership_version,
+                    "quorum_evictions": self.quorum_evictions,
+                    "leave_timeout_s": self._leave_timeout_s}
 
     def lag(self, worker_id: int) -> float:
         """This worker's measured add-clock lag behind the most advanced

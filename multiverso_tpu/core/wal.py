@@ -55,6 +55,19 @@ _SEGMENT_RE = re.compile(r"wal_(\d{6})\.log")
 #: gigabytes: no legitimate PS add message approaches this.
 MAX_RECORD_BYTES = 256 << 20
 
+#: Chaos slow-disk fault (fleet/chaos.py): extra seconds slept inside
+#: every group commit's fsync, process-wide. Models a disk whose write
+#: latency degraded (firmware GC pause, contended volume) — the commit
+#: still HAPPENS, just late, so ``-wal_sync_acks`` acks stretch and the
+#: group-commit window widens exactly as on real slow media. 0 = off.
+_fsync_delay_s = 0.0
+
+
+def set_fsync_delay(delay_s: float) -> None:
+    """Install (or with 0 clear) the injected per-commit fsync delay."""
+    global _fsync_delay_s
+    _fsync_delay_s = max(0.0, float(delay_s))
+
 
 def _frame(lsn: int, payload: bytes) -> bytes:
     crc = zlib.crc32(payload, zlib.crc32(struct.pack("<Q", lsn)))
@@ -216,6 +229,9 @@ class WriteAheadLog:
             if batch:       # past the seal are lost BY DESIGN (= crash)
                 f.write(b"".join(batch))
                 f.flush()
+                if _fsync_delay_s:
+                    import time as _time    # injected slow-disk fault
+                    _time.sleep(_fsync_delay_s)
                 # fdatasync, not fsync: a journal needs its DATA (and
                 # the size growth that makes it readable) durable; the
                 # mtime metadata fsync additionally journals costs 2-4x

@@ -20,15 +20,24 @@ taken to its fleet conclusion). Layers:
 * ``supervisor`` — ``ReplicaSupervisor``: the actuation half of the
   self-healing fleet — alert-driven replacement of dead members and
   spawn/drain autoscaling with hysteresis + cooldown
-  (docs/DURABILITY.md "Supervisor").
+  (docs/DURABILITY.md "Supervisor");
+* ``ps_fleet``   — ``PSShardFleet``: supervised multi-shard PS topology
+  — N durable WAL'd parameter-server seats of one table, each
+  respawned through the checkpoint+WAL-replay recovery path
+  (docs/DURABILITY.md "Fleet topology & fault matrix");
+* ``chaos``      — ``ChaosEngine``: seeded, composable fault injection
+  (kill/pause/net-drop/slow-fsync) driving the ``serve_bench
+  --chaos-drill`` convergence assertions.
 
 See docs/SERVING.md ("Fleet") for topology and tuning, and
 docs/OBSERVABILITY.md for the ``fleet.*`` metric catalog.
 """
 
+from multiverso_tpu.fleet.chaos import ChaosEngine, Fault
 from multiverso_tpu.fleet.client import (FleetClient, RoutingTable,
                                          fetch_fleet_stats, request_drain)
 from multiverso_tpu.fleet.hashring import HashRing
+from multiverso_tpu.fleet.ps_fleet import PSShardFleet
 from multiverso_tpu.fleet.health import (STAT_FIELDS, health_score,
                                          local_stats, metrics_payload)
 from multiverso_tpu.fleet.hedge import (AdaptiveDelay, HedgedCall,
@@ -41,9 +50,10 @@ from multiverso_tpu.fleet.supervisor import (LocalFleetView,
                                              ReplicaSupervisor)
 
 __all__ = [
-    "AdaptiveDelay", "FleetClient", "FleetMember", "FleetRouter",
-    "HashRing", "HedgeScheduler", "HedgedCall", "LocalFleetView",
-    "MemberInfo", "RemoteFleetView", "ReplicaGroup", "ReplicaSupervisor",
-    "RoutingTable", "STAT_FIELDS", "fetch_fleet_stats", "health_score",
-    "local_stats", "metrics_payload", "request_drain",
+    "AdaptiveDelay", "ChaosEngine", "Fault", "FleetClient", "FleetMember",
+    "FleetRouter", "HashRing", "HedgeScheduler", "HedgedCall",
+    "LocalFleetView", "MemberInfo", "PSShardFleet", "RemoteFleetView",
+    "ReplicaGroup", "ReplicaSupervisor", "RoutingTable", "STAT_FIELDS",
+    "fetch_fleet_stats", "health_score", "local_stats", "metrics_payload",
+    "request_drain",
 ]

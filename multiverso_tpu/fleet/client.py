@@ -43,7 +43,7 @@ from multiverso_tpu.fleet.hedge import (AdaptiveDelay, HedgeBudget,
 from multiverso_tpu.parallel.net import (pack_json_blob, recv_message,
                                          send_message, unpack_json_blob)
 from multiverso_tpu.serving.client import (ReplicaUnavailableError,
-                                           ServingClient,
+                                           ServingClient, backoff_delays,
                                            connect_with_backoff)
 from multiverso_tpu.telemetry import counter, emit_span, histogram
 from multiverso_tpu.telemetry import context as trace_context
@@ -92,6 +92,17 @@ class RoutingTable:
             return self._ranked
         skip = set(exclude)
         return [m for m in self._ranked if m not in skip]
+
+    def replica_pref(self, member_id: str, n_replicas: int = 2
+                     ) -> List[str]:
+        """Per-partition replica set as a failover preference: the
+        partition OWNER first, then its ring successors (the members that
+        inherit its arcs if it leaves — in split mode, the ones holding
+        this partition's replica copies), then everyone else by health."""
+        succ = self.ring.successors(member_id, max(0, n_replicas - 1)) \
+            if member_id in self.ring else []
+        rest = self.ranked(exclude=(member_id, *succ))
+        return [member_id] + succ + rest
 
     def addr(self, member_id: str) -> Tuple[str, int]:
         m = self.by_id[member_id]
@@ -219,13 +230,18 @@ class FleetClient:
     for in-process use. ``hedge`` is ``"adaptive"`` (p95-tracking delay),
     a fixed delay in ms, or ``"off"``. ``max_attempts`` bounds the
     distinct replicas one logical request may touch (primary + hedges +
-    failover)."""
+    failover). ``rpc_timeout_ms`` (``-rpc_timeout_ms``) arms a per-RPC
+    deadline: an attempt with no reply inside the budget is abandoned —
+    its member suspected, the request jitter-retried against the next
+    ring owner — instead of blocking on a half-dead (SIGSTOPped,
+    half-partitioned) shard until the caller's whole timeout burns."""
 
     def __init__(self, router, runner_id: int = 0,
                  refresh_s: float = 0.25,
                  hedge: Union[str, float] = "adaptive",
                  max_attempts: int = 3,
-                 scheduler: Optional[HedgeScheduler] = None):
+                 scheduler: Optional[HedgeScheduler] = None,
+                 rpc_timeout_ms: Optional[float] = None):
         from multiverso_tpu.fleet.membership import ReplicaGroup
         self._feed = _GroupFeed(router) if isinstance(router, ReplicaGroup) \
             else _RouterFeed(router)
@@ -236,6 +252,9 @@ class FleetClient:
             else float(hedge)
         self._delay = AdaptiveDelay()
         self._budget = HedgeBudget()
+        self._rpc_timeout_s = None if not rpc_timeout_ms \
+            else float(rpc_timeout_ms) / 1e3
+        self._c_deadline = counter("fleet.rpc_deadline_exceeded")
         self._sched = scheduler or default_scheduler()
         self._lock = threading.Lock()
         self._conns: Dict[str, ServingClient] = {}
@@ -356,6 +375,22 @@ class FleetClient:
                 self._suspect(member_id)
                 raise
 
+            # Exactly-once delivery per attempt: with the rpc deadline
+            # armed, a real reply racing the deadline's failover must not
+            # reach the hedge state machine twice.
+            once = [False]
+            timer: List = [None]
+
+            def deliver_once(result) -> bool:
+                with state["lock"]:
+                    if once[0]:
+                        return False
+                    once[0] = True
+                if timer[0] is not None:
+                    timer[0].cancel()
+                deliver(result)
+                return True
+
             def cb(res):
                 if ctx is not None and ctx.sampled:
                     with state["lock"]:
@@ -365,12 +400,12 @@ class FleetClient:
                               member=member_id, attempt=idx,
                               hedge=1 if hedged else 0)
                 try:
-                    deliver(res.wait(timeout=1.0))
+                    deliver_once(res.wait(timeout=1.0))
                 except ReplicaUnavailableError as e:
                     self._suspect(member_id)
-                    deliver(e)
+                    deliver_once(e)
                 except Exception as e:  # noqa: BLE001 - shed/decode errors
-                    deliver(e)          # belong to the hedge state machine
+                    deliver_once(e)     # belong to the hedge state machine
 
             try:
                 res = cli.request_async(payload, deadline_ms, runner_id,
@@ -380,6 +415,23 @@ class FleetClient:
             except ReplicaUnavailableError:
                 self._suspect(member_id)
                 raise
+            if self._rpc_timeout_s is not None:
+                # Per-RPC deadline, JITTERED through the standard backoff
+                # schedule (idx-th entry): every client re-routing off the
+                # same half-dead shard staggers onto the next ring owner
+                # instead of herding there at the same instant.
+                slack = backoff_delays(idx + 1)[-1]
+
+                def expire():
+                    if deliver_once(ReplicaUnavailableError(
+                            f"rpc deadline "
+                            f"({1e3 * self._rpc_timeout_s:.0f}ms) "
+                            f"exceeded on {member_id}")):
+                        self._c_deadline.inc()
+                        self._suspect(member_id)
+
+                timer[0] = self._sched.call_later(
+                    self._rpc_timeout_s + slack, expire)
         return attempt
 
     def _cancel_losers(self, winner: int, state: Dict,
@@ -526,7 +578,10 @@ class FleetClient:
                     else (state["out"], state["clock"]))
 
         for member_id, pos in parts.items():
-            pref = [member_id] + table.ranked(exclude=(member_id,))
+            # Per-partition replica set (carried from the PR-6 split-mode
+            # TODO): the sub-request fails over along the partition's OWN
+            # successor list before falling back to health order.
+            pref = table.replica_pref(member_id)
             sub_ctx = trace_context.child_of(lroot) \
                 if lroot is not None else None
             self.request_async(

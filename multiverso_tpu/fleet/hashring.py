@@ -130,3 +130,51 @@ class HashRing:
         owners = self.owner_indices(keys)
         return {self._members[int(i)]: np.flatnonzero(owners == i)
                 for i in np.unique(owners)}
+
+    # -- replica sets --------------------------------------------------------
+    def replica_set(self, key: int, n: int = 2) -> List[str]:
+        """The first ``n`` DISTINCT members clockwise of ``key``'s ring
+        position — the key's replica set, primary first. Generalizes
+        :meth:`owner` (``replica_set(k, 1) == [owner(k)]``); the classic
+        successor-list placement, so removing the primary hands the key
+        to exactly the next member of its own set."""
+        check(len(self._members) > 0, "hash ring has no members")
+        n = min(max(1, int(n)), len(self._members))
+        hashed = _splitmix64(np.asarray([key], dtype=np.int64))[0]
+        size = len(self._positions)
+        idx = int(np.searchsorted(self._positions, hashed,
+                                  side="right")) % size
+        out: List[str] = []
+        for step in range(size):
+            member = self._members[int(self._owners[(idx + step) % size])]
+            if member not in out:
+                out.append(member)
+                if len(out) == n:
+                    break
+        return out
+
+    def successors(self, member: str, n: int = 1) -> List[str]:
+        """The first ``n`` DISTINCT members clockwise of ``member``'s
+        vnodes, in arc order — the members that inherit its keys if it
+        leaves the ring (its per-partition replica set, minus itself).
+        Deterministic for a given membership, so routers and clients
+        derive IDENTICAL failover preferences independently."""
+        check(member in self._members, f"unknown ring member {member!r}")
+        if len(self._members) <= 1 or n <= 0:
+            return []
+        me = self._members.index(member)
+        size = len(self._positions)
+        out: List[str] = []
+        # _positions is sorted, so flatnonzero walks this member's vnodes
+        # in ring order; for each, take the next arc's distinct owner.
+        for i in np.flatnonzero(self._owners == me):
+            for step in range(1, size):
+                o = int(self._owners[(int(i) + step) % size])
+                if o != me:
+                    cand = self._members[o]
+                    if cand not in out:
+                        out.append(cand)
+                    break
+            if len(out) >= n:
+                break
+        return out[:n]

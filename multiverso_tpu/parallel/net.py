@@ -20,6 +20,22 @@ import numpy as np
 
 from multiverso_tpu.core.actor import Message
 
+#: Chaos fault hook (fleet/chaos.py): when set, consulted once per wire
+#: op with ``(direction, sock)`` where direction is "send" or "recv".
+#: The hook may sleep (link delay) or raise OSError (packet drop — the
+#: caller sees exactly what a torn TCP link produces, so every recovery
+#: path it exercises is the real one). Installed per-process, never on
+#: by default; a hook raising anything other than OSError is a bug in
+#: the drill, not the data plane, and is allowed to propagate.
+_fault_hook = None
+
+
+def set_fault_hook(hook) -> None:
+    """Install (or with ``None`` clear) the process-wide link-fault hook."""
+    global _fault_hook
+    _fault_hook = hook
+
+
 _HEADER = struct.Struct("<iiqii")   # type, table_id, msg_id, src, n_blobs
 _BLOB_HEADER = struct.Struct("<16sI")  # dtype string, ndim
 _MAGIC = struct.Struct("<I")
@@ -63,6 +79,11 @@ def pack_message(msg: Message) -> bytes:
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     buf = bytearray()
     while len(buf) < n:
+        # The read deadline is the CALLER's: clients create the socket
+        # with create_connection(timeout=...) (which persists as the
+        # socket timeout), and the server side reads through its
+        # selector loop, never this helper.
+        # graftlint: disable=blocking-call-no-timeout
         chunk = sock.recv(n - len(buf))
         if not chunk:
             return None
@@ -71,6 +92,8 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def send_message(sock: socket.socket, msg: Message) -> None:
+    if _fault_hook is not None:
+        _fault_hook("send", sock)
     sock.sendall(pack_message(msg))
 
 
@@ -212,6 +235,8 @@ def unpack_json_blob(blob: np.ndarray):
 
 def recv_message(sock: socket.socket) -> Optional[Message]:
     """Blocking read of one framed message; None on clean EOF."""
+    if _fault_hook is not None:
+        _fault_hook("recv", sock)
     magic = _recv_exact(sock, _MAGIC.size)
     if magic is None:
         return None

@@ -76,6 +76,10 @@ class _TableSyncGate:
         self._gets = VectorClock(num_workers)
         self.cached: Dict[int, "collections.deque"] = \
             collections.defaultdict(collections.deque)
+        # Elastic membership (Control_Elastic): retired slots reusable by
+        # later joins, and a version stamp drills can watch re-form on.
+        self._free: List[int] = []
+        self.version = 0
 
     def worker_of(self, msg: Message) -> int:
         if msg.type == MsgType.Request_Add and len(msg.data) > 1:
@@ -116,6 +120,66 @@ class _TableSyncGate:
         (ref src/server.cpp:190-213)."""
         self._adds.finish(worker % self._n)
         self._gets.finish(worker % self._n)
+
+    # -- elastic membership (mirrors SyncCoordinator.join/leave) ----------
+    def join(self, worker: "Optional[int]" = None) -> int:
+        """Admit one worker into the LIVE clock group at the epoch floor;
+        returns its slot id. All calls run on the single dispatcher
+        thread, so membership flips atomically between ops. With an
+        explicit ``worker`` the slot chosen by the membership LEADER
+        (server 0) is adopted verbatim — every server must agree on the
+        joiner's identity, so only the leader allocates ids."""
+        inf = float("inf")
+        add_floor, get_floor = self._adds.min(), self._gets.min()
+        if add_floor == inf:            # group fully retired: newcomer
+            add_floor = 0.0             # restarts the clocks from zero
+        if get_floor == inf:
+            get_floor = 0.0
+        # Join at the COMMON floor, not each vector's independent min:
+        # the independent mins can describe a mid-round hybrid state no
+        # worker occupies, and a joiner initialized there deadlocks the
+        # gates by issuing one op out of phase (see
+        # SyncCoordinator.join — the elastic fuzz caught this).
+        add_floor = get_floor = min(add_floor, get_floor)
+        if worker is None:
+            if self._free:
+                w = min(self._free)     # deterministic reuse order
+                self._free.remove(w)
+            else:
+                w = self._adds.add_slot()
+                self._gets.add_slot()
+        else:
+            w = int(worker)
+            while self._adds.size() <= w:   # pad to the leader's slot
+                s = self._adds.add_slot(inf)    # count with retired
+                self._gets.add_slot(inf)        # (joinable) slots
+                self._free.append(s)
+            if w in self._free:
+                self._free.remove(w)
+        self._adds.set(w, add_floor)
+        self._gets.set(w, get_floor)
+        self._n = self._adds.size()
+        self.version += 1
+        return w
+
+    def leave(self, worker: int) -> None:
+        """Retire a worker's clocks (the finish_train algebra) and free
+        its slot for a later :meth:`join`. The leaver's still-gated cached
+        ops are DROPPED: once its clocks are infinite they can never
+        drain, and a graceful leaver has already waited out its ops (a
+        SIGKILL-shaped one has no waiter left to answer)."""
+        w = worker % self._n
+        self._adds.finish(w)
+        self._gets.finish(w)
+        self.cached.pop(w, None)
+        if w not in self._free:
+            self._free.append(w)
+        self.version += 1
+
+    def status(self) -> Dict[str, object]:
+        """Membership snapshot for drills/rollups (slots incl. retired)."""
+        return {"slots": self._n, "free": sorted(self._free),
+                "version": self.version}
 
 
 # Dispatch-queue sentinel: re-examine deferred (early-arrival) requests.
@@ -801,7 +865,8 @@ class PSService:
     def _dispatch_one(self, sock: socket.socket, msg: Message) -> None:
         unregistered = msg.table_id not in self._tables and (
             msg.type in (MsgType.Request_Add, MsgType.Request_Get)
-            or (msg.type == MsgType.Server_Finish_Train
+            or (msg.type in (MsgType.Server_Finish_Train,
+                             MsgType.Control_Elastic)
                 and msg.table_id >= 0))
         if unregistered or sock in self._deferred_socks:
             # Peers may send traffic before this process registers the
@@ -838,7 +903,8 @@ class PSService:
             q.append((sock, msg))
             return
         self._serve(sock, msg, gate)
-        if gate is not None or msg.type == MsgType.Server_Finish_Train:
+        if gate is not None or msg.type in (MsgType.Server_Finish_Train,
+                                            MsgType.Control_Elastic):
             self._drain_sync_caches()
 
     def _replay_deferred(self) -> None:
@@ -857,7 +923,8 @@ class PSService:
                 continue
             is_table_op = (
                 msg.type in (MsgType.Request_Add, MsgType.Request_Get)
-                or (msg.type == MsgType.Server_Finish_Train
+                or (msg.type in (MsgType.Server_Finish_Train,
+                                 MsgType.Control_Elastic)
                     and msg.table_id >= 0))
             if not is_table_op or msg.table_id in self._tables:
                 # Table op whose shard arrived, or a control message that
@@ -1187,6 +1254,8 @@ class PSService:
             for gate in gates:
                 gate.finish(w)
             return msg.create_reply()
+        if msg.type == MsgType.Control_Elastic:
+            return self._serve_elastic(msg)
         if msg.type == MsgType.Control_Lookup:
             rank = int(msg.data[0][0])
             addr = self.lookup(rank)
@@ -1200,6 +1269,43 @@ class PSService:
                                             dtype=np.uint8)]
             return reply
         return self._dispatch(msg)
+
+    def _serve_elastic(self, msg: Message) -> Message:
+        """Elastic membership announce (MXNET-MPI, PAPERS.md 1801.03855):
+        a worker process joins/leaves this table's server-side BSP clock
+        group at runtime. Runs on the dispatcher thread — the only thread
+        that touches gates — so membership flips atomically between ops;
+        the caller drains unlocked cached ops right after (a leave retires
+        clocks to infinity, which may release every gated laggard)."""
+        from multiverso_tpu.parallel.net import (pack_json_blob,
+                                                 unpack_json_blob)
+        reply = msg.create_reply()
+        try:
+            req = unpack_json_blob(msg.data[0]) if msg.data else {}
+        except IOError:
+            req = {}
+        gate = self._sync.get(msg.table_id)
+        op = req.get("op")
+        if gate is None:
+            # Async table: no clock group to re-form. Loud, not silent —
+            # a join that "succeeds" against the wrong mode would strand
+            # the worker waiting on gates that don't exist.
+            out: Dict[str, object] = {
+                "error": f"table {msg.table_id} has no sync gate"}
+        elif op == "join":
+            worker = req.get("worker")
+            out = {"worker": gate.join(None if worker is None
+                                       else int(worker))}
+            out.update(gate.status())
+        elif op == "leave" and req.get("worker") is not None:
+            gate.leave(int(req["worker"]))
+            out = dict(gate.status())
+        elif op == "status":
+            out = dict(gate.status())
+        else:
+            out = {"error": f"bad elastic request {req!r}"}
+        reply.data = [pack_json_blob(out)]
+        return reply
 
     def close(self) -> None:
         self._running = False
@@ -1514,6 +1620,10 @@ class DistributedTableBase:
         # Async-mode ops keep the fail-loud deadline.
         self._op_timeout: Optional[float] = None if self._bsp else 60.0
         self._n_local = max(1, zoo.num_local_workers)
+        # Elastic slots: local worker index -> server-ALLOCATED global id
+        # (``elastic_join``). Empty for the fixed roster a process was
+        # launched with — _gid's arithmetic mapping stays authoritative.
+        self._gid_override: Dict[int, int] = {}
         self._clients: Dict[int, PeerClient] = {}
         self._peers = peers
         # Join the REPLICATED membership directory (the Controller analog,
@@ -1550,7 +1660,13 @@ class DistributedTableBase:
 
     def _gid(self, worker_id: int) -> int:
         """Global BSP worker id: contiguous per process (rank * local + k;
-        accepts either a local index or this process's global id)."""
+        accepts either a local index or this process's global id). A slot
+        allocated at runtime by ``elastic_join`` overrides the arithmetic
+        mapping for its local index."""
+        if self._gid_override:
+            g = self._gid_override.get(worker_id % self._n_local)
+            if g is not None:
+                return g
         return self.rank * self._n_local + (worker_id % self._n_local)
 
     def _sync_workers(self) -> int:
@@ -1623,13 +1739,28 @@ class DistributedTableBase:
                        ) -> Tuple[threading.Event, List]:
         """Drop the dead connection, rediscover the peer's address, resend.
         Polls the directory for up to RETRY_WINDOW so a peer mid-restart is
-        picked up as soon as it re-registers."""
+        picked up as soon as it re-registers. The poll cadence is the
+        standard JITTERED backoff schedule (was a fixed 0.3s): when a
+        supervisor kills a shard, every client of it lands here in the
+        same instant — identical sleeps would hammer the replacement in
+        synchronized waves the moment it announces."""
+        from multiverso_tpu.serving.client import backoff_delays
         deadline = time.monotonic() + self.RETRY_WINDOW
-        dead_addr = tuple(self._peers[server])
+        delays = iter(backoff_delays(64, base_delay_s=0.1, cap_s=0.5))
         while True:
             old = self._clients.pop(server, None)
             if old is not None:
                 old.close()
+            # ``avoid`` is the address that JUST failed — recomputed
+            # every sweep, not pinned to the first failure. Pinning let
+            # one replica's stale entry (a sibling's bring-up
+            # placeholder) outrank everyone's correct answer on every
+            # sweep: after a single transient send fault against a
+            # HEALTHY peer, the loop parked on the stale (refused) port
+            # for the whole window. Chaos drill's net_drop fault found
+            # this; with the per-sweep avoid, the next sweep's lookup
+            # returns the good address and the request goes through.
+            dead_addr = tuple(self._peers[server])
             addr = self._lookup_peer(server, avoid=dead_addr)
             if addr is not None:
                 self._peers[server] = addr
@@ -1638,7 +1769,7 @@ class DistributedTableBase:
             except OSError:
                 if time.monotonic() > deadline:
                     raise
-                time.sleep(0.3)
+                time.sleep(next(delays, 0.5))
 
     def _request_or_retry(self, server: int, msg: Message
                           ) -> Tuple[threading.Event, List]:
@@ -1782,6 +1913,65 @@ class DistributedTableBase:
                 continue    # dead server can't be holding anyone's gate
         _PendingOp(parts, retrier=self._retry_request).wait(
             self._op_timeout)
+
+    # -- elastic membership ------------------------------------------------
+    def elastic_join(self, worker_id: int = 0,
+                     timeout: Optional[float] = None) -> int:
+        """Announce a NEW sync worker to every server's clock group
+        (MXNET-MPI elastic membership, PAPERS.md 1801.03855); returns the
+        allocated global worker id and binds it to local index
+        ``worker_id`` so this table's subsequent ops stamp it. Server 0 is
+        the membership LEADER: it allocates the slot, the remaining
+        servers adopt that id verbatim — two workers joining concurrently
+        can therefore never be assigned the same slot. The join lands at
+        the current epoch floor (no gate predicate regresses), and
+        because announce + ops share one FIFO connection per server, no
+        op stamped with the new slot can outrun the join that creates it.
+        No-op (returns the arithmetic gid) in async mode."""
+        if not self._bsp:
+            return self._gid(worker_id)
+        wid: Optional[int] = None
+        for s in range(self.world):
+            payload: Dict[str, object] = {"op": "join"}
+            if wid is not None:
+                payload["worker"] = wid
+            out = self._elastic_rpc(s, payload, timeout)
+            check("error" not in out, f"elastic join rejected by server "
+                  f"{s}: {out}")
+            wid = int(out["worker"])
+        self._gid_override[worker_id % self._n_local] = wid
+        return wid
+
+    def elastic_leave(self, worker_id: int = 0,
+                      timeout: Optional[float] = None) -> None:
+        """Graceful leave: retire this worker from every server's clocks
+        (peers' gates stop waiting on it immediately) and free its slot
+        for a later :meth:`elastic_join` to reuse. Callers drain their own
+        in-flight ops first (:meth:`flush`); anything still gated
+        server-side is dropped with the slot. No-op in async mode."""
+        if not self._bsp:
+            return
+        gid = self._gid(worker_id)
+        for s in range(self.world):
+            try:
+                self._elastic_rpc(s, {"op": "leave", "worker": gid},
+                                  timeout)
+            except OSError:
+                continue    # dead server can't be holding anyone's gate
+        self._gid_override.pop(worker_id % self._n_local, None)
+
+    def _elastic_rpc(self, server: int, payload: Dict[str, object],
+                     timeout: Optional[float] = None) -> Dict[str, object]:
+        from multiverso_tpu.parallel.net import (pack_json_blob,
+                                                 unpack_json_blob)
+        msg = Message(src=self.rank, type=MsgType.Control_Elastic,
+                      table_id=self.table_id, msg_id=self._next_msg_id(),
+                      data=[pack_json_blob(payload)])
+        op = _PendingOp(
+            [(server, msg, self._request_or_retry(server, msg))],
+            assemble=lambda replies: unpack_json_blob(replies[0].data[0]),
+            retrier=self._retry_request)
+        return op.wait(self._op_timeout if timeout is None else timeout)
 
     # -- checkpointing -----------------------------------------------------
     @property

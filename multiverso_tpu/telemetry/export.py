@@ -58,7 +58,13 @@ def _atomic_write_json(path: str, payload: Dict) -> None:
     # (exporter loop vs stop) never interleave into one tmp file.
     tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
     with open(tmp, "w") as f:
-        json.dump(payload, f)
+        # dumps-then-write, NOT json.dump(f): dump() always takes the
+        # pure-Python chunked iterencode path (_one_shot=False), which
+        # for a full span ring is ~half a million generator frames —
+        # each one a GIL yield point, so concurrent span emitters
+        # convoy a single snapshot write into tens of seconds. The
+        # one-shot C encoder serializes the same payload in one call.
+        f.write(json.dumps(payload))
     os.replace(tmp, path)
 
 

@@ -294,11 +294,13 @@ define_int("serve_prefix_cache", 0, "prefix-cache entries (0 = off; "
            "prefill output and prompt KV pages (copy-on-extend), "
            "probed at step-boundary admission")
 # Fleet layer (multiverso_tpu/fleet; docs/SERVING.md "Fleet").
-define_string("fleet_role", "local", "local|router|replica|drain: local "
-              "spawns a router + -fleet_replicas replica processes; "
-              "router/replica run one role (production: one per host); "
-              "drain triggers a rolling checkpoint drain on a running "
-              "fleet (-fleet_router; -fleet_member_id to drain one)")
+define_string("fleet_role", "local", "local|router|replica|drain|"
+              "ps_fleet: local spawns a router + -fleet_replicas replica "
+              "processes; router/replica run one role (production: one "
+              "per host); drain triggers a rolling checkpoint drain on a "
+              "running fleet (-fleet_router; -fleet_member_id to drain "
+              "one); ps_fleet supervises -ps_fleet_shards durable WAL'd "
+              "PS shards (docs/DURABILITY.md 'Fleet topology')")
 define_string("fleet_router", "", "host:port of the fleet router's "
               "control listener (replica role + fleet clients)")
 define_int("fleet_port", 0, "router control/proxy listener port "
@@ -339,6 +341,10 @@ define_double("wal_flush_ms", 25.0, "group-commit interval: staged records "
 define_bool("wal_sync_acks", False, "fsync each add's record BEFORE its "
             "reply: no acked-write-loss window, at per-record fsync cost "
             "on the dispatch thread (the recovery drill's mode)")
+define_double("wal_fsync_delay_ms", 0.0, "CHAOS: inject this many ms of "
+              "sleep before every WAL commit fsync (a slow/contended "
+              "disk fault; 0 = off — the chaos drill arms it on a "
+              "seeded subset of shard seats)")
 # Fleet supervisor: the ACTUATION half of the self-healing fleet
 # (fleet/supervisor.py; docs/DURABILITY.md "Supervisor").
 define_bool("fleet_supervise", False, "local fleet role: watch spawned "
@@ -354,6 +360,26 @@ define_double("fleet_supervisor_cooldown_s", 10.0, "minimum seconds "
 define_double("fleet_scale_quiet_s", 30.0, "how long every scale alert "
               "must stay resolved before the supervisor drains a "
               "scale-up replica back down")
+# Recoverable fleet: multi-shard PS topology + per-RPC deadlines
+# (fleet/ps_fleet.py, fleet/client.py; docs/DURABILITY.md).
+define_double("rpc_timeout_ms", 0.0, "per-attempt RPC deadline on fleet "
+              "client calls (0 = off): an attempt that outlives "
+              "deadline + jittered slack is abandoned, the member is "
+              "briefly suspected, and the request retries against the "
+              "next ring owner — half-dead shards become failovers, "
+              "not hangs")
+define_int("ps_fleet_shards", 4, "ps_fleet role: durable WAL'd PS shard "
+           "processes to spawn and supervise (each through the "
+           "checkpoint+WAL-replay recovery path)")
+define_string("ps_fleet_dir", "", "ps_fleet role: working directory for "
+              "per-shard WAL/checkpoint/addr state (empty = a fresh "
+              "temp directory; survives and feeds recovery when set)")
+define_string("ps_table_kind", "array", "array|matrix: table kind a PS "
+              "shard seat serves — matrix serves a sparse "
+              "DistributedMatrixTable of -ps_table_size rows x "
+              "-ps_table_cols cols")
+define_int("ps_table_cols", 8, "matrix seats: columns per row "
+           "(-ps_table_kind=matrix)")
 # Per-table communication policy (parallel/comm_policy.py;
 # docs/DESIGN.md "CommPolicy").
 define_string("comm_policy", "", "per-table communication policy: '' = "

@@ -60,6 +60,7 @@ import subprocess
 import sys
 import tempfile
 import threading
+import traceback
 import time
 
 import numpy as np
@@ -1230,7 +1231,8 @@ def _trace_smoke_requests(args, fleet, router_addr) -> None:
     for _ in range(3):
         fleet.lookup(keys, deadline_ms=10_000, split=True, timeout=60)
     hedger = FleetClient(router_addr, hedge=0.0,
-                         refresh_s=args.heartbeat_ms / 1e3)
+                         refresh_s=args.heartbeat_ms / 1e3,
+                         rpc_timeout_ms=args.rpc_timeout_ms or None)
     try:
         for _ in range(4):
             hedger.lookup(keys, deadline_ms=10_000, timeout=60)
@@ -1626,7 +1628,8 @@ def _replica_recovery_drill(args, router_addr, procs, tdir) -> dict:
     hedge = args.hedge if args.hedge in ("adaptive", "off") \
         else float(args.hedge)
     fleet = FleetClient(router_addr, hedge=hedge,
-                        refresh_s=args.heartbeat_ms / 1e3)
+                        refresh_s=args.heartbeat_ms / 1e3,
+                        rpc_timeout_ms=args.rpc_timeout_ms or None)
     dstats = _LoadStats()
     drill_state: dict = {}
     duration = max(args.duration, 6.0)
@@ -1713,6 +1716,260 @@ def _replica_recovery_drill(args, router_addr, procs, tdir) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Chaos drill (ISSUE 16): kill-any-subset over the recoverable fleet
+# ---------------------------------------------------------------------------
+def _slot_signal(sup, slot: int, signum) -> None:
+    """Deliver a signal to the CURRENT occupant of a supervised slot —
+    after a respawn the original Popen is a corpse; later chaos rounds
+    must hit the replacement."""
+    handle = sup.slots().get(slot)
+    if handle is None:
+        raise ProcessLookupError(f"slot {slot} not supervised")
+    getattr(handle, "proc", handle).send_signal(signum)
+
+
+def _elastic_round(seed: int) -> dict:
+    """Elastic worker leave+rejoin witness: a worker joins the LIVE
+    clock group (drained to the epoch floor), leaves, and a later join
+    REUSES its slot — the group re-forms at each step with the
+    membership version advancing (core/sync_coordinator.py; the
+    cross-process Control_Elastic path is covered by
+    tests/test_elastic_fuzz.py)."""
+    from multiverso_tpu.core.sync_coordinator import SyncCoordinator
+
+    sc = SyncCoordinator(2, name=f"chaos{seed}", leave_timeout_s=5.0)
+    for w in (0, 1):            # mid-epoch: the join must drain to floor
+        sc.acquire_add(w)
+        sc.commit_add(w)
+    base = sc.status()
+    w = sc.join()
+    joined = sc.status()
+    sc.leave(w)
+    left = sc.status()
+    w2 = sc.join()
+    rejoined = sc.status()
+    return {
+        "joined_slot": w, "rejoined_slot": w2,
+        "slot_reused": w2 == w,
+        "world": [base["world"], joined["world"], left["world"],
+                  rejoined["world"]],
+        "versions": [base["version"], joined["version"],
+                     left["version"], rejoined["version"]],
+        "reformed": (joined["world"] == 3 and left["world"] == 2
+                     and rejoined["world"] == 3 and w2 == w
+                     and rejoined["version"] == base["version"] + 3),
+        "quorum_evictions": rejoined["quorum_evictions"],
+    }
+
+
+def _chaos_drill(args, router_addr, procs, tdir, fleet) -> dict:
+    """Seeded kill-any-subset drill over BOTH planes (ISSUE 16): a
+    supervised multi-shard PS fleet takes a live training stream while
+    the serving fleet takes lookup load; each round the ChaosEngine
+    SIGKILLs/SIGSTOPs a random subset of PS shards (+ possibly SIGKILLs
+    a serving replica) under an optional lossy client link, and the
+    drill asserts the fleet converges back to FULL membership with the
+    acked add stream intact EXACTLY (zero acked-write loss — every
+    killed shard recovered checkpoint+WAL bitwise) and serving errors
+    confined to the documented recovery+hedge windows. A seeded subset
+    of shard seats runs with an injected WAL fsync delay the whole time
+    (the slow-disk fault). Replaces respawned serving handles in
+    ``procs``."""
+    from multiverso_tpu.fleet import (ChaosEngine, PSShardFleet,
+                                      RemoteFleetView, ReplicaSupervisor,
+                                      fetch_fleet_stats)
+
+    _ensure_mv_runtime()
+    seed = args.chaos_seed
+    shards = 2 if args.dry_run else 4
+    rounds = args.chaos_rounds or (2 if args.dry_run else 3)
+    size = 128
+    srng = np.random.default_rng(seed)
+    slow = sorted(int(r) for r in srng.choice(
+        np.arange(1, shards + 1), size=max(1, shards // 2),
+        replace=False))
+    psf = PSShardFleet(
+        shards=shards, table_id=916, table_size=size, sync_acks=True,
+        checkpoint_every_s=1.0, join_grace_s=120.0,
+        extra_seat_args={r: ["-wal_fsync_delay_ms=10"] for r in slow})
+    psf.start()
+
+    # Serving plane healer: same shape as the recovery drill — remote
+    # view so heartbeat loss (not pid liveness) drives replacement.
+    serving_live = {i: p for i, p in enumerate(procs)
+                    if p.poll() is None}
+
+    class _RemoteHandle:
+        def __init__(self, proc):
+            self.proc = proc
+
+        def poll(self):
+            return None
+
+        def terminate(self):
+            self.proc.terminate()
+
+    sup = ReplicaSupervisor(
+        RemoteFleetView(router_addr),
+        lambda slot: _spawn_replica(args, router_addr, slot, tdir),
+        min_replicas=len(serving_live), max_replicas=len(serving_live),
+        cooldown_s=1.0, poll_s=0.2, join_grace_s=120.0)
+    for i, p in serving_live.items():
+        sup.adopt(i, _RemoteHandle(p))
+    sup.start()
+
+    engine = ChaosEngine(seed=seed, kinds=("kill", "pause", "net_drop"),
+                         max_pause_s=1.5, max_drop_rate=0.25)
+    for r in range(1, psf.shards + 1):
+        engine.register_kill(
+            f"ps-{r}", lambda sig, r=r: psf.kill(r, sig))
+    for i in serving_live:
+        engine.register_kill(
+            f"replica-{i}", lambda sig, i=i: _slot_signal(sup, i, sig),
+            kinds=("kill",))
+
+    # Live training plane: a paced add stream whose every ack is
+    # durable (-wal_sync_acks on every seat); `acked` is ground truth
+    # for the per-round parity gate. The mutex makes quiesce exact: the
+    # parity reader takes it, so no add is half-accounted.
+    acked = np.zeros(size, np.float32)
+    trng = np.random.default_rng(seed + 1)
+    train_stop = threading.Event()
+    train_gate = threading.Event()
+    train_gate.set()
+    train_mutex = threading.Lock()
+    train_errors: list = []
+    n_adds = [0]
+
+    def train():
+        while not train_stop.is_set():
+            train_gate.wait(timeout=1.0)
+            if train_stop.is_set() or not train_gate.is_set():
+                continue
+            d = trng.integers(1, 4, size).astype(np.float32)
+            with train_mutex:
+                try:
+                    psf.table.add(d)        # synchronous: ack == applied
+                except Exception:  # noqa: BLE001 - any failed add
+                    # makes parity unprovable; recorded and asserted 0
+                    train_errors.append(traceback.format_exc(limit=12))
+                    continue
+                acked[:] += d
+                n_adds[0] += 1
+            time.sleep(0.01)
+
+    trainer = threading.Thread(target=train, daemon=True)
+    trainer.start()
+
+    hedge_window_s = (args.liveness_misses * args.heartbeat_ms) / 1e3
+    round_records = []
+    try:
+        for rnd in range(rounds):
+            faults = engine.plan_round(
+                window_s=min(2.0, max(0.5, args.duration / 4)))
+            serving_kill = any(f.kind == "kill" and
+                               (f.target or "").startswith("replica-")
+                               for f in faults)
+            sstats = _LoadStats()
+            load_s = max(6.0, args.duration)
+            loader = threading.Thread(
+                target=_run_fleet_load,
+                args=(fleet, sstats, args.threads, args.qps, load_s,
+                      args.rows, args.keys_per_req, args.deadline_ms),
+                daemon=True)
+            alert_state: dict = {}
+
+            def poll_alert():
+                alert_state["heartbeat_loss"] = \
+                    _await_heartbeat_loss(router_addr, timeout_s=30)
+
+            poller = None
+            if serving_kill:
+                poller = threading.Thread(target=poll_alert, daemon=True)
+                poller.start()
+            loader.start()
+            t0 = time.monotonic()
+            applied = engine.run_round(faults)
+            ps_ok = psf.wait_converged(timeout_s=180)
+            t_ps = time.monotonic()
+            serve_ok, t_serve = True, time.monotonic()
+            if serving_kill:
+                serve_ok = False
+                deadline = time.monotonic() + 180
+                while time.monotonic() < deadline:
+                    try:
+                        st = fetch_fleet_stats(router_addr)
+                        if all(f"replica-{i}" in st.get("replicas", {})
+                               for i in serving_live):
+                            serve_ok, t_serve = True, time.monotonic()
+                            break
+                    except Exception:  # noqa: BLE001 - router busy or
+                        pass           # link fault still reverting
+                    time.sleep(0.1)
+            loader.join()
+            if poller is not None:
+                poller.join(timeout=35)
+            # Quiesce the training stream and take the parity gate:
+            # acked MUST equal the recovered world exactly, every round.
+            train_gate.clear()
+            with train_mutex:
+                got = np.asarray(psf.table.get())
+                parity = bool(np.array_equal(got, acked))
+            train_gate.set()
+            t_conv = max(t_ps, t_serve)
+            with sstats.lock:
+                errs_outside = sum(
+                    1 for t in sstats.error_times
+                    if not (t0 <= t <= t_conv + hedge_window_s))
+                window = {"n_ok": len(sstats.latencies),
+                          "n_shed": sstats.sheds,
+                          "n_error": sstats.errors}
+            round_records.append({
+                "faults": applied,
+                "converged": bool(ps_ok and serve_ok),
+                "ps_converge_s": round(t_ps - t0, 3),
+                "serving_converge_s":
+                    round(t_serve - t0, 3) if serving_kill else None,
+                "parity_ok": parity,
+                "acked_adds": n_adds[0],
+                "serving_errors_outside_window": errs_outside,
+                "serving_window": window,
+                "heartbeat_loss_alert":
+                    alert_state.get("heartbeat_loss")
+                    if serving_kill else None,
+            })
+    finally:
+        train_stop.set()
+        train_gate.set()
+        trainer.join(timeout=60)
+        ps_status = psf.status()
+        psf.close()
+        sup.stop()
+        for i, h in sup.slots().items():
+            if i < len(procs):
+                procs[i] = getattr(h, "proc", h)
+
+    elastic = _elastic_round(seed)
+    return {
+        "seed": seed,
+        "shards": shards,
+        "serving_replicas": len(serving_live),
+        "rounds": round_records,
+        "slow_disk_seats": slow,
+        "converged_all_rounds": all(r["converged"]
+                                    for r in round_records),
+        "zero_acked_loss": (all(r["parity_ok"] for r in round_records)
+                            and not train_errors),
+        "acked_adds": n_adds[0],
+        "train_errors": train_errors[:10],
+        "ps_supervisor": ps_status.get("supervisor"),
+        "ps_events": ps_status.get("events", []),
+        "serving_respawns": sup.status()["respawns"],
+        "elastic": elastic,
+    }
+
+
 def run_fleet(args) -> dict:
     from multiverso_tpu.fleet import FleetClient, fetch_fleet_stats
     from multiverso_tpu.telemetry import TraceBuffer, get_trace_buffer
@@ -1738,7 +1995,8 @@ def run_fleet(args) -> dict:
         hedge = args.hedge if args.hedge in ("adaptive", "off") \
             else float(args.hedge)
         fleet = FleetClient(router_addr, hedge=hedge,
-                            refresh_s=args.heartbeat_ms / 1e3)
+                            refresh_s=args.heartbeat_ms / 1e3,
+                            rpc_timeout_ms=args.rpc_timeout_ms or None)
         deadline = time.monotonic() + 240
         while len(fleet.refresh().members) < args.replicas:
             if any(p.poll() is not None for p in procs) \
@@ -1984,10 +2242,21 @@ def run_fleet(args) -> dict:
                     "n_error": dstats.errors,
                 }
 
+        # Chaos drill (ISSUE 16): seeded kill-any-subset over a
+        # supervised multi-shard PS fleet under live training, with the
+        # serving fleet taking lookup load (and possibly losing a
+        # replica) at the same time. Runs after the scripted drills so
+        # its random subset never fights their deterministic victims.
+        chaos = None
+        if args.chaos_drill:
+            chaos = _chaos_drill(args, router_addr, procs, tdir, fleet)
+
         record = _make_record("serve_fleet_lookup", args, stats, elapsed,
                               _metric_families(("serve.", "fleet.")))
         if recovery is not None:
             record["recovery"] = recovery
+        if chaos is not None:
+            record["chaos"] = chaos
         record["parity_ok"] = bool(parity_ok)
         record["replicas"] = args.replicas
         record["cpu_cores"] = os.cpu_count()
@@ -2108,7 +2377,12 @@ def _make_record(benchmark: str, args, stats: _LoadStats,
         # hot-path cost, acceptance <= 2%), and fleet-mode replica leg
         # (SIGKILL under load -> heartbeat-loss -> automatic
         # replacement joins the ring; errors after the hedging window).
-        "schema": "multiverso_tpu.bench_serve/v8",
+        # v9: + chaos block (--chaos-drill): seeded kill-any-subset
+        # rounds over a supervised multi-shard PS fleet (per-round
+        # faults/convergence/parity, zero_acked_loss, slow-disk seats)
+        # plus the elastic worker leave+rejoin round; config grows
+        # chaos_seed/chaos_rounds/rpc_timeout_ms.
+        "schema": "multiverso_tpu.bench_serve/v9",
         "benchmark": benchmark,
         "time_unix": time.time(),
         "box": {"cores": os.cpu_count(),
@@ -2217,6 +2491,23 @@ def main() -> int:
                    help="give replica-0 an unreachable SLO so its "
                    "burn-rate alert provably fires under load and ships "
                    "via heartbeat into Fleet_Stats/fleet_top")
+    p.add_argument("--chaos-drill", action="store_true",
+                   help="chaos drill (ISSUE 16): seeded kill-any-subset "
+                   "over a supervised multi-shard PS fleet under live "
+                   "training + serving load (fleet/chaos.py); each round "
+                   "asserts convergence to full membership, zero "
+                   "acked-write loss (WAL parity exact), and serving "
+                   "errors confined to the recovery+hedge window; ends "
+                   "with an elastic worker leave+rejoin round")
+    p.add_argument("--chaos-seed", type=int, default=16,
+                   help="chaos schedule seed: the same seed replays the "
+                   "same faults (targets, kinds, offsets)")
+    p.add_argument("--chaos-rounds", type=int, default=0,
+                   help="chaos rounds; 0 = auto (2 dry-run, 3 full)")
+    p.add_argument("--rpc-timeout-ms", type=float, default=0.0,
+                   help="per-RPC deadline for bench FleetClients; an "
+                   "attempt outliving it is abandoned and retried "
+                   "against the next ring owner (0 = off)")
     p.add_argument("--obs-ab", action="store_true",
                    help="run the observability overhead A/B leg "
                    "(alerts+watchdog on vs off) in single mode")
@@ -2246,7 +2537,13 @@ def main() -> int:
         # overlap (inflight >= 2) and a recorded cache hit.
         if args.cache_rows <= 0:
             args.cache_rows = 1024
-        if args.replicas:
+        if args.replicas and args.chaos_drill:
+            # An explicit --chaos-drill dry-run exercises ONLY the
+            # chaos leg (the tier-1 smoke's shape): the scripted drills
+            # would fight the random subset for victims and blow the
+            # smoke's time budget.
+            pass
+        elif args.replicas:
             args.drain_drill = True
             # ...and the observability plane (ISSUE 13): the fault
             # drill's heartbeat-loss alert + postmortem witnesses and

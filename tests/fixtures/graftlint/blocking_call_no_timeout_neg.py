@@ -1,0 +1,46 @@
+"""Negative fixture: every block carries a deadline (or owns one)."""
+import socket
+import threading
+
+
+def connect_bounded(addr):
+    return socket.create_connection(addr, timeout=1.5)
+
+
+def connect_bounded_positional(addr):
+    return socket.create_connection(addr, 1.5)
+
+
+def wait_bounded(evt: threading.Event):
+    return evt.wait(timeout=5.0)
+
+
+def drain_bounded(q):
+    return q.get(timeout=0.5)
+
+
+def zoo_accessor():
+    class Zoo:
+        @classmethod
+        def get(cls):
+            return cls
+    return Zoo.get()        # classmethod accessor, not a queue drain
+
+
+def read_with_deadline(sock):
+    sock.settimeout(2.0)
+    return sock.recv(4096)
+
+
+class Reader:
+    def __init__(self, sock):
+        sock.settimeout(1.0)
+        self._sock = sock
+
+    def frame(self):
+        return self._sock.recv(8)
+
+
+def read_from_bounded_connect(addr):
+    with socket.create_connection(addr, timeout=1.0) as s:
+        return s.recv(16)

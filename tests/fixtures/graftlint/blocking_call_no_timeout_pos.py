@@ -1,0 +1,27 @@
+"""Positive fixture: unbounded blocks in the killable-peer planes."""
+import socket
+import threading
+
+
+def connect_no_timeout(addr):
+    return socket.create_connection(addr)  # expect: blocking-call-no-timeout
+
+
+def wait_forever(evt: threading.Event):
+    evt.wait()  # expect: blocking-call-no-timeout
+
+
+def drain_forever(q):
+    return q.get()  # expect: blocking-call-no-timeout
+
+
+def read_no_deadline(sock):
+    return sock.recv(4096)  # expect: blocking-call-no-timeout
+
+
+class Reader:
+    def __init__(self, sock):
+        self._sock = sock
+
+    def frame(self):
+        return self._sock.recv(8)  # expect: blocking-call-no-timeout

@@ -229,6 +229,139 @@ def test_supervisor_kill_respawn_fuzz(mv_env):
         router.close()
 
 
+def test_elastic_membership_join_leave_fuzz(mv_env):
+    """Elastic clock-group fuzz (ISSUE 16 satellite): a live BSP group
+    under a seeded schedule of joins, graceful leaves, and SIGKILL-shaped
+    silent deaths — including one that dies BETWEEN acquire_add and
+    commit_add, the worst point (an in-flight add that would wedge every
+    peer's get gate forever without the quorum fallback's cleanup).
+    Invariants: no surviving worker's op ever fails, every silent death
+    is evicted by the quorum fallback (counted exactly), the group
+    re-forms and keeps making progress after every event, freed slots
+    are reused by later joins, and no monitored daemon loop wedged."""
+    from multiverso_tpu.core.sync_coordinator import SyncCoordinator
+    from multiverso_tpu.telemetry import get_registry
+    from multiverso_tpu.telemetry.flight import start_watchdog
+
+    start_watchdog()
+    trips0 = get_registry().counter("telemetry.watchdog.trips").value
+    rng = np.random.default_rng(16)
+    sc = SyncCoordinator(3, name="fuzz16", leave_timeout_s=0.4)
+
+    stop = threading.Event()
+    errors = []
+    rounds = {}
+    mu = threading.Lock()
+    silent = {}        # wid -> "boundary" | "inflight" (simulated SIGKILL)
+    departing = set()  # wid -> graceful leave requested
+
+    def worker(wid):
+        rounds[wid] = 0
+        try:
+            while not stop.is_set():
+                with mu:
+                    if silent.get(wid) == "boundary":
+                        return          # vanish: no leave, no finish
+                    if wid in departing:
+                        sc.leave(wid)   # orderly goodbye, slot freed
+                        return
+                sc.acquire_add(wid, timeout=30.0)
+                with mu:
+                    if silent.get(wid) == "inflight":
+                        return          # die holding an in-flight add
+                sc.commit_add(wid)
+                sc.acquire_get(wid, timeout=30.0)
+                sc.commit_get(wid)
+                rounds[wid] += 1
+                time.sleep(0.001)
+            sc.finish_train(wid)        # test teardown: retire cleanly
+        except Exception as e:  # noqa: BLE001 - the invariant
+            errors.append((wid, e))
+
+    threads = {}
+
+    def spawn(wid):
+        t = threading.Thread(target=worker, args=(wid,), daemon=True)
+        threads[wid] = t
+        t.start()
+
+    def await_world(n, deadline_s=20.0):
+        deadline = time.monotonic() + deadline_s
+        while sc.status()["world"] != n:
+            assert time.monotonic() < deadline, \
+                f"group never re-formed to {n}: {sc.status()}, {errors}"
+            time.sleep(0.01)
+
+    def await_progress(deadline_s=20.0):
+        with mu:
+            base = dict(rounds)
+        live = sc.status()["active"]
+        deadline = time.monotonic() + deadline_s
+        while any(rounds.get(w, 0) <= base.get(w, 0) for w in live):
+            assert time.monotonic() < deadline, \
+                f"surviving quorum stalled: {rounds} vs {base}, {errors}"
+            time.sleep(0.01)
+
+    live = {0, 1, 2}
+    for w in live:
+        spawn(w)
+    kills = leaves = joins = 0
+    # Seeded schedule: every event class fires, order fixed, victims
+    # random. "kill" alternates the death point so both the clean
+    # round-boundary death and the in-flight-add death are exercised.
+    try:
+        for i, event in enumerate(
+                ["join", "kill", "leave", "join", "kill", "join"]):
+            time.sleep(float(rng.random() * 0.1))
+            if event == "join":
+                w = sc.join(timeout=30.0)
+                with mu:
+                    # The slot id may be a reused corpse's: a stale kill
+                    # flag must not shoot the fresh tenant.
+                    silent.pop(w, None)
+                    departing.discard(w)
+                live.add(w)
+                spawn(w)
+                joins += 1
+                await_world(len(live))
+            elif event == "kill":
+                victim = int(rng.choice(sorted(live)))
+                point = "inflight" if kills % 2 else "boundary"
+                with mu:
+                    silent[victim] = point
+                live.discard(victim)
+                kills += 1
+                threads[victim].join(timeout=30)
+                assert not threads[victim].is_alive()
+                # The survivors' stalled gates must evict the corpse.
+                await_world(len(live))
+            else:
+                victim = int(rng.choice(sorted(live)))
+                with mu:
+                    departing.add(victim)
+                live.discard(victim)
+                leaves += 1
+                threads[victim].join(timeout=30)
+                assert not threads[victim].is_alive()
+                await_world(len(live))
+            await_progress()
+    finally:
+        stop.set()
+        for t in threads.values():
+            t.join(timeout=60)
+    assert not errors, f"surviving worker op failed: {errors}"
+
+    status = sc.status()
+    assert status["quorum_evictions"] == kills, status
+    # Every membership change bumped the version exactly once.
+    assert status["version"] == kills + leaves + joins, status
+    # Slot reuse: freed slots (2 kills + 1 leave) cover the later joins,
+    # so the slot table never grows past the peak concurrent world.
+    assert status["slots"] <= 4, status
+    trips = get_registry().counter("telemetry.watchdog.trips").value
+    assert trips == trips0, "a daemon loop wedged during the chaos"
+
+
 def test_restart_restore_before_announce_keeps_acked_writes(mv_env,
                                                             tmp_path):
     """The acked-write-loss race the fuzz caught, pinned deterministically:

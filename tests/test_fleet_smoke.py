@@ -43,7 +43,7 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert line["replicas"] == 2
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v8"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v9"
     assert record["replicas"] == 2
 
     # Routed lookups bitwise-equal to the direct table gather.
@@ -213,3 +213,48 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert pipe["cache_hits"] >= 1, pipe
     for r in per.values():
         assert "pipeline_inflight" in r and "cache_hits" in r
+
+
+def test_serve_bench_chaos_drill_dry_run(tmp_path):
+    """ISSUE-16 chaos drill smoke: one seeded round over a 2-shard
+    supervised PS fleet (WAL'd, sync acks) under live training, with 2
+    serving replicas taking lookup load — the round's random subset of
+    SIGKILL/SIGSTOP (possibly under a lossy link) must converge back to
+    full membership with ZERO acked-write loss (exact WAL parity), no
+    serving errors outside the recovery+hedge window, and the elastic
+    leave+rejoin round must re-form the clock group with the slot
+    reused."""
+    out = tmp_path / "BENCH_SERVE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--dry-run", "--replicas", "2",
+         "--chaos-drill", "--chaos-rounds", "1", "--chaos-seed", "16",
+         f"--out={out}"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+
+    record = json.loads(out.read_text())
+    assert record["schema"] == "multiverso_tpu.bench_serve/v9"
+    chaos = record["chaos"]
+    assert chaos["seed"] == 16
+    assert chaos["shards"] == 2
+
+    # Every round: faults actually landed, the fleet converged back to
+    # full membership, and the acked training stream survived bitwise.
+    assert len(chaos["rounds"]) == 1
+    for rnd in chaos["rounds"]:
+        assert rnd["faults"], "round planned no faults"
+        assert rnd["converged"] is True, rnd
+        assert rnd["parity_ok"] is True, rnd
+        assert rnd["serving_errors_outside_window"] == 0, rnd
+    assert chaos["converged_all_rounds"] is True
+    assert chaos["zero_acked_loss"] is True, chaos["train_errors"]
+    assert chaos["acked_adds"] > 0
+    assert chaos["train_errors"] == []
+
+    # Elastic membership: join drained to the epoch floor, leave freed
+    # the slot, the rejoin reused it, version advanced every step.
+    elastic = chaos["elastic"]
+    assert elastic["reformed"] is True, elastic
+    assert elastic["slot_reused"] is True, elastic
+    assert elastic["quorum_evictions"] == 0, elastic

@@ -28,9 +28,9 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["benchmark"] == "serve_lookup"
     record = json.loads(out.read_text())
-    # v7: + hotkeys block (planted-Zipf sketch recovery + cache-headroom
-    # advisor), box fingerprint (bench_guard's warn-don't-fail key)
-    assert record["schema"] == "multiverso_tpu.bench_serve/v8"
+    # v9: + chaos block (--chaos-drill seeded kill-any-subset rounds);
+    # config grows chaos_seed/chaos_rounds/rpc_timeout_ms
+    assert record["schema"] == "multiverso_tpu.bench_serve/v9"
     assert record["box"]["cores"] >= 1
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}

@@ -273,6 +273,14 @@ def test_exporter_write_once_under_concurrent_spans(tmp_path):
                     with span(f"conc.span{tid}", i=i):
                         pass
                     i += 1
+                    if i % 256 == 0:
+                        # Yield the GIL: three unthrottled span loops
+                        # convoy the exporter's json.dump into minutes
+                        # of wall time on a 1-core box without adding
+                        # any concurrency coverage — the races under
+                        # test are emit-vs-write interleavings, which
+                        # 256-span bursts still produce.
+                        time.sleep(0.001)
         except Exception as e:  # noqa: BLE001
             errors.append(e)
 
