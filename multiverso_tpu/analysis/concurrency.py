@@ -785,6 +785,102 @@ class BlockingCallNoTimeout(Rule):
 
 
 @register
+class PollLoopNoBackoff(Rule):
+    id = "poll-loop-no-backoff"
+    severity = "warning"
+    rationale = (
+        "A retry/convergence wait that sleeps a CONSTANT interval — "
+        "`while time.monotonic() < deadline: ... time.sleep(0.01)` — "
+        "burns a core polling a condition that changes on someone "
+        "else's schedule, and under load N such waiters poll in "
+        "lockstep (the rebalancer's drain-wait is the canonical "
+        "shape). Grow the delay (exponential backoff toward a cap) or "
+        "block on the state change itself (an Event the completing "
+        "side sets, `stop.wait(delay)`); a constant-cadence ticker "
+        "loop that isn't waiting for anything is fine and not "
+        "flagged. Scoped to the daemon planes (fleet/serving/parallel/"
+        "apps) — benches own their wall clock.")
+
+    _SCOPED = ("multiverso_tpu/fleet/", "multiverso_tpu/serving/",
+               "multiverso_tpu/parallel/", "multiverso_tpu/apps/")
+    _TIME_CALLS = {"time.monotonic", "time.time", "time.perf_counter"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.role == "script":
+            return      # benches/CLIs own their wall clock
+        if ctx.role == "package" and \
+                not any(s in ctx.rel for s in self._SCOPED):
+            return
+        for loop in ctx.walk():
+            if not isinstance(loop, ast.While):
+                continue
+            if not self._is_wait_loop(loop, ctx):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or \
+                        astutil.resolve_name(node.func, ctx.aliases) != \
+                        "time.sleep":
+                    continue
+                if self._nearest_while(node) is not loop:
+                    continue    # belongs to an inner loop's verdict
+                arg = node.args[0] if node.args else None
+                if not isinstance(arg, ast.Constant):
+                    continue    # variable delay: the owner grows it
+                yield self.finding(
+                    ctx, node,
+                    "constant-interval sleep inside a retry/convergence "
+                    "wait: back off exponentially toward a cap, or wait "
+                    "on an Event the completing side sets "
+                    "(stop.wait(delay) also makes shutdown immediate)")
+
+    @staticmethod
+    def _nearest_while(node: ast.AST) -> Optional[ast.While]:
+        for anc in astutil.ancestors(node):
+            if isinstance(anc, ast.While):
+                return anc
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return None
+        return None
+
+    def _is_wait_loop(self, loop: ast.While, ctx: FileContext) -> bool:
+        """A loop WAITING for someone else's state change: its test (or
+        a break-guard in its body) polls a deadline or a callable
+        condition. A plain `while self._running:` ticker is not one."""
+        if self._polls(loop.test, ctx):
+            return True
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.If) and \
+                    any(isinstance(s, (ast.Break, ast.Return))
+                        for b in (sub.body, sub.orelse) for s in b) and \
+                    self._polls(sub.test, ctx):
+                return True
+        return False
+
+    def _polls(self, test: ast.expr, ctx: FileContext) -> bool:
+        """Deadline arithmetic (a time call or a *deadline* name in a
+        comparison) or a polled callable (`not f()` / compare-with-call)."""
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                resolved = astutil.resolve_name(sub.func, ctx.aliases)
+                if resolved in self._TIME_CALLS:
+                    return True
+            elif isinstance(sub, ast.Name) and "deadline" in sub.id.lower():
+                return True
+            elif isinstance(sub, ast.Attribute) and \
+                    "deadline" in sub.attr.lower():
+                return True
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+                and any(isinstance(s, ast.Call)
+                        for s in ast.walk(test.operand)):
+            return True
+        if isinstance(test, ast.Compare) and \
+                any(isinstance(s, ast.Call) for s in ast.walk(test)):
+            return True
+        return False
+
+
+@register
 class DaemonLoopNoWatchdog(Rule):
     id = "daemon-loop-no-watchdog"
     severity = "warning"

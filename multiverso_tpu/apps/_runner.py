@@ -106,6 +106,7 @@ def serve_config() -> dict:
         "pipeline_depth": depth_raw or "auto",
         "cache_rows": int(get_flag("serve_cache_rows")),
         "cache_staleness": int(get_flag("serve_cache_staleness")),
+        "cache_mem_budget": int(get_flag("serve_cache_mem_budget")),
         "continuous": bool(get_flag("serve_continuous")),
         "paged": bool(get_flag("serve_paged_kv")),
         "kv_page": int(get_flag("serve_kv_page")),
@@ -203,6 +204,13 @@ def fleet_config() -> dict:
         "rpc_timeout_ms": float(get_flag("rpc_timeout_ms")),
         "ps_shards": int(get_flag("ps_fleet_shards")),
         "ps_dir": str(get_flag("ps_fleet_dir")),
+        "hotkey_replicas": int(get_flag("fleet_hotkey_replicas")),
+        "rebalance": bool(get_flag("fleet_rebalance")),
+        "rebalance_ratio": float(get_flag("fleet_rebalance_ratio")),
+        "rebalance_windows": int(get_flag("fleet_rebalance_windows")),
+        "rebalance_cooldown_s":
+            float(get_flag("fleet_rebalance_cooldown_s")),
+        "rebalance_vnodes": int(get_flag("fleet_rebalance_vnodes")),
     }
 
 
@@ -299,10 +307,12 @@ def rendezvous(rdv: str, rank: int, world: int, address,
     deadline = time.time() + timeout_s
     for r in range(world):
         path = os.path.join(rdv, f"addr{r}")
+        delay = 0.01
         while not os.path.exists(path):
             if time.time() > deadline:
                 raise TimeoutError(f"rank {r} never registered in {rdv}")
-            time.sleep(0.05)
+            time.sleep(delay)
+            delay = min(delay * 2.0, 0.25)
         host, port = open(path).read().split(":")
         peers[r] = (host, int(port))
     return peers
@@ -319,7 +329,9 @@ def wait_all_done(rdv: str, rank: int, world: int,
         f.write("ok")
     deadline = time.time() + timeout_s
     for r in range(world):
+        delay = 0.01
         while not os.path.exists(os.path.join(rdv, f"done{r}")):
             if time.time() > deadline:
                 raise TimeoutError(f"rank {r} never finished")
-            time.sleep(0.05)
+            time.sleep(delay)
+            delay = min(delay * 2.0, 0.25)

@@ -74,7 +74,9 @@ def _wait_duration() -> None:
     deadline = time.monotonic() + duration if duration > 0 else None
     try:
         while deadline is None or time.monotonic() < deadline:
-            time.sleep(0.2)
+            # Constant cadence on purpose: this parks the main thread
+            # while daemons serve, and 0.2s bounds Ctrl-C latency.
+            time.sleep(0.2)  # graftlint: disable=poll-loop-no-backoff
     except KeyboardInterrupt:
         log.info("fleet_main: interrupted, shutting down")
 
@@ -191,6 +193,7 @@ def _drain_body(cfg: dict) -> int:
         deadline = time.monotonic() + \
             cfg["drain_timeout_s"] * (len(want) + 1)
         pending = list(want)    # reported if the loop never iterates
+        delay = 0.05
         while time.monotonic() < deadline:
             table = {m["id"]: m for m in cli.refresh().members}
             pending = [mid for mid in want
@@ -201,7 +204,8 @@ def _drain_body(cfg: dict) -> int:
             if not pending:
                 log.info("drain complete: %s", want)
                 return 0
-            time.sleep(0.1)
+            time.sleep(delay)
+            delay = min(delay * 2.0, 0.5)
         log.error("drain timed out; still pending: %s", pending)
         return 1
     finally:
@@ -242,6 +246,19 @@ def _ps_fleet_body(cfg: dict) -> int:
     return 0
 
 
+def _actuator_kwargs(cfg: dict) -> dict:
+    """Skew-actuator knobs (``-fleet_hotkey_replicas`` /
+    ``-fleet_rebalance*``) in FleetRouter kwarg shape."""
+    return {
+        "hotkey_replicas": cfg["hotkey_replicas"],
+        "rebalance": cfg["rebalance"],
+        "rebalance_ratio": cfg["rebalance_ratio"],
+        "rebalance_windows": cfg["rebalance_windows"],
+        "rebalance_cooldown_s": cfg["rebalance_cooldown_s"],
+        "rebalance_vnodes": cfg["rebalance_vnodes"],
+    }
+
+
 def _router_body(cfg: dict) -> int:
     from multiverso_tpu.fleet import FleetRouter
 
@@ -249,7 +266,8 @@ def _router_body(cfg: dict) -> int:
                          port=cfg["port"], vnodes=cfg["vnodes"],
                          heartbeat_ms=cfg["heartbeat_ms"],
                          liveness_misses=cfg["liveness_misses"],
-                         proxy=cfg["proxy"])
+                         proxy=cfg["proxy"],
+                         **_actuator_kwargs(cfg))
     _write_addr_file(cfg["addr_file"], router.address)
     try:
         _wait_duration()
@@ -293,19 +311,22 @@ def _local_body(cfg: dict, remaining_args: List[str]) -> int:
                          port=cfg["port"], vnodes=cfg["vnodes"],
                          heartbeat_ms=cfg["heartbeat_ms"],
                          liveness_misses=cfg["liveness_misses"],
-                         proxy=cfg["proxy"])
+                         proxy=cfg["proxy"],
+                         **_actuator_kwargs(cfg))
     _write_addr_file(cfg["addr_file"], router.address)
     procs = _spawn_replicas(cfg, router.address, remaining_args,
                             cfg["replicas"])
     supervisor = None
     try:
         deadline = time.monotonic() + 120
+        delay = 0.01
         while len(router.group.member_ids()) < cfg["replicas"]:
             check(time.monotonic() < deadline,
                   "fleet replicas never joined the router")
             if any(p.poll() is not None for p in procs):
                 check(False, "a fleet replica exited during bring-up")
-            time.sleep(0.05)
+            time.sleep(delay)
+            delay = min(delay * 2.0, 0.25)
         log.info("fleet up: %d replicas behind %s:%d",
                  cfg["replicas"], *router.address)
         if cfg["supervise"]:

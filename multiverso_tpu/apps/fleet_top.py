@@ -36,6 +36,18 @@ def _fmt_ms(v: float) -> str:
     return f"{v:9.2f}"
 
 
+def _fmt_rebal(hot: int, migrations: int) -> str:
+    """Compact REBAL cell: '-' when the skew actuators are idle, else
+    the replicated-hot-key count, with '/mN' while N migrations are in
+    flight (the drain-and-handoff window)."""
+    if not hot and not migrations:
+        return "-"
+    cell = str(int(hot))
+    if migrations:
+        cell += f"/m{int(migrations)}"
+    return cell
+
+
 def _fmt_alerts(alerts) -> str:
     """Compact ALERTS cell: '-' when quiet, else 'N:first_name' (the
     full list is in the Fleet_Stats JSON; the table names the loudest)."""
@@ -74,7 +86,7 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
     header = (f"{'MEMBER':24s} {'HEALTH':>7s} {'QPS':>8s} {'SHED%':>7s} "
               f"{'QUEUE':>6s} {'INFL':>5s} {'P50ms':>9s} {'P95ms':>9s} "
               f"{'P99ms':>9s} {'SLO':>6s} {'DRAINS':>6s} {'STATE':>8s} "
-              f"{'SKEW%':>6s} {'ALERTS':>15s}")
+              f"{'SKEW%':>6s} {'REBAL':>6s} {'ALERTS':>15s}")
     lines.append(header)
     for mid in sorted(replicas):
         r = replicas[mid]
@@ -92,8 +104,10 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
             f"{r.get('slo_violations', 0):6d} "
             f"{r.get('drains_completed', 0):6d} {state:>8s} "
             f"{100 * r.get('skew', 0.0):6.1f} "
+            f"{_fmt_rebal(r.get('hot_replicated', 0), r.get('migrations', 0)):>6s} "
             f"{_fmt_alerts(r.get('alerts')):>15s}")
     ftotal = fleet.get("stages", {}).get("total", {})
+    rebal = fleet.get("rebalance") or {}
     # The router's own alerts (heartbeat loss fires on the ROUTER — a
     # dead replica cannot report its own absence) render on the FLEET
     # row: they are fleet-scoped, not any one member's. The FLEET SKEW%
@@ -110,6 +124,7 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
         f"{fleet.get('slo_violations', 0):6d} "
         f"{'':6s} {'n=%d' % fleet.get('replicas', 0):>8s} "
         f"{'x%.2f' % fleet.get('shard_load_ratio', 1.0):>6s} "
+        f"{_fmt_rebal(fleet.get('hotkey_replicated', 0), rebal.get('migrations', 0)):>6s} "
         f"{_fmt_alerts(router_alerts):>15s}")
     return "\n".join(lines)
 

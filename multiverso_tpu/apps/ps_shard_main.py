@@ -132,7 +132,9 @@ def _body(remaining: List[str]) -> int:
     next_ckpt = time.monotonic() + every if every > 0 and uri else None
     try:
         while deadline is None or time.monotonic() < deadline:
-            time.sleep(0.1)
+            # Constant cadence on purpose: this is the checkpoint
+            # ticker's clock, not a convergence wait.
+            time.sleep(0.1)  # graftlint: disable=poll-loop-no-backoff
             if next_ckpt is not None and time.monotonic() >= next_ckpt:
                 # Snapshot is dispatcher-atomic (ps_service); the stream
                 # write is atomic-rename (utils/stream); the rotate+prune

@@ -82,7 +82,9 @@ def _body(remaining: List[str]) -> int:
     deadline = time.monotonic() + duration if duration > 0 else None
     try:
         while deadline is None or time.monotonic() < deadline:
-            time.sleep(0.2)
+            # Constant cadence on purpose: parks the main thread while
+            # the service threads serve; 0.2s bounds Ctrl-C latency.
+            time.sleep(0.2)  # graftlint: disable=poll-loop-no-backoff
     except KeyboardInterrupt:
         log.info("serve_main: interrupted, shutting down")
     finally:

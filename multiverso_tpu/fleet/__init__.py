@@ -17,6 +17,11 @@ taken to its fleet conclusion). Layers:
   optional data-plane proxy, and the rolling-drain driver;
 * ``client``     — ``FleetClient``: ring-routed lookups, health-balanced
   decode, hedging + typed-failover;
+* ``rebalance``  — skew actuators (docs/DESIGN.md "Skew actuation"):
+  ``HotKeyReplicator`` (confident hot keys replicated to R extra ring
+  owners, reads freshness-gated by the HotRowCache clock rule) and
+  ``FleetRebalancer`` (vnode drain-and-handoff migration of hot ranges
+  to the coldest member);
 * ``supervisor`` — ``ReplicaSupervisor``: the actuation half of the
   self-healing fleet — alert-driven replacement of dead members and
   spawn/drain autoscaling with hysteresis + cooldown
@@ -44,6 +49,7 @@ from multiverso_tpu.fleet.hedge import (AdaptiveDelay, HedgedCall,
                                         HedgeScheduler)
 from multiverso_tpu.fleet.membership import (FleetMember, MemberInfo,
                                              ReplicaGroup)
+from multiverso_tpu.fleet.rebalance import FleetRebalancer, HotKeyReplicator
 from multiverso_tpu.fleet.router import FleetRouter
 from multiverso_tpu.fleet.supervisor import (LocalFleetView,
                                              RemoteFleetView,
@@ -51,8 +57,9 @@ from multiverso_tpu.fleet.supervisor import (LocalFleetView,
 
 __all__ = [
     "AdaptiveDelay", "ChaosEngine", "Fault", "FleetClient", "FleetMember",
-    "FleetRouter", "HashRing", "HedgeScheduler", "HedgedCall",
-    "LocalFleetView", "MemberInfo", "PSShardFleet", "RemoteFleetView",
+    "FleetRebalancer", "FleetRouter", "HashRing", "HedgeScheduler",
+    "HedgedCall", "HotKeyReplicator", "LocalFleetView", "MemberInfo",
+    "PSShardFleet", "RemoteFleetView",
     "ReplicaGroup", "ReplicaSupervisor", "RoutingTable", "STAT_FIELDS",
     "fetch_fleet_stats", "health_score", "local_stats", "metrics_payload",
     "request_drain",

@@ -68,12 +68,21 @@ def _resolve_root() -> Optional[TraceContext]:
 
 class RoutingTable:
     """One immutable snapshot of the fleet's routing state. Ranked order
-    is precomputed once — ``ranked()`` sits on the per-request path."""
+    is precomputed once — ``ranked()`` sits on the per-request path.
+
+    Skew-actuation state rides the same payload (docs/DESIGN.md "Skew
+    actuation"): vnode ownership ``overrides`` feed the ring rebuild (so
+    router and clients agree on migrated arcs), and ``hot_replicas``
+    holds each replicated hot key's member list PRE-FILTERED by the
+    HotRowCache freshness rule — a member may serve a replicated key iff
+    ``fleet_max_step - member_step <= hot_staleness`` (an unversioned
+    fleet, ``max_step < 0``, is always fresh). Filtering at build time
+    keeps the per-request path to a dict probe."""
 
     __slots__ = ("version", "vnodes", "members", "by_id", "ring",
-                 "_ranked")
+                 "overrides", "hot_replicas", "_ranked")
 
-    def __init__(self, payload: Dict):
+    def __init__(self, payload: Dict, hot_staleness: float = 0.0):
         self.version = int(payload.get("version", 0))
         self.vnodes = int(payload.get("vnodes", 64))
         self.members: List[Dict] = list(payload.get("members", []))
@@ -81,10 +90,24 @@ class RoutingTable:
         routable = sorted(m["id"] for m in self.members
                           if not m.get("draining")
                           and m.get("health", 0.0) > 0.0)
-        self.ring = HashRing(routable, vnodes=self.vnodes)
+        self.overrides: List[Tuple[str, int, str]] = [
+            (str(m), int(v), str(t))
+            for m, v, t in payload.get("overrides", [])]
+        self.ring = HashRing(routable, vnodes=self.vnodes,
+                             overrides=self.overrides)
         live = [m for m in self.members if m["id"] in self.ring.members]
         live.sort(key=lambda m: (-float(m.get("health", 0.0)), m["id"]))
         self._ranked = [m["id"] for m in live]
+        steps = {m["id"]: float(m.get("step", -1.0)) for m in self.members}
+        max_step = max(steps.values(), default=-1.0)
+        self.hot_replicas: Dict[int, List[str]] = {}
+        for key, mids in (payload.get("hot_keys") or {}).items():
+            fresh = [m for m in mids
+                     if m in self.ring
+                     and (max_step < 0 or (steps.get(m, -1.0) >= 0
+                          and max_step - steps[m] <= hot_staleness))]
+            if fresh:
+                self.hot_replicas[int(key)] = fresh
 
     def ranked(self, exclude: Sequence[str] = ()) -> List[str]:
         """Member ids by descending health, the routable ones only."""
@@ -241,12 +264,19 @@ class FleetClient:
                  hedge: Union[str, float] = "adaptive",
                  max_attempts: int = 3,
                  scheduler: Optional[HedgeScheduler] = None,
-                 rpc_timeout_ms: Optional[float] = None):
+                 rpc_timeout_ms: Optional[float] = None,
+                 hot_staleness: float = 0.0):
         from multiverso_tpu.fleet.membership import ReplicaGroup
         self._feed = _GroupFeed(router) if isinstance(router, ReplicaGroup) \
             else _RouterFeed(router)
         self.runner_id = int(runner_id)
         self.max_attempts = max(1, int(max_attempts))
+        # Replicated-hot-key read bound, same clock arithmetic as
+        # -serve_cache_staleness (0 = only replicas at the fleet max
+        # step may serve a replicated key).
+        self._hot_staleness = float(hot_staleness)
+        self._hot_rr = 0        # round-robin cursor over fresh replicas
+        self._c_hot_routed = counter("fleet.hotkey.routed")
         self._hedge_on = hedge != "off"
         self._fixed_delay = None if isinstance(hedge, str) \
             else float(hedge)
@@ -266,6 +296,7 @@ class FleetClient:
         self._c_lookup = counter("fleet.route.lookup")
         self._c_decode = counter("fleet.route.decode")
         self._c_sub = counter("fleet.route.subrequests")
+        self._c_parked = counter("fleet.route.parked")
         self._c_errors = counter("fleet.errors")
         self._c_cancels = counter("fleet.hedge.cancelled")
         self.refresh()          # fail loudly if the router is unreachable
@@ -283,7 +314,7 @@ class FleetClient:
         # addresses forever.
         fresh_feed = getattr(self._feed, "consume_reconnected",
                              lambda: False)()
-        table = RoutingTable(payload)
+        table = RoutingTable(payload, hot_staleness=self._hot_staleness)
         with self._lock:
             if self._table is None or fresh_feed \
                     or table.version >= self._table.version:
@@ -510,33 +541,72 @@ class FleetClient:
     def _affinity_pref(self, rows: np.ndarray,
                        table: RoutingTable) -> List[str]:
         """Ring owner of the request's combined key hash first, then the
-        rest by health — sticky per key-set, balanced across sets."""
+        rest by health — sticky per key-set, balanced across sets.
+
+        Hot-key replication relaxes stickiness: when EVERY requested row
+        is a replicated hot key (all-or-nothing, mirroring the cache's
+        all-or-nothing admission), the request round-robins across the
+        union of the rows' FRESH replica lists (table-build filtered by
+        the HotRowCache staleness rule) with the home owner as failover;
+        otherwise the classic affinity route."""
         if rows.size and len(table.ring):
             rep = int(_splitmix64(rows.astype(np.uint64)).sum()
                       % np.uint64(2**63 - 1))
             owner = table.ring.owner(rep)
+            hot = table.hot_replicas
+            if hot and all(int(r) in hot for r in rows):
+                cand: List[str] = []
+                for r in rows:
+                    for m in hot[int(r)]:
+                        if m not in cand:
+                            cand.append(m)
+                if cand:
+                    self._hot_rr = (self._hot_rr + 1) % 1_000_003
+                    pick = cand[self._hot_rr % len(cand)]
+                    self._c_hot_routed.inc()
+                    rest = [m for m in [owner]
+                            + table.ranked(exclude=(owner,))
+                            if m != pick]
+                    return [pick] + rest
             return [owner] + table.ranked(exclude=(owner,))
         return table.ranked()
 
     def lookup_async(self, rows, on_done: Callable,
                      deadline_ms: float = 100.0, split: bool = False,
-                     runner_id: Optional[int] = None) -> None:
+                     runner_id: Optional[int] = None,
+                     _deadline: Optional[float] = None) -> None:
         """Row lookup; ``on_done`` gets ``(values, clock)`` or exception,
         exactly once. ``split=True`` fans rows out to their ring owners
         and stitches replies back in request order."""
         rows = np.asarray(rows, dtype=np.int32).reshape(-1)
         table = self.routing()
-        self._c_lookup.inc()
-        # Router-/client-side half of the traffic microscope: the key
-        # stream AS ROUTED (affinity + split fan-out), before any cache
-        # or shed — what key-affinity rebalancing would re-shard by.
-        record_keys("fleet.route", rows, rows.nbytes)
+        if _deadline is None:
+            self._c_lookup.inc()
+            # Router-/client-side half of the traffic microscope: the key
+            # stream AS ROUTED (affinity + split fan-out), before any
+            # cache or shed — what key-affinity rebalancing re-shards by.
+            record_keys("fleet.route", rows, rows.nbytes)
+            _deadline = time.monotonic() + deadline_ms / 1e3
+        if not len(table.ring):
+            # Park-and-retry through the flip: mid-handoff (donor
+            # draining, survivor health-scored 0 under the redirected
+            # load) or mid-recovery the table can be MOMENTARILY empty,
+            # and the announce that repopulates it is heartbeats away —
+            # re-resolve off the scheduler until the request deadline
+            # instead of failing a request the flip would have served.
+            if time.monotonic() + 0.05 < _deadline:
+                self._c_parked.inc()
+                self._sched.call_later(
+                    0.05, lambda: self.lookup_async(
+                        rows, on_done, deadline_ms, split, runner_id,
+                        _deadline=_deadline))
+            else:
+                on_done(ReplicaUnavailableError(
+                    "fleet has no live replicas"))
+            return
         if not split or rows.size == 0:
             self.request_async(rows, self._affinity_pref(rows, table),
                                on_done, deadline_ms, runner_id)
-            return
-        if not len(table.ring):
-            on_done(ReplicaUnavailableError("fleet has no live replicas"))
             return
         parts = table.ring.partition(rows.astype(np.int64))
         self._c_sub.inc(len(parts))

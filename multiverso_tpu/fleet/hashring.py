@@ -19,6 +19,17 @@ over numpy int arrays for batch routing; runs per request). Never
 Construction is a pure function of ``(sorted member ids, vnodes)``, so a
 router and its clients independently build IDENTICAL rings from the same
 membership list — the routing table only has to ship ids, not arcs.
+
+Vnode **ownership overrides** (the rebalancer's actuation surface,
+docs/DESIGN.md "Skew actuation") relax that purity one controlled step:
+an override ``(member, vnode, target)`` keeps the vnode's POSITION on
+the circle (placed by ``member``'s hash, so nothing else moves) but
+hands the arc's keys to ``target``. Overrides ship in the routing
+payload next to the member list, so router and clients still build
+identical rings — now a pure function of ``(members, vnodes,
+overrides)``. An override whose placing member or target has left the
+ring is dropped (the arc reverts to its hash owner), which is exactly
+the fail-safe a swept member wants.
 """
 
 from __future__ import annotations
@@ -57,12 +68,17 @@ class HashRing:
     arrays (membership changes are rare; lookups are the hot path and
     stay two numpy ops)."""
 
-    def __init__(self, members: Iterable[str] = (), vnodes: int = 64):
+    def __init__(self, members: Iterable[str] = (), vnodes: int = 64,
+                 overrides: Iterable[Tuple[str, int, str]] = ()):
         check(vnodes >= 1, "vnodes must be >= 1")
         self.vnodes = int(vnodes)
         self._members: List[str] = sorted(set(members))
         self._positions = np.zeros(0, dtype=_U64)
         self._owners = np.zeros(0, dtype=np.int64)
+        self._arc_place = np.zeros(0, dtype=np.int64)
+        self._arc_vnode = np.zeros(0, dtype=np.int64)
+        self._overrides: Dict[Tuple[str, int], str] = {
+            (str(m), int(v)): str(t) for m, v, t in overrides}
         self._rebuild()
 
     # -- membership ---------------------------------------------------------
@@ -97,6 +113,8 @@ class HashRing:
         if n == 0:
             self._positions = np.zeros(0, dtype=_U64)
             self._owners = np.zeros(0, dtype=np.int64)
+            self._arc_place = np.zeros(0, dtype=np.int64)
+            self._arc_vnode = np.zeros(0, dtype=np.int64)
             return
         pos = np.empty(n * self.vnodes, dtype=_U64)
         own = np.empty(n * self.vnodes, dtype=np.int64)
@@ -104,9 +122,65 @@ class HashRing:
             for v in range(self.vnodes):
                 pos[i * self.vnodes + v] = _vnode_position(member, v)
                 own[i * self.vnodes + v] = i
+        # Migrated arcs: the vnode keeps ITS position (placed by the
+        # original member's hash — no other arc moves) but its keys are
+        # served by the override target. Dangling entries (placer or
+        # target no longer a member) are ignored, not an error: a swept
+        # member's arcs must revert to hash ownership on their own.
+        index = {m: i for i, m in enumerate(self._members)}
+        for (member, vnode), target in self._overrides.items():
+            i, t = index.get(member), index.get(target)
+            if i is not None and t is not None and 0 <= vnode < self.vnodes:
+                own[i * self.vnodes + vnode] = t
         order = np.argsort(pos, kind="stable")
         self._positions = pos[order]
         self._owners = own[order]
+        # Arc identity (placing member, vnode index) in sorted-arc order:
+        # the rebalancer ranks arcs by traffic and needs to name them.
+        self._arc_place = np.repeat(np.arange(n, dtype=np.int64),
+                                    self.vnodes)[order]
+        self._arc_vnode = np.tile(np.arange(self.vnodes, dtype=np.int64),
+                                  n)[order]
+
+    # -- vnode ownership overrides (rebalancer actuation) --------------------
+    @property
+    def overrides(self) -> Tuple[Tuple[str, int, str], ...]:
+        """Active ``(placing member, vnode, target)`` triples, sorted —
+        the exact value the routing payload ships."""
+        return tuple(sorted((m, v, t) for (m, v), t
+                            in self._overrides.items()))
+
+    def set_overrides(self,
+                      triples: Iterable[Tuple[str, int, str]]) -> None:
+        """Replace ALL overrides and rebuild (the routing-table path:
+        clients apply the payload's full override list atomically)."""
+        self._overrides = {(str(m), int(v)): str(t)
+                           for m, v, t in triples}
+        self._rebuild()
+
+    def assign_vnode(self, member: str, vnode: int, target: str) -> None:
+        """Point one vnode arc of ``member`` at ``target`` (the router's
+        per-migration step). ``target == member`` clears the override."""
+        check(0 <= int(vnode) < self.vnodes,
+              f"vnode {vnode} out of range [0, {self.vnodes})")
+        key = (str(member), int(vnode))
+        if str(target) == str(member):
+            self._overrides.pop(key, None)
+        else:
+            self._overrides[key] = str(target)
+        self._rebuild()
+
+    def arc_ids(self, keys: np.ndarray) -> List[Tuple[str, int]]:
+        """Per key: the identity ``(placing member, vnode)`` of the arc
+        that covers it — what a rebalancer aggregates traffic by. Uses
+        the PLACING member, not the effective owner, so an arc keeps its
+        name across migrations."""
+        check(len(self._members) > 0, "hash ring has no members")
+        hashed = _splitmix64(np.asarray(keys).reshape(-1))
+        idx = np.searchsorted(self._positions, hashed, side="right")
+        idx = np.where(idx == len(self._positions), 0, idx)
+        return [(self._members[int(self._arc_place[i])],
+                 int(self._arc_vnode[i])) for i in idx]
 
     # -- routing ------------------------------------------------------------
     def owner(self, key: int) -> str:

@@ -145,7 +145,12 @@ def metrics_payload() -> Dict:
     # per-replica rate and derives the fleet's shard-imbalance ratio.
     hub = get_sketch_hub()
     hub.flush()
-    traffic = hub.summary("serve.lookup", topn=5)
+    # topn must cover the hot-key replicator's confident-set cap
+    # (HotKeyReplicator topk=16): a key the heartbeat never ships can
+    # never promote, and all-or-nothing hot routing needs EVERY row of
+    # a hot request replicated — a top-5 cap silently disabled it for
+    # any hot set wider than 5 keys.
+    traffic = hub.summary("serve.lookup", topn=16)
     return {
         "requests": reg.counter("serve.requests").value,
         "replies": reg.counter("serve.replies").value,

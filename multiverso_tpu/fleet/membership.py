@@ -29,7 +29,7 @@ import os
 import socket
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from multiverso_tpu.core.actor import Message, MsgType
 from multiverso_tpu.fleet.hashring import HashRing
@@ -123,11 +123,23 @@ class ReplicaGroup:
         self._version = 0
         self._stats_seq = 0     # bumps per metrics-bearing heartbeat
         self._ring = HashRing((), vnodes=self.vnodes)
+        # Skew actuation state (docs/DESIGN.md "Skew actuation"), owned
+        # here because both ship in the routing payload: replicated hot
+        # keys (key -> ordered member list, home owner first) and vnode
+        # ownership overrides ((placing member, vnode) -> target).
+        self._hot_replicas: Dict[int, List[str]] = {}
+        self._overrides: Dict[Tuple[str, int], str] = {}
+        #: Per-member count of migrations currently in flight (donor and
+        #: target both count one per active handoff) — display state for
+        #: fleet_top's REBAL column, no routing semantics.
+        self._migrations: Dict[str, int] = {}
         self._g_members = gauge("fleet.members")
         self._g_version = gauge("fleet.ring_version")
         self._c_joins = counter("fleet.joins")
         self._c_heartbeats = counter("fleet.heartbeats")
         self._c_dead = counter("fleet.member_dead")
+        self._g_hot = gauge("fleet.hotkey.replicated")
+        self._g_overrides = gauge("fleet.rebalance.overrides")
 
     # -- protocol handlers ---------------------------------------------------
     def join(self, member_id: str, host: str, port: int) -> Dict:
@@ -247,9 +259,78 @@ class ReplicaGroup:
         self._version += 1
         routable = sorted(m.id for m in self._members.values()
                           if not m.draining)
-        self._ring = HashRing(routable, vnodes=self.vnodes)
+        # Overrides ride along unconditionally: HashRing drops any whose
+        # placer/target is not routable (the fail-safe revert).
+        self._ring = HashRing(routable, vnodes=self.vnodes,
+                              overrides=[(m, v, t) for (m, v), t
+                                         in self._overrides.items()])
         self._g_members.set(len(self._members))
         self._g_version.set(self._version)
+
+    # -- skew actuation (docs/DESIGN.md "Skew actuation") --------------------
+    def hot_key_counts(self) -> Tuple[Dict[int, int], int]:
+        """Merged CUMULATIVE heavy-hitter counts across live members
+        (counts sum per key — SpaceSaving's merge rule) plus the total
+        served-keys count: the replicator differentiates these into
+        per-window traffic shares."""
+        with self._lock:
+            members = [m for m in self._members.values() if not m.draining]
+        merged: Dict[int, int] = {}
+        total = 0
+        for m in members:
+            met = m.metrics
+            total += int(met.get("keys", 0))
+            for key, cnt in met.get("hot_keys", []):
+                merged[int(key)] = merged.get(int(key), 0) + int(cnt)
+        return merged, total
+
+    def set_hot_keys(self, mapping: Dict[int, List[str]]) -> None:
+        """Replace the replicated-hot-key map (key -> ordered member
+        list, home owner first). Called from the router's sweep tick;
+        bumps the routing version only when the map actually changed so
+        a steady confident set doesn't churn client tables."""
+        mapping = {int(k): [str(m) for m in v] for k, v in mapping.items()}
+        with self._lock:
+            if mapping == self._hot_replicas:
+                return
+            promoted = len(set(mapping) - set(self._hot_replicas))
+            demoted = len(set(self._hot_replicas) - set(mapping))
+            self._hot_replicas = mapping
+            self._bump_locked()
+        if promoted:
+            counter("fleet.hotkey.promotions").inc(promoted)
+        if demoted:
+            counter("fleet.hotkey.demotions").inc(demoted)
+        self._g_hot.set(len(mapping))
+
+    def hot_keys(self) -> Dict[int, List[str]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._hot_replicas.items()}
+
+    def apply_vnode_overrides(
+            self, triples: Iterable[Tuple[str, int, str]]) -> None:
+        """Replace ALL vnode ownership overrides (the rebalancer's
+        transfer+announce step: the rebuilt ring and version bump make
+        every client park-and-retry onto the new owner)."""
+        staged = {(str(m), int(v)): str(t) for m, v, t in triples}
+        with self._lock:
+            if staged == self._overrides:
+                return
+            self._overrides = staged
+            self._bump_locked()
+        self._g_overrides.set(len(staged))
+
+    def vnode_overrides(self) -> List[Tuple[str, int, str]]:
+        with self._lock:
+            return sorted((m, v, t) for (m, v), t
+                          in self._overrides.items())
+
+    def set_migrations(self, per_member: Dict[str, int]) -> None:
+        """Display-plane only (fleet_top REBAL column): per-member count
+        of handoffs in flight. No version bump — nothing routes on it."""
+        with self._lock:
+            self._migrations = {str(k): int(v)
+                                for k, v in per_member.items() if v}
 
     @property
     def version(self) -> int:
@@ -267,11 +348,20 @@ class ReplicaGroup:
         with self._lock:
             members = list(self._members.values())
             version = self._version
+            hot = {str(k): list(v) for k, v in self._hot_replicas.items()}
+            overrides = sorted([m, v, t] for (m, v), t
+                               in self._overrides.items())
         max_step = max([m.step for m in members], default=-1.0)
         return {
             "version": version,
             "vnodes": self.vnodes,
             "heartbeat_ms": self.heartbeat_ms,
+            # Skew actuation, shipped so clients rebuild the IDENTICAL
+            # effective ring: replicated hot keys (JSON keys must be
+            # strings; ordered member list, home owner first) and vnode
+            # ownership overrides.
+            "hot_keys": hot,
+            "overrides": overrides,
             "members": [{
                 "id": m.id, "host": m.host, "port": m.port,
                 "health": round(health_score(m.stats, max_step), 6),
@@ -295,7 +385,16 @@ class ReplicaGroup:
         with self._lock:
             members = list(self._members.values())
             version = self._stats_seq
+            hot_lists = list(self._hot_replicas.values())
+            n_overrides = len(self._overrides)
+            migrations = dict(self._migrations)
         max_step = max([m.step for m in members], default=-1.0)
+        # REBAL column inputs: how many replicated hot keys each member
+        # serves (as home owner OR extra replica) + handoffs in flight.
+        hot_count: Dict[str, int] = {}
+        for repl in hot_lists:
+            for mid in repl:
+                hot_count[mid] = hot_count.get(mid, 0) + 1
         per: Dict[str, Dict] = {}
         for m in members:
             met, rates = m.metrics, m.rates()
@@ -304,6 +403,8 @@ class ReplicaGroup:
                 "health": round(health_score(m.stats, max_step), 6),
                 "draining": m.draining,
                 "drains_completed": m.drains_completed,
+                "hot_replicated": hot_count.get(m.id, 0),
+                "migrations": migrations.get(m.id, 0),
                 "qps": rates["qps"],
                 "request_rate": rates["request_rate"],
                 "shed_rate": rates["shed_rate"],
@@ -372,6 +473,11 @@ class ReplicaGroup:
                 merged_hot[key] = merged_hot.get(key, 0) + int(count)
         fleet["hot_keys"] = sorted(([k, c] for k, c in merged_hot.items()),
                                    key=lambda kc: -kc[1])[:5]
+        # Skew actuator status (fleet_top REBAL; not per-row sums — a
+        # replicated key appears on several members by design).
+        fleet["hotkey_replicated"] = len(hot_lists)
+        fleet["rebalance"] = {"overrides": n_overrides,
+                              "migrations": sum(migrations.values())}
         # The ROUTER's own alert engine (heartbeat-loss fires HERE — the
         # dead replica cannot report its own absence) plus the sum of
         # replica-reported firing alerts: fleet_top's ALERTS column.

@@ -198,12 +198,14 @@ class PSShardFleet:
             self.spawn_seat(r)
         deadline = time.monotonic() + bringup_timeout_s
         for r in range(1, self.shards + 1):
+            delay = 0.01
             while not self.seat_announced(r):
                 check(self.seat_alive(r),
                       f"ps shard {r} exited during bring-up")
                 check(time.monotonic() < deadline,
                       f"ps shard {r} never announced")
-                time.sleep(0.05)
+                time.sleep(delay)
+                delay = min(delay * 2.0, 0.25)
             self.peers[r] = self._read_addr(r)
         if self.table_kind == "matrix":
             self.table = DistributedMatrixTable(
@@ -239,10 +241,12 @@ class PSShardFleet:
         """Block until EVERY seat is announced + alive (full membership
         — the chaos drill's per-round convergence gate)."""
         deadline = time.monotonic() + timeout_s
+        delay = 0.01
         while time.monotonic() < deadline:
             if len(self.membership_stats()["replicas"]) == self.shards:
                 return True
-            time.sleep(0.05)
+            time.sleep(delay)
+            delay = min(delay * 2.0, 0.25)
         return False
 
     def status(self) -> Dict:

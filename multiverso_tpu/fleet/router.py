@@ -51,10 +51,31 @@ class FleetRouter:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  vnodes: int = 64, heartbeat_ms: float = 100.0,
                  liveness_misses: int = 5, proxy: bool = True,
-                 lookup_runners: Sequence[int] = (0,)):
+                 lookup_runners: Sequence[int] = (0,),
+                 hotkey_replicas: int = 0, rebalance: bool = False,
+                 rebalance_ratio: float = 1.5,
+                 rebalance_windows: int = 3,
+                 rebalance_cooldown_s: float = 10.0,
+                 rebalance_vnodes: int = 4):
         self.group = ReplicaGroup(vnodes=vnodes, heartbeat_ms=heartbeat_ms,
                                   liveness_misses=liveness_misses)
         self._lookup_runners = frozenset(int(r) for r in lookup_runners)
+        # Skew actuators (fleet/rebalance.py), ticked from the sweep
+        # loop so decisions advance on the same clock as the load gauges
+        # they read. Both off by default — flags arm them.
+        self.replicator = None
+        if int(hotkey_replicas) > 0:
+            from multiverso_tpu.fleet.rebalance import HotKeyReplicator
+            self.replicator = HotKeyReplicator(
+                self.group, replicas=int(hotkey_replicas))
+        self.rebalancer = None
+        if rebalance:
+            from multiverso_tpu.fleet.rebalance import FleetRebalancer
+            self.rebalancer = FleetRebalancer(
+                self.group, ratio=float(rebalance_ratio),
+                windows=int(rebalance_windows),
+                cooldown_s=float(rebalance_cooldown_s),
+                move_vnodes=int(rebalance_vnodes))
         self._proxy_client = None
         self._proxy_on = bool(proxy)
         self._drain_driver = None
@@ -242,13 +263,15 @@ class FleetRouter:
         check(before is not None, f"unknown fleet member '{member_id}'")
         self.group.drain(member_id)
         deadline = time.monotonic() + timeout_s
+        delay = 0.01
         while time.monotonic() < deadline:
             done = self.group.drains_completed(member_id)
             if done is None:
                 return False          # died mid-drain; sweep took it
             if done > before and not self.group.is_draining(member_id):
                 return True           # full cycle: out and back in
-            time.sleep(0.01)
+            time.sleep(delay)
+            delay = min(delay * 2.0, 0.25)
         return False
 
     def rolling_drain(self, timeout_s_per_member: float = 60.0) -> bool:
@@ -277,7 +300,14 @@ class FleetRouter:
                 # Shard-load gauges for the imbalance alert rule: the
                 # sweeper already runs at heartbeat cadence, so the
                 # ratio series is as fresh as liveness itself.
-                self.group.publish_load_gauges()
+                rates = self.group.publish_load_gauges()
+                # Skew actuation on the same clock: nominate/demote hot
+                # keys, then migrate vnodes if imbalance survives the
+                # replication (both no-ops when not armed).
+                if self.replicator is not None:
+                    self.replicator.tick()
+                if self.rebalancer is not None:
+                    self.rebalancer.tick(rates)
 
     def _reply_json(self, conn: socket.socket, msg: Message,
                     reply_type: int, payload: Dict) -> None:
@@ -315,6 +345,8 @@ class FleetRouter:
     def close(self) -> None:
         self._running = False
         self._sweep_stop.set()
+        if self.rebalancer is not None:
+            self.rebalancer.close()
         try:
             self._listener.close()
         except OSError:

@@ -16,8 +16,8 @@ See docs/SERVING.md for architecture and tuning.
 
 from multiverso_tpu.serving.batcher import (BucketLadder, DynamicBatcher,
                                             ServeRequest, ShedError)
-from multiverso_tpu.serving.cache import (HotRowCache, StampedRows,
-                                          cache_from_flags)
+from multiverso_tpu.serving.cache import (CacheAutosizer, HotRowCache,
+                                          StampedRows, cache_from_flags)
 from multiverso_tpu.serving.client import (ReplicaUnavailableError,
                                            RoutedLookupClient, ServeResult,
                                            ServingClient,
@@ -38,7 +38,8 @@ from multiverso_tpu.serving.runners import (AttentionLMRunner,
 from multiverso_tpu.serving.service import ServingService
 
 __all__ = [
-    "AttentionLMRunner", "BucketLadder", "CheckpointReplica",
+    "AttentionLMRunner", "BucketLadder", "CacheAutosizer",
+    "CheckpointReplica",
     "ContinuousBatcher", "DispatchPipeline", "DynamicBatcher",
     "HotRowCache", "PagePlan", "PagePool", "PrefixStore",
     "ReplicaLookupRunner", "ReplicaSnapshot",

@@ -406,7 +406,10 @@ class DynamicBatcher:
                 idle = not self._queue and not self._busy
             if idle and (self._pipeline is None or self._pipeline.empty()):
                 return True
-            time.sleep(0.002)
+            # Deliberately tight + constant: quiesce hunts a transient
+            # quiet instant under live traffic; backing off would make
+            # it MISS the gap it is waiting for.
+            time.sleep(0.002)  # graftlint: disable=poll-loop-no-backoff
         return False
 
     def _gather_batch(self) -> Optional[List[ServeRequest]]:

@@ -33,7 +33,8 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Optional, Tuple
+import time
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -69,6 +70,10 @@ class HotRowCache:
     def __init__(self, capacity: int, staleness: int = 0):
         self.capacity = max(1, int(capacity))
         self.staleness = max(0, int(staleness))
+        #: Observed bytes per cached row (value bytes + stamp/key
+        #: overhead), learned from the first insert — what converts the
+        #: autosizer's -serve_cache_mem_budget into a row bound.
+        self.row_nbytes = 0
         self._lock = threading.Lock()
         self._rows: "collections.OrderedDict[int, Tuple[float, np.ndarray]]" \
             = collections.OrderedDict()
@@ -135,6 +140,9 @@ class HotRowCache:
         memcpy."""
         stamped = [(int(k), (float(clock), np.array(row, copy=True)))
                    for k, row in zip(keys, rows)]
+        if stamped and not self.row_nbytes:
+            # ~48 bytes of per-entry bookkeeping (dict slot + stamp).
+            self.row_nbytes = int(stamped[0][1][1].nbytes) + 48
         with self._lock:
             for k, entry in stamped:
                 self._rows[k] = entry
@@ -150,15 +158,123 @@ class HotRowCache:
             self._rows.clear()
             self._g_rows.set(0)
 
+    def resize(self, capacity: int) -> None:
+        """Change the row bound in place (the autosizer's actuation);
+        a shrink evicts LRU-first immediately so the memory comes back
+        now, not at the next insert."""
+        with self._lock:
+            self.capacity = max(1, int(capacity))
+            while len(self._rows) > self.capacity:
+                self._rows.popitem(last=False)
+            self._g_rows.set(len(self._rows))
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._rows)
 
 
+class CacheAutosizer:
+    """Advisor-driven ``-serve_cache_rows`` auto-sizing within a byte
+    budget (docs/DESIGN.md "Skew actuation", leg 3).
+
+    Rides the sketch hub's headroom-advisor tick
+    (:meth:`~multiverso_tpu.telemetry.sketch.SketchHub.register_autosizer`):
+    each advice window it applies the supervisor's hysteresis/cooldown
+    discipline to two signals the advisor already computes —
+
+    * **grow** when ``predicted_hit_rate_2x - predicted_hit_rate >=
+      grow_gain`` for ``windows`` consecutive ticks: the stream's
+      frequency CDF says doubling capacity buys real hit rate. Doubles,
+      clamped to ``mem_budget // row_nbytes`` rows.
+    * **shrink** when occupancy has stayed under half of capacity for
+      ``windows`` ticks (the LRU never fills the grant — halving is
+      free), or immediately when the budget itself says so (row bytes
+      learned bigger than assumed). Halves, floored at ``min_rows``.
+
+    Metrics: ``serve.cache.autosize.capacity`` / ``.grows`` /
+    ``.shrinks`` / ``.budget_rows`` (docs/OBSERVABILITY.md)."""
+
+    def __init__(self, cache: HotRowCache, mem_budget: int,
+                 surface: str = "serve.lookup", grow_gain: float = 0.02,
+                 windows: int = 3, cooldown_s: float = 5.0,
+                 min_rows: int = 64):
+        self.cache = cache
+        self.mem_budget = int(mem_budget)
+        self.grow_gain = float(grow_gain)
+        self.windows = max(1, int(windows))
+        self.cooldown_s = float(cooldown_s)
+        self.min_rows = max(1, int(min_rows))
+        self._grow_streak = 0
+        self._shrink_streak = 0
+        self._last_action = -float("inf")
+        self._g_capacity = gauge("serve.cache.autosize.capacity")
+        self._g_budget_rows = gauge("serve.cache.autosize.budget_rows")
+        self._c_grows = counter("serve.cache.autosize.grows")
+        self._c_shrinks = counter("serve.cache.autosize.shrinks")
+        self._g_capacity.set(cache.capacity)
+        get_sketch_hub().register_autosizer(surface, self.on_advice)
+
+    def budget_rows(self) -> Optional[int]:
+        """The budget as a row bound; None until a row's bytes are
+        observed (no guessing — an unsized cache never resizes)."""
+        if self.cache.row_nbytes <= 0:
+            return None
+        return max(self.min_rows, self.mem_budget // self.cache.row_nbytes)
+
+    def on_advice(self, advice: Dict,
+                  now: Optional[float] = None) -> Optional[str]:
+        """One hysteresis step per advisor tick; returns the action
+        taken (``"grow"``/``"shrink"``) or None. Deterministic given
+        ``now`` — the tier-1 tests drive it with a fake clock."""
+        now = time.monotonic() if now is None else now
+        bound = self.budget_rows()
+        if bound is None:
+            return None
+        self._g_budget_rows.set(bound)
+        capacity = self.cache.capacity
+        if capacity > bound:
+            # The budget is a hard ceiling, not advice: clamp now.
+            return self._apply(bound, now, grew=False)
+        gap = float(advice.get("predicted_hit_rate_2x", 0.0)) \
+            - float(advice.get("predicted_hit_rate", 0.0))
+        if gap >= self.grow_gain and capacity < bound:
+            self._grow_streak += 1
+            self._shrink_streak = 0
+            if self._grow_streak >= self.windows \
+                    and now - self._last_action >= self.cooldown_s:
+                return self._apply(min(capacity * 2, bound), now,
+                                   grew=True)
+            return None
+        self._grow_streak = 0
+        if len(self.cache) <= capacity // 2 \
+                and capacity > self.min_rows:
+            self._shrink_streak += 1
+            if self._shrink_streak >= self.windows \
+                    and now - self._last_action >= self.cooldown_s:
+                return self._apply(max(capacity // 2, self.min_rows),
+                                   now, grew=False)
+        else:
+            self._shrink_streak = 0
+        return None
+
+    def _apply(self, capacity: int, now: float, grew: bool
+               ) -> Optional[str]:
+        if capacity == self.cache.capacity:
+            return None
+        self.cache.resize(capacity)
+        self._grow_streak = self._shrink_streak = 0
+        self._last_action = now
+        self._g_capacity.set(capacity)
+        (self._c_grows if grew else self._c_shrinks).inc()
+        return "grow" if grew else "shrink"
+
+
 def cache_from_flags() -> Optional[HotRowCache]:
     """Build the cache the ``-serve_cache_rows`` / ``-serve_cache_staleness``
     flags describe (None when disabled — the default: live-table serving
-    opts into staleness, it never inherits it silently)."""
+    opts into staleness, it never inherits it silently). A positive
+    ``-serve_cache_mem_budget`` arms the :class:`CacheAutosizer`, kept
+    alive as ``cache.autosizer``."""
     from multiverso_tpu.utils.configure import get_flag
     try:
         capacity = int(get_flag("serve_cache_rows"))
@@ -167,4 +283,11 @@ def cache_from_flags() -> Optional[HotRowCache]:
         return None
     if capacity <= 0:
         return None
-    return HotRowCache(capacity, staleness)
+    cache = HotRowCache(capacity, staleness)
+    try:
+        budget = int(get_flag("serve_cache_mem_budget"))
+    except Exception:  # noqa: BLE001 - older flag sets lack the budget
+        budget = 0
+    if budget > 0:
+        cache.autosizer = CacheAutosizer(cache, budget)
+    return cache

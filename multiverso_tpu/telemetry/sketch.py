@@ -394,6 +394,7 @@ class SketchHub:
         self._buffers: List[Tuple[threading.Thread, list]] = []
         self._tl = threading.local()
         self._advisors: Dict[str, Callable[[], Dict]] = {}
+        self._autosizers: Dict[str, Callable[[Dict], None]] = {}
         #: Per-surface (keys, bytes) publication watermark: counters inc
         #: by sketch-total minus watermark at flush, so an overflow fold
         #: on a recording thread (no publication) is still counted
@@ -525,6 +526,15 @@ class SketchHub:
         with self._lock:
             self._advisors[surface] = feed
 
+    def register_autosizer(self, surface: str,
+                           cb: Callable[[Dict], None]) -> None:
+        """Attach an actuation callback to a surface's advisor: after
+        each advice publication ``cb`` receives the advice dict (with
+        ``measured_hit_rate`` merged in). The cache autosizer
+        (``serving/cache.py``) closes the sense->act loop here."""
+        with self._lock:
+            self._autosizers[surface] = cb
+
     def advise(self, surface: str, capacity: int) -> Dict:
         """The advisor computation itself: the frequency CDF's predicted
         hit rate for a ``capacity``-row cache on this surface's stream."""
@@ -566,6 +576,13 @@ class SketchHub:
         # *_2x gauge says whether doubling -serve_cache_rows would buy
         # anything at all.
         gauge("serve.cache.advisor.gap").set(predicted - measured)
+        with self._lock:
+            autosizer = self._autosizers.get(surface)
+        if autosizer is not None:
+            try:
+                autosizer({**advice, "measured_hit_rate": measured})
+            except Exception:  # noqa: BLE001 - actuation must not kill flush
+                counter("serve.cache.autosize.errors").inc()
 
     # -- views ---------------------------------------------------------------
     def surfaces(self) -> List[str]:
@@ -606,6 +623,7 @@ class SketchHub:
         with self._lock:
             self._sketches.clear()
             self._advisors.clear()
+            self._autosizers.clear()
             self._published.clear()
             for _, buf in self._buffers:
                 del buf[:]

@@ -455,3 +455,39 @@ define_double("fleet_imbalance_ratio", 1.7, "p99-to-mean per-replica "
 define_double("fleet_imbalance_min_keys", 100.0, "minimum fleet-wide "
               "keys/sec before the shard-imbalance rule may fire (an "
               "idle fleet's noise must not page)")
+# Skew actuation: hot-key replication + vnode drain-and-handoff
+# rebalancing (fleet/rebalance.py; docs/DESIGN.md "Skew actuation").
+define_int("fleet_hotkey_replicas", 0, "EXTRA ring owners each confident "
+           "hot key is replicated to (0 = off): the router nominates the "
+           "Space-Saving top-K confident heavy hitters from the merged "
+           "heartbeat sketches; writes fan out with freshness stamps and "
+           "reads pick any replica whose step satisfies the HotRowCache "
+           "staleness rule, falling back to the home owner")
+define_bool("fleet_rebalance", False, "arm the router's vnode "
+            "drain-and-handoff rebalancer: when fleet.shard_load_ratio "
+            "stays at/over -fleet_rebalance_ratio for "
+            "-fleet_rebalance_windows consecutive sweeps (a hot RANGE "
+            "replication can't spread), ownership of the hottest "
+            "member's busiest vnode arcs migrates to the coldest member "
+            "via drain -> transfer -> announce; clients park-and-retry "
+            "through the version flip exactly as through shard recovery")
+define_double("fleet_rebalance_ratio", 1.5, "sustained p99-to-mean "
+              "key-rate ratio at/over which the rebalancer acts (kept "
+              "BELOW -fleet_imbalance_ratio so actuation starts before "
+              "the alert pages)")
+define_int("fleet_rebalance_windows", 3, "consecutive bad sweep windows "
+           "before a migration (hysteresis: one noisy window never "
+           "moves ownership)")
+define_double("fleet_rebalance_cooldown_s", 10.0, "minimum seconds "
+              "between vnode migrations (anti-flap, the supervisor's "
+              "cooldown discipline)")
+define_int("fleet_rebalance_vnodes", 4, "vnode arcs migrated per "
+           "rebalance action (small steps: each migration moves "
+           "~vnodes/(members*-fleet_vnodes) of the keyspace)")
+# Advisor-driven hot-row cache auto-sizing (serving/cache.py).
+define_int("serve_cache_mem_budget", 0, "cache autosizer byte budget "
+           "(0 = autosizing off): the cache-headroom advisor's "
+           "predicted_hit_rate_2x gauge grows -serve_cache_rows when "
+           "doubling would pay and shrinks it when the marginal rows "
+           "don't, never exceeding this many bytes of cached rows "
+           "(hysteresis + cooldown so capacity never flaps)")

@@ -70,7 +70,16 @@ def comparable_key(record):
             int(cfg.get("max_batch", 0) or 0),
             int(cfg.get("cache_rows", 0) or 0),
             float(cfg.get("hot_frac", 0) or 0),
-            float(cfg.get("zipf", 0) or 0))
+            float(cfg.get("zipf", 0) or 0),
+            # Skew actuators (ISSUE 17): replication changes which
+            # member serves a hot read and rebalancing moves vnode
+            # ownership mid-run — both shift achieved QPS for
+            # non-code reasons, so the FIRST actuator-enabled record
+            # must abstain against pre-actuator history, not gate.
+            # Falsy defaults keep every pre-17 record's key identical.
+            int(cfg.get("hotkey_replicas", 0) or 0),
+            int(bool(cfg.get("rebalance", False))),
+            int(cfg.get("cache_mem_budget", 0) or 0))
 
 
 def box_fingerprint(record):
@@ -133,6 +142,18 @@ def _fake(qps, benchmark="serve_lookup", cores=4, rows=1000):
             "config": {"replicas": 0, "dry_run": False, "rows": rows}}
 
 
+def _rebal(qps):
+    r = _fake(qps)
+    r["config"]["rebalance"] = True
+    return r
+
+
+def _hotkey(qps):
+    r = _fake(qps)
+    r["config"]["hotkey_replicas"] = 1
+    return r
+
+
 def self_test():
     """--dry-run: exercise the three gate outcomes on synthetic history
     written through the real file path (the tier-1 smoke drives this)."""
@@ -149,6 +170,13 @@ def self_test():
          + [_fake(400.0)], "warn_box_mismatch"),
         ("too little history abstains",
          steady[:2] + [_fake(400.0)], "insufficient_history"),
+        ("first rebalance-enabled record abstains",
+         steady + [_rebal(400.0)], "insufficient_history"),
+        ("first hot-key-replicated record abstains",
+         steady + [_hotkey(400.0)], "insufficient_history"),
+        ("rebalance-enabled history gates rebalance-enabled runs",
+         [_rebal(q) for q in (500.0, 510.0, 495.0, 505.0)]
+         + [_rebal(400.0)], "regression"),
     ]
     failures = 0
     for name, records, want in cases:

@@ -1021,7 +1021,8 @@ def _tracing_block(args, tdir: str, qps_traced: float,
 # Fleet mode: router AND replicas as subprocesses (three distinct pids on
 # the data path — the stitched traces prove client -> router -> replica)
 # ---------------------------------------------------------------------------
-def _spawn_router(args, tdir: str, addr_file: str) -> subprocess.Popen:
+def _spawn_router(args, tdir: str, addr_file: str,
+                  port: int = 0) -> subprocess.Popen:
     lifetime = args.duration * 3 + 300  # three load windows
     cmd = [sys.executable, "-m", "multiverso_tpu.apps.fleet_main",
            "-fleet_role=router",
@@ -1037,6 +1038,20 @@ def _spawn_router(args, tdir: str, addr_file: str) -> subprocess.Popen:
            "-telemetry_alerts=true", "-telemetry_flight=true",
            "-telemetry_ts_interval=0.25",
            "-serve_device=cpu"]
+    if port:
+        # The router-kill round respawns on the SAME port so replicas
+        # and clients reconnect through connect_with_backoff unchanged.
+        cmd.append(f"-fleet_port={port}")
+    if getattr(args, "hotkey_replicas", 0):
+        cmd.append(f"-fleet_hotkey_replicas={args.hotkey_replicas}")
+    if getattr(args, "rebalance", False):
+        # Drill-friendly knobs: the imbalance streak + cooldown must fit
+        # inside one bench window, not an operator's steady state.
+        cmd += ["-fleet_rebalance=true",
+                "-fleet_rebalance_ratio=1.4",
+                "-fleet_rebalance_windows=2",
+                "-fleet_rebalance_cooldown_s=2.0",
+                "-fleet_rebalance_vnodes=8"]
     return subprocess.Popen(cmd, cwd=_REPO)
 
 
@@ -1060,6 +1075,7 @@ def _spawn_replica(args, router_addr, idx: int,
            f"-serve_pipeline_depth={args.pipeline_depth}",
            f"-serve_cache_rows={args.cache_rows}",
            f"-serve_cache_staleness={args.cache_staleness}",
+           f"-serve_cache_mem_budget={getattr(args, 'cache_mem_budget', 0)}",
            f"-serve_duration={lifetime}",
            f"-telemetry_dir={tdir}",
            "-telemetry_interval=2",
@@ -1323,6 +1339,318 @@ def _skew_drill(args, fleet, router_addr) -> dict:
     result.setdefault("fired", False)
     result["skewed_requests"] = n
     return result
+
+
+def _rebalance_drill(args, fleet, router_addr) -> dict:
+    """Skew SELF-HEALING witness (ISSUE 17) — the actuation half of the
+    PR-14 detection drill: drive the same fully-skewed stream (every
+    request carries one fixed key set, so ring affinity lands it all on
+    one owner) and keep it running while the router's actuators respond
+    — hot-key replication spreads the confident hot keys over extra
+    ring owners (clients round-robin replicated reads), and the
+    rebalancer migrates vnode arcs off the hot owner if imbalance
+    persists. PASS = the actuators ENGAGED (keys replicated or arcs
+    migrated) and ``fleet.shard_load_ratio`` sits under the 1.3 bar
+    after a sustained skewed window, with ZERO client errors for the
+    whole drill (replication is pure routing; migration drains through
+    the zero-downtime hot-swap lifecycle)."""
+    from multiverso_tpu.fleet import fetch_fleet_stats
+
+    hot = np.arange(min(args.keys_per_req, 8), dtype=np.int32)
+    stop = threading.Event()
+    errors = [0]
+    n_req = [0]
+    last_error = [""]
+
+    def load():
+        while not stop.is_set():
+            try:
+                fleet.lookup(hot, deadline_ms=max(args.deadline_ms, 500),
+                             timeout=30)
+            except Exception as exc:  # noqa: BLE001 - every failure
+                errors[0] += 1        # counts: the witness claims ZERO
+                last_error[0] = f"{type(exc).__name__}: {exc}"[:200]
+            n_req[0] += 1
+
+    loaders = [threading.Thread(target=load, daemon=True)
+               for _ in range(2)]
+    for t in loaders:
+        t.start()
+    t0 = time.monotonic()
+    deadline = t0 + (45.0 if args.dry_run else 90.0)
+    min_run_s = 8.0     # the ratio must HOLD under sustained skew, not
+    worst = 1.0         # just read low before the stream ramped
+    healed = False
+    last: dict = {}
+    path: list = []
+    while time.monotonic() < deadline:
+        try:
+            st = fetch_fleet_stats(router_addr)
+        except Exception:  # noqa: BLE001 - router busy under load
+            time.sleep(0.5)
+            continue
+        last = st
+        f = st.get("fleet", {})
+        ratio = float(f.get("shard_load_ratio", 1.0))
+        path.append(round(ratio, 2))
+        worst = max(worst, ratio)
+        engaged = (int(f.get("hotkey_replicated", 0)) > 0
+                   or int((f.get("rebalance") or {})
+                          .get("overrides", 0)) > 0)
+        if engaged and ratio < 1.3 and time.monotonic() - t0 >= min_run_s:
+            healed = True
+            break
+        time.sleep(0.5)
+    stop.set()
+    for t in loaders:
+        t.join(timeout=60)
+    f = last.get("fleet", {})
+    return {
+        "healed": healed,
+        "worst_ratio": round(worst, 3),
+        "final_ratio": round(float(f.get("shard_load_ratio", 0.0)), 3),
+        "ratio_path": path[-40:],
+        "hotkey_replicated": int(f.get("hotkey_replicated", 0)),
+        "rebalance": f.get("rebalance", {}),
+        "client_errors": errors[0],
+        "last_client_error": last_error[0],
+        "skewed_requests": n_req[0],
+    }
+
+
+def _handoff_kill_probe(args, fleet, router_addr, procs, table) -> dict:
+    """Opportunistic SIGKILL-mid-handoff probe: keep the skew up so the
+    rebalancer starts another migration, and the moment the stats
+    rollup shows one in flight, SIGKILL the donor replica. The fleet
+    must keep serving bitwise-correct rows (full-copy replicas:
+    ownership moved to the target BEFORE the donor died; acked-write
+    durability through the same window is the WAL-through-migration
+    witness in tests/test_rebalance.py). Migration windows are short on
+    a quiet box, so catching one is best effort — ``caught`` records
+    whether the kill landed mid-flight."""
+    from multiverso_tpu.fleet import fetch_fleet_stats
+
+    hot = np.arange(min(args.keys_per_req, 8), dtype=np.int32)
+    stop = threading.Event()
+
+    def load():
+        while not stop.is_set():
+            try:
+                fleet.lookup(hot, deadline_ms=1000, timeout=30)
+            except Exception:  # noqa: BLE001 - volume, not cleanliness
+                pass
+
+    loader = threading.Thread(target=load, daemon=True)
+    loader.start()
+    victim = None
+    deadline = time.monotonic() + 20.0
+    try:
+        while time.monotonic() < deadline and victim is None:
+            try:
+                st = fetch_fleet_stats(router_addr, timeout_s=5)
+            except Exception:  # noqa: BLE001 - router busy under load
+                time.sleep(0.1)
+                continue
+            for rid, row in st.get("replicas", {}).items():
+                if int(row.get("migrations", 0)) > 0:
+                    idx = int(rid.rsplit("-", 1)[-1])
+                    if idx < len(procs) and procs[idx].poll() is None:
+                        victim = rid
+                        procs[idx].kill()
+                        break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        loader.join(timeout=30)
+    if victim is None:
+        return {"caught": False}
+    time.sleep(1.0)     # let the sweep take the corpse out of the ring
+    ok = _parity_check(fleet, table, args.rows, args.keys_per_req)
+    return {"caught": True, "killed": victim,
+            "post_kill_parity": bool(ok)}
+
+
+def _rebalance_ab(args, tdir) -> dict:
+    """Static-vs-actuated A/B on the SAME fully-skewed stream (ISSUE 17
+    headline): two fresh mini-fleets run back to back on the quiet
+    post-teardown box — leg A with the actuators off (ring affinity
+    concentrates the hot set on one owner, the others idle), leg B with
+    hot-key replication + rebalancing on — and one record carries both
+    achieved-QPS legs. The actuated leg ends with the mid-handoff kill
+    probe."""
+    from multiverso_tpu.fleet import FleetClient, fetch_fleet_stats
+
+    rng = np.random.default_rng(0)
+    table = rng.normal(size=(args.rows, args.cols)).astype(np.float32)
+    hot = np.arange(min(args.keys_per_req, 8), dtype=np.int32)
+    replicas = max(2, args.replicas)
+    legs: dict = {}
+    for name, actuated in (("static", False), ("actuated", True)):
+        a = argparse.Namespace(**vars(args))
+        a.rebalance = actuated
+        a.hotkey_replicas = (args.hotkey_replicas or 1) if actuated else 0
+        # The actuated leg is the WHOLE closed loop, cache leg included:
+        # with a byte budget set, give the autosizer a seed capacity so
+        # the replicated hot set also serves host-side. (On a 1-core CI
+        # box replication alone can't raise box-bound QPS — spreading
+        # load across processes sharing one core is throughput-neutral;
+        # the cache leg is what cuts per-request work.)
+        if actuated and args.cache_mem_budget and not args.cache_rows:
+            a.cache_rows = 256
+        a.slo_drill = False     # _spawn_replica reads it; no skewed SLO
+        sub = os.path.join(tdir, f"ab_{name}")
+        os.makedirs(sub, exist_ok=True)
+        addr_file = os.path.join(sub, "router_addr")
+        router = _spawn_router(a, sub, addr_file)
+        procs: list = []
+        fleet = None
+        try:
+            addr = _wait_addr_file(addr_file, [router])
+            procs = [_spawn_replica(a, addr, i, sub)
+                     for i in range(replicas)]
+            # Hedge OFF: under saturation adaptive hedging would itself
+            # spread the hot set to the idle replica and mask the very
+            # contrast the A/B measures (routing policy, nothing else).
+            fleet = FleetClient(addr, hedge="off",
+                                refresh_s=a.heartbeat_ms / 1e3,
+                                hot_staleness=float(a.cache_staleness))
+            deadline = time.monotonic() + 240
+            while len(fleet.refresh().members) < replicas:
+                if any(p.poll() is not None for p in procs) \
+                        or router.poll() is not None:
+                    raise RuntimeError("A/B fleet exited during bring-up")
+                if time.monotonic() > deadline:
+                    raise RuntimeError("A/B fleet never formed")
+                time.sleep(0.05)
+            for _ in range(10):     # warm connections + decode path
+                fleet.lookup(hot, deadline_ms=10_000, timeout=60)
+            # Give the actuated leg's replicator a skewed baseline to
+            # promote from BEFORE the timed window — the A/B measures
+            # actuated steady state, not promotion latency.
+            settle = time.monotonic() + (4.0 if actuated else 0.5)
+            while time.monotonic() < settle:
+                try:
+                    fleet.lookup(hot, deadline_ms=10_000, timeout=60)
+                except Exception:  # noqa: BLE001 - settle is best effort
+                    pass
+            # Offer well past one owner's capacity: the static leg must
+            # SATURATE on its single affinity owner for the actuated
+            # leg's extra owners to show up as achieved QPS.
+            stats = _LoadStats()
+            elapsed = _run_fleet_load(
+                fleet, stats, max(args.threads, 8), args.qps * 4,
+                max(4.0, args.duration / 2), args.rows,
+                args.keys_per_req, max(args.deadline_ms, 200),
+                lambda _rng: hot)
+            st = {}
+            try:
+                st = fetch_fleet_stats(addr)
+            except Exception:  # noqa: BLE001 - leg stats are additive
+                pass
+            fb = st.get("fleet", {})
+            with stats.lock:
+                legs[name] = {
+                    "achieved_qps":
+                        round(len(stats.latencies) / elapsed, 1)
+                        if elapsed > 0 else 0.0,
+                    "n_ok": len(stats.latencies),
+                    "n_shed": stats.sheds,
+                    "n_error": stats.errors,
+                    "shard_load_ratio":
+                        round(float(fb.get("shard_load_ratio", 0.0)), 3),
+                    "hotkey_replicated":
+                        int(fb.get("hotkey_replicated", 0)),
+                    "rebalance": fb.get("rebalance", {}),
+                }
+            if actuated:
+                legs[name]["kill_mid_handoff"] = _handoff_kill_probe(
+                    a, fleet, addr, procs, table)
+        finally:
+            if fleet is not None:
+                fleet.close()
+            _shutdown_procs(procs + [router])
+    a_qps = legs["static"]["achieved_qps"]
+    b_qps = legs["actuated"]["achieved_qps"]
+    legs["qps_ratio"] = round(b_qps / a_qps, 3) if a_qps > 0 else None
+    # Box honesty (the bench_guard rule): spreading a hot set over more
+    # owners shows up as QPS only when there are cores for the extra
+    # owners to run on. On a 1-core CI box every process shares the one
+    # core, so qps_ratio ~ 1 is the physics and the actuation witness
+    # is the shard_load_ratio contrast instead (static ~2.0, actuated
+    # ~1.0 — same stream, load actually spread).
+    legs["box_cores"] = os.cpu_count() or 1
+    return legs
+
+
+def _router_kill_round(args, router_box, router_addr, addr_file,
+                       procs, tdir, fleet) -> dict:
+    """Control-plane kill round (ISSUE 17 chaos satellite): SIGKILL the
+    ROUTER under live lookup load, respawn it on the SAME port, and
+    require (a) every live replica rejoins — their heartbeat loops
+    re-dial through connect_with_backoff, (b) the client keeps serving
+    from its last routing table through the outage with errors confined
+    to the recovery window, and (c) routed reads answer normally
+    afterwards. The respawned router's version counter restarts; the
+    client's reconnected-feed handling must accept the regressed table
+    rather than route from the stale one forever."""
+    from multiverso_tpu.fleet import fetch_fleet_stats
+
+    live = [f"replica-{i}" for i, p in enumerate(procs)
+            if p.poll() is None]
+    stats = _LoadStats()
+    load_s = max(6.0, args.duration)
+    loader = threading.Thread(
+        target=_run_fleet_load,
+        args=(fleet, stats, args.threads, args.qps, load_s,
+              args.rows, args.keys_per_req, args.deadline_ms),
+        daemon=True)
+    loader.start()
+    time.sleep(load_s * 0.3)
+    t_kill = time.monotonic()
+    old = router_box[0]
+    old.kill()
+    try:
+        old.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        pass
+    # The respawn on the SAME port must not trip over a stale announce.
+    try:
+        os.remove(addr_file)
+    except OSError:
+        pass
+    router_box[0] = _spawn_router(args, tdir, addr_file,
+                                  port=router_addr[1])
+    rejoined, t_rec = False, None
+    deadline = time.monotonic() + 120
+    delay = 0.05
+    while time.monotonic() < deadline:
+        try:
+            st = fetch_fleet_stats(router_addr, timeout_s=5)
+            if all(m in st.get("replicas", {}) for m in live):
+                rejoined, t_rec = True, time.monotonic()
+                break
+        except Exception:  # noqa: BLE001 - port still closed mid-boot
+            pass
+        time.sleep(delay)
+        delay = min(delay * 2.0, 0.5)
+    loader.join(timeout=load_s + 120)
+    window_s = (args.liveness_misses * args.heartbeat_ms) / 1e3
+    t_end = (t_rec if t_rec is not None else time.monotonic()) + window_s
+    with stats.lock:
+        in_window = sum(1 for t in stats.error_times
+                        if t_kill <= t <= t_end)
+        outside = sum(1 for t in stats.error_times
+                      if not (t_kill <= t <= t_end))
+        window = {"n_ok": len(stats.latencies), "n_shed": stats.sheds,
+                  "n_error": stats.errors}
+    return {
+        "rejoined_all": rejoined,
+        "recovery_s": round(t_rec - t_kill, 3)
+        if t_rec is not None else None,
+        "errors_in_recovery_window": in_window,
+        "errors_outside_window": outside,
+        "window": window,
+    }
 
 
 def _await_postmortem(tdir: str, victim_pid: int,
@@ -1981,6 +2309,9 @@ def run_fleet(args) -> dict:
     addr_file = os.path.join(tdir, "router_addr")
 
     router_proc = _spawn_router(args, tdir, addr_file)
+    # Boxed so the chaos router-kill round can swap in the respawned
+    # handle and teardown still reaps the RIGHT process.
+    router_box = [router_proc]
     procs: list = []
     fleet = None
     record = None
@@ -1996,7 +2327,8 @@ def run_fleet(args) -> dict:
             else float(args.hedge)
         fleet = FleetClient(router_addr, hedge=hedge,
                             refresh_s=args.heartbeat_ms / 1e3,
-                            rpc_timeout_ms=args.rpc_timeout_ms or None)
+                            rpc_timeout_ms=args.rpc_timeout_ms or None,
+                            hot_staleness=float(args.cache_staleness))
         deadline = time.monotonic() + 240
         while len(fleet.refresh().members) < args.replicas:
             if any(p.poll() is not None for p in procs) \
@@ -2124,6 +2456,14 @@ def run_fleet(args) -> dict:
         if args.skew_drill:
             skew = _skew_drill(args, fleet, router_addr)
 
+        # Skew self-heal drill (ISSUE 17): same stream shape, but now
+        # the router's actuators are expected to CLOSE the loop the
+        # skew drill only detects. Needs the actuators enabled.
+        rebal_heal = None
+        if args.rebalance_drill and args.replicas >= 2 \
+                and (args.rebalance or args.hotkey_replicas):
+            rebal_heal = _rebalance_drill(args, fleet, router_addr)
+
         # Recovery drill (ISSUE 15), replica leg — BEFORE the fault
         # drill, so the full fleet is alive: the kill is masked by
         # hedging/failover while the supervisor replaces the victim
@@ -2250,6 +2590,12 @@ def run_fleet(args) -> dict:
         chaos = None
         if args.chaos_drill:
             chaos = _chaos_drill(args, router_addr, procs, tdir, fleet)
+            # Control-plane leg AFTER the subset rounds (the serving
+            # supervisor is stopped by then — a router outage must not
+            # race a healer that reads membership through the router).
+            chaos["router_kill"] = _router_kill_round(
+                args, router_box, router_addr, addr_file, procs, tdir,
+                fleet)
 
         record = _make_record("serve_fleet_lookup", args, stats, elapsed,
                               _metric_families(("serve.", "fleet.")))
@@ -2257,6 +2603,8 @@ def run_fleet(args) -> dict:
             record["recovery"] = recovery
         if chaos is not None:
             record["chaos"] = chaos
+        if rebal_heal is not None:
+            record["rebalance"] = {"self_heal": rebal_heal}
         record["parity_ok"] = bool(parity_ok)
         record["replicas"] = args.replicas
         record["cpu_cores"] = os.cpu_count()
@@ -2333,11 +2681,17 @@ def run_fleet(args) -> dict:
             fleet.close()
         # Graceful stop so every process flushes its final trace — the
         # stitch below reads what they wrote.
-        _shutdown_procs(procs + [router_proc])
+        _shutdown_procs(procs + [router_box[0]])
     if record.get("recovery") is not None:
         # PS-side durability legs on the now-quiet box (see above).
         record["recovery"]["wal"] = _wal_recovery_leg(args)
         record["recovery"]["wal_overhead"] = _wal_overhead_ab(args)
+    if args.rebalance_drill:
+        # Static-vs-actuated zipf A/B on the quiet box (same reasoning
+        # as the WAL legs: mini-fleets must not fight the main fleet
+        # for cores).
+        record.setdefault("rebalance", {})["ab"] = _rebalance_ab(args,
+                                                                 tdir)
     _export_local_trace(tdir)
     record["tracing"] = _tracing_block(args, tdir, record["achieved_qps"],
                                        qps_untraced)
@@ -2382,7 +2736,14 @@ def _make_record(benchmark: str, args, stats: _LoadStats,
         # faults/convergence/parity, zero_acked_loss, slow-disk seats)
         # plus the elastic worker leave+rejoin round; config grows
         # chaos_seed/chaos_rounds/rpc_timeout_ms.
-        "schema": "multiverso_tpu.bench_serve/v9",
+        # v10: + rebalance block (--rebalance-drill): skew self-heal
+        # witness (shard_load_ratio back under the imbalance bar with
+        # zero client errors while the skewed stream still runs) and
+        # the static-vs-actuated zipf A/B legs; chaos gains the
+        # router-kill round (SIGKILL the router, respawn on the same
+        # port, replicas + clients reconnect via connect_with_backoff);
+        # config grows hotkey_replicas/rebalance/cache_mem_budget.
+        "schema": "multiverso_tpu.bench_serve/v10",
         "benchmark": benchmark,
         "time_unix": time.time(),
         "box": {"cores": os.cpu_count(),
@@ -2467,6 +2828,22 @@ def main() -> int:
     p.add_argument("--replicas", type=int, default=0,
                    help="N>=1: fleet mode — router + N replica "
                    "subprocesses behind a hedged FleetClient")
+    p.add_argument("--hotkey-replicas", type=int, default=0,
+                   help="fleet mode: replicate each confident hot key "
+                   "to this many extra ring owners (router-side skew "
+                   "actuator; 0 = off)")
+    p.add_argument("--rebalance", action="store_true",
+                   help="fleet mode: enable vnode drain-and-handoff "
+                   "rebalancing when imbalance survives replication")
+    p.add_argument("--cache-mem-budget", type=int, default=0,
+                   help="per-replica hot-row cache memory budget in "
+                   "bytes: the sketch advisor auto-sizes "
+                   "-serve_cache_rows inside it (0 = fixed capacity)")
+    p.add_argument("--rebalance-drill", action="store_true",
+                   help="fleet mode: skew self-heal witness (actuators "
+                   "must bring shard_load_ratio back under the "
+                   "imbalance bar with zero client errors) plus the "
+                   "static-vs-actuated zipf A/B legs (ISSUE 17)")
     p.add_argument("--hedge", default="adaptive",
                    help="fleet hedge policy: adaptive|off|<ms>")
     p.add_argument("--heartbeat-ms", type=float, default=50.0)

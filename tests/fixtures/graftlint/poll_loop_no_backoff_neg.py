@@ -1,0 +1,38 @@
+"""Negative fixture: waits that back off, block on an Event, or are
+constant-cadence tickers (not waiting for anyone)."""
+import threading
+import time
+
+
+def wait_with_backoff(group, member, deadline):
+    delay = 0.01
+    while time.monotonic() < deadline:
+        if group.drains_completed(member):
+            return True
+        time.sleep(delay)           # variable delay: the owner grows it
+        delay = min(delay * 2.0, 1.0)
+    return False
+
+
+def wait_on_event(stop: threading.Event, group, member):
+    delay = 0.01
+    while not stop.wait(delay):     # Event-based: shutdown is immediate
+        if group.drains_completed(member):
+            return True
+        delay = min(delay * 2.0, 1.0)
+    return False
+
+
+class Ticker:
+    """A cadence loop doing work every interval — not a wait."""
+
+    def __init__(self):
+        self._running = True
+
+    def run(self):
+        while self._running:
+            self.work()
+            time.sleep(1.0)
+
+    def work(self):
+        pass

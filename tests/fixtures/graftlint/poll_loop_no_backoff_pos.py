@@ -1,0 +1,36 @@
+"""Positive fixture: constant-interval polls inside retry/convergence
+waits — the drain-wait shape the rebalancer had to get right."""
+import time
+
+
+def wait_deadline(group, member, deadline):
+    while time.monotonic() < deadline:
+        if group.drains_completed(member):
+            return True
+        time.sleep(0.01)  # expect: poll-loop-no-backoff
+    return False
+
+
+def wait_until_ready(service):
+    while not service.ready():
+        time.sleep(0.1)  # expect: poll-loop-no-backoff
+
+
+def wait_with_break(table, want):
+    while True:
+        if table.version() >= want:
+            break
+        time.sleep(0.05)  # expect: poll-loop-no-backoff
+
+
+class Drainer:
+    def wait_drained(self, member):
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if not self.is_draining(member):
+                return True
+            time.sleep(0.02)  # expect: poll-loop-no-backoff
+        return False
+
+    def is_draining(self, member):
+        return False

@@ -352,6 +352,35 @@ def test_fleet_client_lookup_parity(fleet_env):
         cli.close()
 
 
+def test_fleet_hot_key_replicated_reads_bitwise_fresh(fleet_env):
+    """E2E leg-1 witness (docs/DESIGN.md "Skew actuation"): replicate a
+    hot row set, round-robin the reads across its replicas, and every
+    reply is bitwise the table row — replication changes WHO serves a
+    hot key, never WHAT is served."""
+    router, services, members, data = fleet_env
+    rows = np.asarray([3, 77], np.int32)
+    ring = router.group.ring
+    router.group.set_hot_keys(
+        {int(r): ring.replica_set(int(r), 2) for r in rows})
+    cli = FleetClient(router.address, hedge="off", refresh_s=0.05,
+                      hot_staleness=1.0)
+    try:
+        deadline = time.monotonic() + 10.0
+        while not cli.routing().hot_replicas:
+            assert time.monotonic() < deadline, "hot keys never shipped"
+            time.sleep(0.05)
+        assert set(cli.routing().hot_replicas) == {int(r) for r in rows}
+        from multiverso_tpu.telemetry import counter
+        routed = counter("fleet.hotkey.routed")
+        base = routed.value
+        for _ in range(6):
+            got = cli.lookup(rows, deadline_ms=10_000, timeout=30)
+            np.testing.assert_array_equal(got, data[rows])
+        assert routed.value - base == 6
+    finally:
+        cli.close()
+
+
 def test_fleet_router_proxy_serves_plain_clients(fleet_env):
     router, services, members, data = fleet_env
     from multiverso_tpu.serving import ServingClient
@@ -703,6 +732,8 @@ def test_fleet_top_render_is_stable():
         "fleet": {"replicas": 2, "qps": 123.4, "shed_rate": 0.015,
                   "queue_depth": 3.0, "inflight": 2.0,
                   "slo_violations": 9, "alerts_active": 2,
+                  "hotkey_replicated": 3,
+                  "rebalance": {"overrides": 4, "migrations": 1},
                   "stages": {"total": {"p50": 1.0, "p95": 2.0,
                                        "p99": 3.0, "count": 10}}},
         "replicas": {
@@ -710,6 +741,7 @@ def test_fleet_top_render_is_stable():
                    "queue_depth": 1.0, "inflight": 1.0,
                    "slo_violations": 4, "drains_completed": 1,
                    "draining": False,
+                   "hot_replicated": 3, "migrations": 1,
                    "alerts": [{"name": "serve.slo_burn",
                                "severity": "page", "value": 3.2,
                                "for_s": 1.5}],
@@ -726,13 +758,16 @@ def test_fleet_top_render_is_stable():
     assert lines[0].startswith("fleet_top  v7")
     assert "qps=123.4" in lines[0]
     assert "alerts=2" in lines[0]
-    assert "ALERTS" in lines[1]
+    assert "ALERTS" in lines[1] and "REBAL" in lines[1]
     r0 = [l for l in lines if l.startswith("r0")][0]
     assert "up" in r0 and "1:serve.slo_b" in r0
+    # REBAL cell: replicated-key count + migrations in flight
+    assert "3/m1" in r0
     r1 = [l for l in lines if l.startswith("r1")][0]
     # no alerts key at all renders as the quiet cell, never a KeyError
     assert "drain" in r1 and r1.rstrip().endswith("-")
     assert lines[-1].startswith("FLEET")
+    assert "3/m1" in lines[-1]
     # router-scoped alerts (heartbeat loss) render on the FLEET row
     assert "1:fleet.heart" in lines[-1]
     # a missing stages dict renders as zeros, never a KeyError

@@ -43,7 +43,7 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert line["replicas"] == 2
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v9"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v10"
     assert record["replicas"] == 2
 
     # Routed lookups bitwise-equal to the direct table gather.
@@ -234,7 +234,7 @@ def test_serve_bench_chaos_drill_dry_run(tmp_path):
     assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v9"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v10"
     chaos = record["chaos"]
     assert chaos["seed"] == 16
     assert chaos["shards"] == 2
@@ -251,6 +251,14 @@ def test_serve_bench_chaos_drill_dry_run(tmp_path):
     assert chaos["zero_acked_loss"] is True, chaos["train_errors"]
     assert chaos["acked_adds"] > 0
     assert chaos["train_errors"] == []
+
+    # Router-kill round (ISSUE 17): SIGKILL the router under load,
+    # respawn on the same port — every replica must rejoin (heartbeat
+    # loops re-dial via connect_with_backoff) and client errors stay
+    # confined to the recovery window.
+    rk = chaos["router_kill"]
+    assert rk["rejoined_all"] is True, rk
+    assert rk["errors_outside_window"] == 0, rk
 
     # Elastic membership: join drained to the epoch floor, leave freed
     # the slot, the rejoin reused it, version advanced every step.

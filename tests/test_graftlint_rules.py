@@ -29,7 +29,8 @@ RULES = ("implicit-host-sync", "block-until-ready-in-loop",
          "lock-order-cycle", "unlocked-registry-mutation",
          "bare-thread-no-join", "bare-print", "unbounded-queue-append",
          "span-in-traced-fn", "daemon-loop-no-watchdog",
-         "unbounded-metric-name", "blocking-call-no-timeout")
+         "unbounded-metric-name", "blocking-call-no-timeout",
+         "poll-loop-no-backoff")
 
 
 def _expected_lines(path, rule):

@@ -30,7 +30,7 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     record = json.loads(out.read_text())
     # v9: + chaos block (--chaos-drill seeded kill-any-subset rounds);
     # config grows chaos_seed/chaos_rounds/rpc_timeout_ms
-    assert record["schema"] == "multiverso_tpu.bench_serve/v9"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v10"
     assert record["box"]["cores"] >= 1
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
