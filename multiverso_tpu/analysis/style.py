@@ -18,6 +18,13 @@ stands on, and a bare open-write-close publishes torn bytes at the final
 path on any crash mid-write. Durable writes must be tmp + fsync +
 atomic-rename (the ``utils/stream._AtomicLocalStream`` shape) and
 durable appends must fsync (the WAL group commit).
+
+``unattributed-wait`` polices the latency truth layer (ISSUE 18): on
+the serving/fleet hot paths, every place a request's wall clock can
+drain — condition waits, queue gets, sleeps, socket reads — must sit
+inside code that also emits a phase-ledger span, or the wait is
+invisible to the critical-path decomposition and shows up only as
+``latency.unattributed`` residual nobody can act on.
 """
 
 from __future__ import annotations
@@ -190,6 +197,100 @@ class NonAtomicDurableWrite(Rule):
                     "— journal records that never hit the platter are "
                     "silent acked-write loss on the next crash; group "
                     "commit with fsync (core/wal.py is the shape)")
+
+
+@register
+class UnattributedWait(Rule):
+    id = "unattributed-wait"
+    severity = "warning"
+    rationale = (
+        "A wait on the serving/fleet hot path (cv/Event .wait, queue "
+        ".get, time.sleep, socket recv/accept) with no phase-ledger "
+        "span in reach is wall-clock the critical-path decomposition "
+        "cannot attribute: the time a request spends there surfaces "
+        "only as latency.unattributed residual, and the conservation "
+        "check degrades for every trace that crosses it. Emit a span "
+        "around the wait (emit_span with the measured interval, the "
+        "serving pipeline's shape), or suppress with a reason when the "
+        "wait is control-plane idle time no request ever crosses "
+        "(daemon tickers, shutdown joins).")
+
+    #: The request hot-path planes the phase ledger covers.
+    _SCOPED = ("multiverso_tpu/serving/", "multiverso_tpu/fleet/")
+    #: Socket calls that park the thread until a peer acts.
+    _SOCK_WAITS = frozenset({"recv", "recv_into", "recvfrom", "accept"})
+    #: Span-emission evidence: the scope measures SOME interval into
+    #: the ledger/metrics plane, so the wait is attributed (or at
+    #: minimum deliberately accounted) rather than invisible.
+    _SPAN_CALLS = frozenset({"emit_span", "span"})
+
+    def _emits_span(self, scope: Optional[ast.AST],
+                    ctx: FileContext) -> bool:
+        for tree in ([scope] if scope is not None else [ctx.tree]):
+            for sub in ast.walk(tree):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                if isinstance(fn, ast.Name) and \
+                        ctx.aliases.get(fn.id, fn.id).rsplit(".", 1)[-1] \
+                        in self._SPAN_CALLS:
+                    return True
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr in self._SPAN_CALLS:
+                    return True
+                # histogram(...).observe(dt) is ledger evidence too:
+                # the unconditional serve.latency.* path measures the
+                # same interval the span would.
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr == "observe":
+                    return True
+        return False
+
+    def _wait_reason(self, node: ast.Call,
+                     ctx: FileContext) -> Optional[str]:
+        """Why this call parks the thread, or None."""
+        if astutil.resolve_name(node.func, ctx.aliases) == "time.sleep":
+            return "time.sleep"
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return None
+        if fn.attr == "wait":
+            return f".{fn.attr}()"
+        if fn.attr in self._SOCK_WAITS:
+            return f".{fn.attr}()"
+        if fn.attr == "get" and not node.args:
+            # Zero-positional .get() (possibly timeout=/block= kwargs)
+            # is a queue drain; dict .get(key) takes a positional.
+            recv = fn.value
+            if isinstance(recv, ast.Name) and \
+                    recv.id.lstrip("_")[:1].isupper():
+                return None     # Zoo.get()-style classmethod accessor
+            return ".get()"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.role == "script":
+            return      # benches pace themselves; no request rides them
+        if ctx.role == "package" and \
+                not any(s in ctx.rel for s in self._SCOPED):
+            return
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            why = self._wait_reason(node, ctx)
+            if why is None:
+                continue
+            scope = (astutil.enclosing_class(node)
+                     or astutil.enclosing_function(node))
+            if self._emits_span(scope, ctx):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{why} on a serving/fleet hot path with no "
+                "phase-ledger span in reach — this wait is invisible "
+                "to the critical-path decomposition and lands in "
+                "latency.unattributed; wrap it in emit_span (or "
+                "suppress with a reason if no request crosses it)")
 
 
 @register

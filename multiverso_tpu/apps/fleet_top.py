@@ -11,6 +11,8 @@ aggregate histogram.
     python -m multiverso_tpu.apps.fleet_top -fleet_router=127.0.0.1:7071
     python -m multiverso_tpu.apps.fleet_top -fleet_router=... \\
         -fleet_top_n=1            # one snapshot and exit (scripts, CI)
+    python -m multiverso_tpu.apps.fleet_top -fleet_router=... \\
+        -fleet_top_exemplars=true # + slowest-request phase ledgers
 """
 
 from __future__ import annotations
@@ -20,14 +22,17 @@ import time
 from typing import Dict, List
 
 from multiverso_tpu.apps._runner import fleet_config, run_app
-from multiverso_tpu.utils.configure import (define_double, define_int,
-                                            get_flag)
+from multiverso_tpu.utils.configure import (define_bool, define_double,
+                                            define_int, get_flag)
 from multiverso_tpu.utils.log import check, log
 
 define_double("fleet_top_interval", 1.0, "seconds between fleet_top "
               "stats refreshes")
 define_int("fleet_top_n", 0, "number of refreshes before exiting "
            "(0 = run until interrupted)")
+define_bool("fleet_top_exemplars", False, "append the fleet's merged "
+            "tail-exemplar table (slowest requests with their phase "
+            "ledgers) below the member table")
 
 _CLEAR = "\x1b[2J\x1b[H"
 
@@ -86,12 +91,19 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
     header = (f"{'MEMBER':24s} {'HEALTH':>7s} {'QPS':>8s} {'SHED%':>7s} "
               f"{'QUEUE':>6s} {'INFL':>5s} {'P50ms':>9s} {'P95ms':>9s} "
               f"{'P99ms':>9s} {'SLO':>6s} {'DRAINS':>6s} {'STATE':>8s} "
-              f"{'SKEW%':>6s} {'REBAL':>6s} {'ALERTS':>15s}")
+              f"{'BOUND':>8s} {'SKEW%':>6s} {'REBAL':>6s} {'ALERTS':>15s}")
     lines.append(header)
+    bounds: List[str] = []
     for mid in sorted(replicas):
         r = replicas[mid]
         total = r.get("stages", {}).get("total", {})
         state = "drain" if r.get("draining") else "up"
+        # Roofline verdict (ISSUE 18): the replica classifies its own
+        # serve plane (dispatch/host/wire/device/idle) and ships the
+        # verdict in its heartbeat.
+        bound = str((r.get("roofline") or {}).get("bound") or "-")
+        if bound != "-":
+            bounds.append(bound)
         lines.append(
             f"{mid[:24]:24s} {r.get('health', 0.0):7.3f} "
             f"{r.get('qps', 0.0):8.1f} "
@@ -103,6 +115,7 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
             f"{_fmt_ms(total.get('p99', 0.0))} "
             f"{r.get('slo_violations', 0):6d} "
             f"{r.get('drains_completed', 0):6d} {state:>8s} "
+            f"{bound:>8s} "
             f"{100 * r.get('skew', 0.0):6.1f} "
             f"{_fmt_rebal(r.get('hot_replicated', 0), r.get('migrations', 0)):>6s} "
             f"{_fmt_alerts(r.get('alerts')):>15s}")
@@ -113,6 +126,10 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
     # row: they are fleet-scoped, not any one member's. The FLEET SKEW%
     # cell shows the shard-load ratio instead: xR.RR = the hottest
     # shard serves R times the mean (the imbalance alert's input).
+    # FLEET BOUND cell: unanimous member verdict, else "mixed".
+    fleet_bound = "-"
+    if bounds:
+        fleet_bound = bounds[0] if len(set(bounds)) == 1 else "mixed"
     lines.append(
         f"{'FLEET':24s} {'':7s} {fleet.get('qps', 0.0):8.1f} "
         f"{100 * fleet.get('shed_rate', 0.0):7.2f} "
@@ -123,9 +140,34 @@ def render_stats(stats: Dict, clear: bool = False) -> str:
         f"{_fmt_ms(ftotal.get('p99', 0.0))} "
         f"{fleet.get('slo_violations', 0):6d} "
         f"{'':6s} {'n=%d' % fleet.get('replicas', 0):>8s} "
+        f"{fleet_bound:>8s} "
         f"{'x%.2f' % fleet.get('shard_load_ratio', 1.0):>6s} "
         f"{_fmt_rebal(fleet.get('hotkey_replicated', 0), rebal.get('migrations', 0)):>6s} "
         f"{_fmt_alerts(router_alerts):>15s}")
+    return "\n".join(lines)
+
+
+def render_exemplars(stats: Dict, n: int = 8) -> str:
+    """The fleet's merged tail-exemplar table: slowest requests across
+    all members with their phase ledgers (the heartbeat ships each
+    member's slowest few; the router merges and re-sorts). Pure
+    function, appended below the member table by -fleet_top_exemplars."""
+    ex = (stats.get("fleet") or {}).get("exemplars") or []
+    lines = [f"{'TRACE':34s} {'MEMBER':18s} {'TOTALms':>9s} "
+             f"{'AGEs':>6s}  PHASES (ms)"]
+    if not ex:
+        lines.append("(no exemplars: reservoirs empty or "
+                     "-telemetry_exemplars off)")
+        return "\n".join(lines)
+    for e in ex[:n]:
+        phases = e.get("phases") or {}
+        cells = " ".join(f"{k}={v:.2f}" for k, v in
+                         sorted(phases.items(), key=lambda kv: -kv[1]))
+        lines.append(
+            f"{(e.get('trace') or '-')[:34]:34s} "
+            f"{str(e.get('member', '-'))[:18]:18s} "
+            f"{e.get('total_ms', 0.0):9.2f} "
+            f"{e.get('age_s', 0.0):6.1f}  {cells}")
     return "\n".join(lines)
 
 
@@ -146,7 +188,10 @@ def main(argv=None) -> int:
                 stats = fetch_fleet_stats(cfg["router"])
                 # Clear only on live refresh: a single -fleet_top_n=1
                 # snapshot must stay pipeable (CI greps it).
-                log.raw("%s", render_stats(stats, clear=(n != 1)))
+                out = render_stats(stats, clear=(n != 1))
+                if get_flag("fleet_top_exemplars"):
+                    out += "\n\n" + render_exemplars(stats)
+                log.raw("%s", out)
                 shown += 1
                 if n and shown >= n:
                     return 0

@@ -523,6 +523,17 @@ class FleetClient:
                               outcome=type(result).__name__)
                 else:
                     emit_span("fleet.request", root, t0, ms, force=force)
+            # Tail exemplar (fleet plane): cheap threshold check first;
+            # the full phase ledger lives in the trace the id resolves.
+            from multiverso_tpu.telemetry.critical_path import \
+                get_reservoir
+            res = get_reservoir("fleet")
+            if res.would_admit(ms):
+                res.offer(
+                    ms, {},
+                    trace=root.trace_hex if root is not None else "",
+                    attempts=state["launched"],
+                    outcome=type(result).__name__ if failed else "ok")
             on_done(result)
 
         def settled(winner: int, launched: int):
@@ -574,7 +585,8 @@ class FleetClient:
     def lookup_async(self, rows, on_done: Callable,
                      deadline_ms: float = 100.0, split: bool = False,
                      runner_id: Optional[int] = None,
-                     _deadline: Optional[float] = None) -> None:
+                     _deadline: Optional[float] = None,
+                     _root=_UNSET) -> None:
         """Row lookup; ``on_done`` gets ``(values, clock)`` or exception,
         exactly once. ``split=True`` fans rows out to their ring owners
         and stitches replies back in request order."""
@@ -587,6 +599,12 @@ class FleetClient:
             # cache or shed — what key-affinity rebalancing re-shards by.
             record_keys("fleet.route", rows, rows.nbytes)
             _deadline = time.monotonic() + deadline_ms / 1e3
+        if _root is _UNSET:
+            # Resolve the trace root ONCE, before any park detour, so
+            # park spans and the eventual fleet.request/fleet.lookup
+            # land in the same trace (the scheduler thread that resumes
+            # a parked request has no ambient context to inherit).
+            _root = _resolve_root()
         if not len(table.ring):
             # Park-and-retry through the flip: mid-handoff (donor
             # draining, survivor health-scored 0 under the redirected
@@ -596,24 +614,34 @@ class FleetClient:
             # instead of failing a request the flip would have served.
             if time.monotonic() + 0.05 < _deadline:
                 self._c_parked.inc()
-                self._sched.call_later(
-                    0.05, lambda: self.lookup_async(
-                        rows, on_done, deadline_ms, split, runner_id,
-                        _deadline=_deadline))
+                t_park = time.monotonic()
+
+                def _resume(_rows=rows, _r=_root):
+                    # Phase ledger: the park detour is its own phase —
+                    # measured at resume so scheduler jitter is counted.
+                    if _r is not None and _r.sampled:
+                        emit_span("fleet.park", trace_context.child_of(_r),
+                                  t_park,
+                                  (time.monotonic() - t_park) * 1e3)
+                    self.lookup_async(_rows, on_done, deadline_ms, split,
+                                      runner_id, _deadline=_deadline,
+                                      _root=_r)
+                self._sched.call_later(0.05, _resume)
             else:
                 on_done(ReplicaUnavailableError(
                     "fleet has no live replicas"))
             return
         if not split or rows.size == 0:
             self.request_async(rows, self._affinity_pref(rows, table),
-                               on_done, deadline_ms, runner_id)
+                               on_done, deadline_ms, runner_id,
+                               trace_ctx=_root)
             return
         parts = table.ring.partition(rows.astype(np.int64))
         self._c_sub.inc(len(parts))
         # ONE trace for the whole split lookup: the sub-requests become
         # fleet.request children of this fleet.lookup root, so a stitched
         # trace shows the fan-out to every owner replica.
-        lroot = _resolve_root()
+        lroot = _root
         t0 = time.monotonic()
         state = {"remaining": len(parts), "out": None, "clock": None,
                  "done": False}

@@ -145,6 +145,21 @@ def metrics_payload() -> Dict:
     # per-replica rate and derives the fleet's shard-imbalance ratio.
     hub = get_sketch_hub()
     hub.flush()
+    # Attribution layer (telemetry/critical_path.py, roofline.py): this
+    # replica's slowest-request ledgers and its per-plane bound verdict
+    # ride the same heartbeat — fleet_top renders them without any new
+    # wire message. Both best-effort: attribution must never cost a
+    # heartbeat.
+    try:
+        from multiverso_tpu.telemetry.critical_path import exemplar_payload
+        exemplars = exemplar_payload("serve", n=4)
+    except Exception:  # noqa: BLE001 - additive section
+        exemplars = []
+    try:
+        from multiverso_tpu.telemetry.roofline import verdict
+        bound = verdict("serve")
+    except Exception:  # noqa: BLE001 - additive section
+        bound = {}
     # topn must cover the hot-key replicator's confident-set cap
     # (HotKeyReplicator topk=16): a key the heartbeat never ships can
     # never promote, and all-or-nothing hot routing needs EVERY row of
@@ -183,4 +198,6 @@ def metrics_payload() -> Dict:
         "slo_violations": slo_violations(
             reg.histogram("serve.latency.total"), slo_ms),
         "stages": stages,
+        "exemplars": exemplars,
+        "roofline": bound,
     }

@@ -432,6 +432,12 @@ class ReplicaGroup:
                 "slo_violations": int(met.get("slo_violations", 0)),
                 "alerts": list(met.get("alerts", [])),
                 "stages": dict(met.get("stages", {})),
+                # Attribution layer passthroughs (telemetry/
+                # critical_path.py, roofline.py): the replica's roofline
+                # verdict (fleet_top BOUND column) and its slowest-
+                # request phase ledgers (fleet_top --exemplars).
+                "roofline": dict(met.get("roofline", {}) or {}),
+                "exemplars": list(met.get("exemplars", []) or []),
             }
         fleet: Dict = {
             "replicas": len(per),
@@ -499,6 +505,13 @@ class ReplicaGroup:
             agg["p50"], agg["p95"], agg["p99"] = \
                 (round(w / n, 4) for w in agg.pop("_wp"))
         fleet["stages"] = stages
+        # Fleet-wide slowest-request ledgers: the per-member exemplar
+        # reservoirs merged slowest-first, each tagged with its member —
+        # "why was fleet p99 high" answered from one rollup read.
+        merged_ex = [dict(e, member=mid)
+                     for mid, p in per.items() for e in p["exemplars"]]
+        fleet["exemplars"] = sorted(
+            merged_ex, key=lambda e: -float(e.get("total_ms", 0.0)))[:8]
         from multiverso_tpu.telemetry import get_registry
         return {"schema": "multiverso_tpu.fleet_stats/v1",
                 "version": version,

@@ -204,6 +204,8 @@ class PSShardFleet:
                       f"ps shard {r} exited during bring-up")
                 check(time.monotonic() < deadline,
                       f"ps shard {r} never announced")
+                # bring-up convergence wait: serving hasn't started
+                # graftlint: disable=unattributed-wait
                 time.sleep(delay)
                 delay = min(delay * 2.0, 0.25)
             self.peers[r] = self._read_addr(r)
@@ -245,6 +247,8 @@ class PSShardFleet:
         while time.monotonic() < deadline:
             if len(self.membership_stats()["replicas"]) == self.shards:
                 return True
+            # membership convergence gate (chaos drill), control plane
+            # graftlint: disable=unattributed-wait
             time.sleep(delay)
             delay = min(delay * 2.0, 0.25)
         return False
@@ -278,6 +282,8 @@ class PSShardFleet:
                 h.terminate()
         for h in self._handles.values():
             try:
+                # teardown join on child exit, after serving stopped
+                # graftlint: disable=unattributed-wait
                 h.wait(timeout=15)
             except Exception:  # noqa: BLE001 - last resort on teardown
                 h.kill()

@@ -236,6 +236,9 @@ class FleetRebalancer:
             # poll-loop-no-backoff shape).
             deadline = time.monotonic() + self.drain_timeout_s
             delay = 0.01
+            # rebalancer drain-cycle wait: requests keep flowing on the
+            # donor's still-open serving path, none block here
+            # graftlint: disable=unattributed-wait
             while not self._stop.wait(delay):
                 done = self.group.drains_completed(donor)
                 if done is None:

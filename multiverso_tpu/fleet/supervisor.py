@@ -387,6 +387,8 @@ class ReplicaSupervisor:
 
     def _loop(self) -> None:
         with watchdog_scope("fleet-supervisor", timeout_s=120.0) as wd:
+            # supervisor ticker: control-plane cadence, not a request
+            # graftlint: disable=unattributed-wait
             while not self._stop.wait(self.poll_s):
                 wd.beat()
                 try:

@@ -149,6 +149,10 @@ class ServeRequest:
     on_done: Callable[[object], None]
     ctx: Optional[TraceContext] = None
     cancelled: bool = False
+    # Phase-ledger boundary (telemetry/critical_path.py): when admission
+    # work (validation + cache probe) finished and the request entered
+    # the queue. 0.0 = not stamped; readers fall back to t_submit.
+    t_enqueue: float = 0.0
 
 
 class _Future:
@@ -165,6 +169,9 @@ class _Future:
         self.event.set()
 
     def wait(self, timeout: Optional[float] = None):
+        # the caller's whole-residency wait: measured end-to-end by the
+        # root serve span + serve.latency.total, not a hidden phase
+        # graftlint: disable=unattributed-wait
         check(self.event.wait(timeout), "serve request timed out")
         result = self.slot[0]
         if isinstance(result, BaseException):
@@ -218,6 +225,7 @@ class DynamicBatcher:
         self._h_admit = histogram("serve.latency.admit")
         self._h_batch = histogram("serve.latency.batch")
         self._h_device = histogram("serve.latency.device")
+        self._h_dispatch = histogram("serve.latency.dispatch")
         self._worker = threading.Thread(target=self._loop,
                                         name="serve-batcher", daemon=True)
         self._worker.start()
@@ -289,6 +297,14 @@ class DynamicBatcher:
                            deadline=now + max(deadline_ms, 0.0) / 1e3,
                            t_submit=now, on_done=on_done,
                            ctx=current_context())
+        # Phase ledger: admission ends / queue begins HERE. Stamped
+        # before the enqueue so the worker can never observe the request
+        # without it; the admission span (validation + cache probe) is
+        # emitted only for sampled traces.
+        req.t_enqueue = time.monotonic()
+        if req.ctx is not None and req.ctx.sampled:
+            emit_span("serve.admission", child_of(req.ctx), now,
+                      (req.t_enqueue - now) * 1e3)
         shed: List[Tuple[ServeRequest, ShedError]] = []
         with self._cv:
             if not self._running:
@@ -508,8 +524,9 @@ class DynamicBatcher:
             # (device)? Unsampled/uncontexted requests skip at the flag
             # check — the emission cost rides only on sampled exemplars.
             if r.ctx is not None and r.ctx.sampled:
-                emit_span("serve.admit_wait", child_of(r.ctx), r.t_submit,
-                          (t0 - r.t_submit) * 1e3)
+                t_enq = r.t_enqueue or r.t_submit
+                emit_span("serve.admit_wait", child_of(r.ctx), t_enq,
+                          (t0 - t_enq) * 1e3)
                 emit_span("serve.batch_form", child_of(r.ctx), t0,
                           (t1 - t0) * 1e3, bucket=bucket, size=len(batch))
                 emit_span("serve.device", child_of(r.ctx), t1,
@@ -521,6 +538,7 @@ class DynamicBatcher:
                 log.error("serve batcher: result slice failed: %s", e)
                 result = ShedError("closed", f"runner error: {e}")
             self._safe_done(r, result)
+        self._offer_exemplars(batch, t0, t1, t2, t2, bucket)
 
     # -- pipelined dispatch (serving/pipeline.py) ---------------------------
     def _dispatch_batch(self, batch: List[ServeRequest]) -> None:
@@ -551,9 +569,13 @@ class DynamicBatcher:
                 self._safe_done(r, ShedError("closed",
                                              f"runner error: {e}"))
             return
+        # Phase ledger: dispatch (the async launch call) ends here; the
+        # stretch to the collector's pickup is device-window residency.
+        t_d = time.monotonic()
+        self._h_dispatch.observe((t_d - t1) * 1e3)
         item = InflightBatch(handle, self.runner.collect,
                              self._deliver_collected, len(batch),
-                             meta=(batch, lengths, bucket, t0, t1))
+                             meta=(batch, lengths, bucket, t0, t1, t_d))
         if not self._pipeline.submit(item):      # pipeline closed
             for r in batch:
                 self._safe_done(r, ShedError("closed",
@@ -565,8 +587,13 @@ class DynamicBatcher:
         """Collector-thread delivery for one pipelined batch: the result
         is the synced batch output, or the exception that killed
         collection (shed the whole batch — none delivered yet)."""
-        batch, lengths, bucket, t0, t1 = item.meta
+        batch, lengths, bucket, t0, t1, t_d = item.meta
         t2 = time.monotonic()
+        # Collector pickup stamp (serving/pipeline.py sets it right
+        # before calling collect): splits window residency (device) from
+        # the host-side sync (collect). Absent stamp -> zero-width
+        # collect, never a negative device phase.
+        t_c0 = getattr(item, "t_collect0", 0.0) or t2
         if isinstance(result, BaseException):
             for r in batch:
                 self._safe_done(r, ShedError("closed",
@@ -581,12 +608,17 @@ class DynamicBatcher:
         self._h_device.observe((t2 - t1) * 1e3)
         for r in batch:
             if r.ctx is not None and r.ctx.sampled:
-                emit_span("serve.admit_wait", child_of(r.ctx), r.t_submit,
-                          (t0 - r.t_submit) * 1e3)
+                t_enq = r.t_enqueue or r.t_submit
+                emit_span("serve.admit_wait", child_of(r.ctx), t_enq,
+                          (t0 - t_enq) * 1e3)
                 emit_span("serve.batch_form", child_of(r.ctx), t0,
                           (t1 - t0) * 1e3, bucket=bucket, size=len(batch))
-                emit_span("serve.device", child_of(r.ctx), t1,
-                          (t2 - t1) * 1e3, bucket=bucket, pipelined=1)
+                emit_span("serve.dispatch", child_of(r.ctx), t1,
+                          (t_d - t1) * 1e3, bucket=bucket)
+                emit_span("serve.device", child_of(r.ctx), t_d,
+                          (t_c0 - t_d) * 1e3, bucket=bucket, pipelined=1)
+                emit_span("serve.collect", child_of(r.ctx), t_c0,
+                          (t2 - t_c0) * 1e3, bucket=bucket)
         for i, r in enumerate(batch):
             try:
                 sliced = self.runner.slice_result(result, i,
@@ -595,11 +627,41 @@ class DynamicBatcher:
                 log.error("serve batcher: result slice failed: %s", e)
                 sliced = ShedError("closed", f"runner error: {e}")
             self._safe_done(r, sliced)
+        self._offer_exemplars(batch, t0, t1, t_d, t2, bucket, t_c0=t_c0)
         # This batch still counts in inflight_requests() until the
         # collector loop's post-deliver decrement; subtract it so the
         # gauge reads 0 at true idle.
         self._g_inflight.set(max(0, self._pipeline.inflight_requests()
                                  - item.n_requests))
+
+    def _offer_exemplars(self, batch: List[ServeRequest], t0: float,
+                         t1: float, t_d: float, t2: float, bucket: int,
+                         t_c0: Optional[float] = None) -> None:
+        """Tail-exemplar offers for one delivered batch (phase-ledger
+        reservoir, telemetry/critical_path.py, plane "serve"). Covers
+        server-side residency — the phases the batcher can see. Cheap
+        for the fast majority: one threshold compare per request before
+        any dict is built; the reservoir is looked up per batch so
+        telemetry resets between tests never detach a live batcher."""
+        from multiverso_tpu.telemetry.critical_path import get_reservoir
+        res = get_reservoir("serve")
+        for r in batch:
+            total_ms = (t2 - r.t_submit) * 1e3
+            if not res.would_admit(total_ms):
+                continue
+            t_enq = r.t_enqueue or r.t_submit
+            phases = {"admission": (t_enq - r.t_submit) * 1e3,
+                      "queue": (t0 - t_enq) * 1e3,
+                      "batch_form": (t1 - t0) * 1e3}
+            if t_c0 is not None:
+                phases["dispatch"] = (t_d - t1) * 1e3
+                phases["device"] = (t_c0 - t_d) * 1e3
+                phases["collect"] = (t2 - t_c0) * 1e3
+            else:
+                phases["device"] = (t2 - t1) * 1e3
+            res.offer(total_ms, phases,
+                      trace=r.ctx.trace_hex if r.ctx is not None else "",
+                      bucket=bucket)
 
     @staticmethod
     def _safe_done(req: ServeRequest, result: object) -> None:

@@ -38,7 +38,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from multiverso_tpu.telemetry import counter, gauge
+from multiverso_tpu.telemetry import counter, gauge, histogram
 from multiverso_tpu.telemetry.sketch import get_sketch_hub, record_keys
 
 
@@ -81,6 +81,7 @@ class HotRowCache:
         self._c_miss = counter("serve.cache.miss")
         self._c_stale = counter("serve.cache.stale")
         self._g_rows = gauge("serve.cache.rows")
+        self._h_probe = histogram("serve.latency.cache_probe")
         # Headroom advisor feed (telemetry/sketch.py): each flush reads
         # this cache's counters + capacity and publishes predicted-vs-
         # measured hit rates. Last-registered cache wins the surface —
@@ -106,6 +107,11 @@ class HotRowCache:
         stale per request, one hit per fully-served request). The result
         is a :class:`StampedRows` whose ``clock_stamp`` is the oldest
         contributing row's stamp — what the reply meta must claim."""
+        # Phase-ledger feed: the probe runs at ADMISSION on the submit
+        # thread, so its cost lands in the admission phase — the
+        # unconditional histogram makes it visible to the roofline
+        # classifier even for unsampled requests.
+        t0 = time.monotonic()
         out = []
         stamp = now_clock
         with self._lock:
@@ -113,15 +119,18 @@ class HotRowCache:
                 entry = self._rows.get(int(k))
                 if entry is None:
                     self._c_miss.inc()
+                    self._h_probe.observe((time.monotonic() - t0) * 1e3)
                     return None
                 if not self._fresh(entry[0], now_clock):
                     self._c_stale.inc()
+                    self._h_probe.observe((time.monotonic() - t0) * 1e3)
                     return None
                 stamp = min(stamp, entry[0]) if out else entry[0]
                 out.append(entry[1])
             for k in keys:                    # LRU touch only on full hits
                 self._rows.move_to_end(int(k))
         self._c_hit.inc()
+        self._h_probe.observe((time.monotonic() - t0) * 1e3)
         if not out:
             return None                       # empty request: device path
         rows = np.stack(out)

@@ -95,6 +95,9 @@ def connect_with_backoff(host: str, port: int, attempts: int = 4,
         except _TRANSIENT_CONNECT as e:
             last = e
             if i + 1 < attempts:
+                # reconnect backoff during failover: the fleet layer
+                # attributes this interval as its fleet.park span
+                # graftlint: disable=unattributed-wait
                 time.sleep(delays[i])
     raise ReplicaUnavailableError(
         f"replica {host}:{port} unavailable after {attempts} connect "
@@ -107,7 +110,8 @@ class ServeResult:
     lost connection alike); a callback added after completion fires
     immediately on the caller's thread."""
 
-    __slots__ = ("event", "slot", "_callbacks", "_cb_lock", "msg_id")
+    __slots__ = ("event", "slot", "_callbacks", "_cb_lock", "msg_id",
+                 "ctx")
 
     def __init__(self):
         self.event = threading.Event()
@@ -117,6 +121,9 @@ class ServeResult:
         #: Wire id of the request this result waits on — what
         #: :meth:`ServingClient.cancel` takes to cancel a hedged loser.
         self.msg_id = -1
+        #: Trace context of the request (None untraced) — the reader
+        #: thread emits the ``serve.deliver`` phase span under it.
+        self.ctx: Optional[TraceContext] = None
 
     def add_callback(self, fn: Callable[["ServeResult"], None]) -> None:
         with self._cb_lock:
@@ -140,6 +147,9 @@ class ServeResult:
         """Returns ``(values, clock)``; raises :class:`ShedError` when the
         server shed the request, :class:`ReplicaUnavailableError` on a
         lost connection."""
+        # whole-residency wait: the root serve.client span measures it
+        # and the phase ledger decomposes it — not a hidden phase
+        # graftlint: disable=unattributed-wait
         check(self.event.wait(timeout), "serve request timed out")
         if not self.slot:
             raise ReplicaUnavailableError(
@@ -235,6 +245,7 @@ class ServingClient:
                       msg_id=self._next_msg_id(), data=data)
         result = ServeResult()
         result.msg_id = msg.msg_id
+        result.ctx = ctx
         if owns_root:
             t_send = time.monotonic()
             result.add_callback(
@@ -244,6 +255,7 @@ class ServingClient:
             result.add_callback(on_done)
         with self._waiters_lock:
             self._waiters[msg.msg_id] = result
+        t_wire0 = time.monotonic()
         try:
             with self._send_lock:
                 send_message(self._sock, msg)
@@ -252,6 +264,11 @@ class ServingClient:
                 self._waiters.pop(msg.msg_id, None)
             raise ReplicaUnavailableError(
                 f"send to serving service failed: {e}") from e
+        if ctx is not None and ctx.sampled:
+            # Phase ledger: the request-side wire leg (serialization +
+            # socket write, including the send-lock wait).
+            emit_span("serve.send", trace_context.child_of(ctx), t_wire0,
+                      (time.monotonic() - t_wire0) * 1e3)
         return result
 
     def cancel(self, msg_id: int, runner_id: int = 0) -> None:
@@ -292,11 +309,19 @@ class ServingClient:
                 msg = recv_message(self._sock)
                 if msg is None:
                     break
+                t_arrive = time.monotonic()
                 with self._waiters_lock:
                     waiter = self._waiters.pop(msg.msg_id, None)
                 if waiter is not None:
                     waiter.slot.append(msg)
                     waiter._complete()
+                    wctx = waiter.ctx
+                    if wctx is not None and wctx.sampled:
+                        # Phase ledger: client-side delivery — reply
+                        # arrival through every completion callback.
+                        emit_span("serve.deliver",
+                                  trace_context.child_of(wctx), t_arrive,
+                                  (time.monotonic() - t_arrive) * 1e3)
         except OSError:
             pass
         self._dead = True
@@ -344,6 +369,9 @@ class RoutedLookupClient:
             # A zero-row lookup still round-trips (the serving codec
             # carries empty payloads) so the reply has the real column
             # shape instead of a made-up one.
+            # whole-residency wait on the underlying client, whose own
+            # root span + ledger measure it
+            # graftlint: disable=unattributed-wait
             values, _ = self._clients[0].request_async(
                 rows.astype(np.int32), deadline_ms,
                 self.runner_id).wait(timeout)
@@ -360,6 +388,9 @@ class RoutedLookupClient:
             parts.append((pos, res))
         out: Optional[np.ndarray] = None
         for pos, res in parts:
+            # whole-residency wait per shard; each underlying client's
+            # root span + ledger measure its own interval
+            # graftlint: disable=unattributed-wait
             values, _ = res.wait(timeout)
             if out is None:
                 out = np.empty((len(rows),) + values.shape[1:],

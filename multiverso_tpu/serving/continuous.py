@@ -754,6 +754,12 @@ class ContinuousBatcher(DynamicBatcher):
                                                "expired while queued"))
             else:
                 self._h_admit.observe((now - req.t_submit) * 1e3)
+                if req.ctx is not None and req.ctx.sampled:
+                    # Phase ledger: queue = enqueue -> claimed at a step
+                    # boundary (the continuous analog of batch gather).
+                    t_enq = req.t_enqueue or req.t_submit
+                    emit_span("serve.admit_wait", child_of(req.ctx),
+                              t_enq, (now - t_enq) * 1e3)
                 self._join(req, bucket)
 
     def _join(self, req: ServeRequest, bucket: int) -> None:
@@ -959,8 +965,10 @@ class ContinuousBatcher(DynamicBatcher):
         per-slot fallback path contains a failed batched read without
         losing the error-per-slot semantics."""
         import jax.numpy as jnp
+        from multiverso_tpu.telemetry.critical_path import get_reservoir
 
         now = time.monotonic()
+        reservoir = get_reservoir("serve")
         for eng in self._engines.values():
             done = [i for i, r in enumerate(eng.reqs)
                     if r is not None and eng.t[i] >= self.max_new - 1]
@@ -1001,6 +1009,16 @@ class ContinuousBatcher(DynamicBatcher):
                 self._c_batches.inc()
                 self._h_device.observe((now - eng.t_join[i]) * 1e3)
                 self._safe_done(r, row)
+                total_ms = (now - r.t_submit) * 1e3
+                if reservoir.would_admit(total_ms):
+                    t_enq = r.t_enqueue or r.t_submit
+                    reservoir.offer(
+                        total_ms,
+                        {"admission": (t_enq - r.t_submit) * 1e3,
+                         "queue": (eng.t_join[i] - t_enq) * 1e3,
+                         "device": (now - eng.t_join[i]) * 1e3},
+                        trace=r.ctx.trace_hex if r.ctx is not None else "",
+                        bucket=eng.bucket, continuous=1)
         self._g_active.set(self._total_active())
         self._g_inflight.set(self._total_active())
 

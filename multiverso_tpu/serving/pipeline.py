@@ -121,7 +121,7 @@ class InflightBatch:
     spans/histograms the batcher emits at delivery."""
 
     __slots__ = ("handle", "collect", "deliver", "n_requests",
-                 "t_dispatch", "meta")
+                 "t_dispatch", "t_collect0", "meta")
 
     def __init__(self, handle, collect: Callable[[object], object],
                  deliver: Callable[["InflightBatch", object], None],
@@ -131,6 +131,10 @@ class InflightBatch:
         self.deliver = deliver
         self.n_requests = max(0, int(n_requests))
         self.t_dispatch = time.monotonic()
+        # Collector pickup stamp (set by _run_collect just before
+        # collect()): the phase-ledger boundary between device-window
+        # residency and the host-side sync (critical_path.py).
+        self.t_collect0 = 0.0
         self.meta = meta
 
 
@@ -174,6 +178,9 @@ class DispatchPipeline:
             if len(self._fifo) >= self.depth:
                 self._c_backpressure.inc()
             while self._running and len(self._fifo) >= self.depth:
+                # backpressure stall inside the caller's serve.dispatch
+                # span: the ledger books it as dispatch time
+                # graftlint: disable=unattributed-wait
                 self._cv.wait(0.2)
             return self._running
 
@@ -183,6 +190,9 @@ class DispatchPipeline:
         False when the pipeline is closed (caller sheds)."""
         with self._cv:
             while self._running and len(self._fifo) >= self.depth:
+                # same backpressure stall as wait_for_slot: booked to
+                # the caller's serve.dispatch span
+                # graftlint: disable=unattributed-wait
                 self._cv.wait(0.2)
             if not self._running:
                 return False
@@ -221,6 +231,9 @@ class DispatchPipeline:
         while True:
             with self._cv:
                 while self._running and not self._fifo:
+                    # collector idle (no batch in flight): a present
+                    # batch is collected at once under serve.collect
+                    # graftlint: disable=unattributed-wait
                     self._cv.wait(0.2)
                     wd.beat()       # idle is progress, not a wedge
                 if not self._fifo:
@@ -233,6 +246,7 @@ class DispatchPipeline:
                 self._g_inflight.set(len(self._fifo) + 1)
                 self._cv.notify_all()
             wd.beat()
+            item.t_collect0 = time.monotonic()
             try:
                 result: object = item.collect(item.handle)
             except Exception as e:  # noqa: BLE001 - a poisoned batch must
@@ -260,6 +274,8 @@ class DispatchPipeline:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
+                # drain/close teardown wait, after admission stopped
+                # graftlint: disable=unattributed-wait
                 self._cv.wait(min(remaining, 0.2))
         return True
 

@@ -202,6 +202,8 @@ class CheckpointReplica:
             return
 
         def loop():
+            # follower refresh ticker: control-plane cadence
+            # graftlint: disable=unattributed-wait
             while not self._stop.wait(interval_s):
                 try:
                     self.refresh()

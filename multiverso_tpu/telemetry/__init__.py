@@ -28,6 +28,27 @@ from multiverso_tpu.telemetry.alerts import (AlertEngine, AlertManager,
 from multiverso_tpu.telemetry.context import (TraceContext, activate,
                                               child_of, current_context,
                                               maybe_new_root, new_root)
+from multiverso_tpu.telemetry.critical_path import (CONCURRENT_PHASES,
+                                                    PHASES, SPAN_PHASES,
+                                                    ExemplarReservoir,
+                                                    all_exemplar_payloads,
+                                                    analyze_critical_paths,
+                                                    decompose,
+                                                    exemplar_payload,
+                                                    exemplars_enabled,
+                                                    get_reservoir,
+                                                    phase_for_span,
+                                                    reset_critical_path,
+                                                    set_exemplars_enabled)
+from multiverso_tpu.telemetry.profile import (PROFILE_SCHEMA, FoldedStacks,
+                                              SamplingProfiler,
+                                              get_profiler, merge_profiles,
+                                              plane_for_thread,
+                                              profile_state, reset_profile,
+                                              start_profiler, stop_profiler)
+from multiverso_tpu.telemetry.roofline import (BOUND_CODES, BOUNDS,
+                                               classify, plane_reading,
+                                               reset_roofline, verdict)
 from multiverso_tpu.telemetry.flight import (POSTMORTEM_SCHEMA,
                                              FlightRecorder,
                                              WatchdogHandle,
@@ -89,4 +110,13 @@ __all__ = [
     "install_crash_handlers", "start_watchdog", "stop_watchdog",
     "validate_postmortem", "watchdog_handles", "watchdog_register",
     "watchdog_scope", "TimeseriesStore",
+    "CONCURRENT_PHASES", "PHASES", "SPAN_PHASES", "ExemplarReservoir",
+    "all_exemplar_payloads", "analyze_critical_paths", "decompose",
+    "exemplar_payload", "exemplars_enabled", "get_reservoir",
+    "phase_for_span", "reset_critical_path", "set_exemplars_enabled",
+    "PROFILE_SCHEMA", "FoldedStacks", "SamplingProfiler", "get_profiler",
+    "merge_profiles", "plane_for_thread", "profile_state", "reset_profile",
+    "start_profiler", "stop_profiler",
+    "BOUND_CODES", "BOUNDS", "classify", "plane_reading", "reset_roofline",
+    "verdict",
 ]

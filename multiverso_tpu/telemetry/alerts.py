@@ -506,4 +506,8 @@ def maybe_start_observability_from_flags() -> bool:
         start_watchdog()
         install_crash_handlers()
         started = True
+    if bool(_flag_or("telemetry_profile", False)):
+        from multiverso_tpu.telemetry.profile import start_profiler
+        start_profiler()
+        started = True
     return started

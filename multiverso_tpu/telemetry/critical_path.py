@@ -1,0 +1,443 @@
+"""Per-request phase ledger, critical-path decomposition, tail exemplars.
+
+This is the *attribution* half of the telemetry plane: the span substrate
+(spans.py) records that a request was slow; this module says *where the
+time went*. Three pieces:
+
+**Phase taxonomy.** Every stamped span on the serving/fleet hot path maps
+to one of a fixed set of phases (``PHASES``). The serving planes stamp
+phase boundaries as ordinary spans — admission (submit-side validation +
+cache probe), queue (admission-queue wait), batch_form (gather + pad),
+dispatch (async launch call), device (accelerator residency), collect
+(result sync), wire (reply serialization + send), deliver (client-side
+unpack + callback) — plus park / hedge / retry for the fleet client's
+routing detours. Hedges run CONCURRENTLY with the primary attempt, so
+``CONCURRENT_PHASES`` are reported but excluded from the conservation
+sum.
+
+**Critical-path analyzer.** ``decompose`` takes one stitched trace's
+spans (Chrome-trace events, the stitch/merge output of export.py) and
+splits the root span's end-to-end latency into per-phase milliseconds,
+with a **conservation check**: attributed phases must sum to within
+``tolerance`` of measured e2e. The residual is *published*, not hidden
+— ``latency.unattributed`` (histogram, ms) and the per-analysis
+``latency.unattributed_frac`` gauge. An unattributed tail is itself a
+finding: it means a hot path is waiting somewhere no span covers (the
+``unattributed-wait`` lint hunts the static version of the same bug).
+
+**Tail exemplars.** Aggregates answer "is p99 high"; exemplars answer
+"why was p99 high at 14:02". ``ExemplarReservoir`` keeps the slowest-N
+requests per rotation window with their full phase ledgers and trace
+ids. The batcher and fleet client offer() every completed request
+(cheap reject for the fast majority); heartbeats ship the reservoir to
+the router (Fleet_Stats → ``fleet_top --exemplars``) and exporter
+snapshots / postmortems embed it, so the evidence survives the window.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence
+
+__all__ = [
+    "PHASES", "CONCURRENT_PHASES", "SPAN_PHASES", "phase_for_span",
+    "decompose", "analyze_critical_paths",
+    "ExemplarReservoir", "get_reservoir", "exemplar_payload",
+    "all_exemplar_payloads", "set_exemplars_enabled", "exemplars_enabled",
+    "reset_critical_path",
+]
+
+#: Canonical request phases, in hot-path order. park/hedge/retry are the
+#: fleet client's routing detours; everything else is the straight-line
+#: path through one replica.
+PHASES = ("admission", "queue", "batch_form", "dispatch", "device",
+          "collect", "wire", "deliver", "park", "hedge", "retry")
+
+#: Phases that overlap the primary attempt in wall-clock time. Reported
+#: in decompositions, EXCLUDED from the conservation sum (a hedge that
+#: loses the race added no e2e latency).
+CONCURRENT_PHASES = frozenset({"hedge"})
+
+#: Span name -> phase. Spans not listed are either roots (e2e anchors)
+#: or containers whose children carry the phase detail.
+SPAN_PHASES: Dict[str, str] = {
+    "serve.admission": "admission",
+    "serve.cache_hit": "admission",   # cache probe answered at admission
+    "serve.admit_wait": "queue",
+    "serve.batch_form": "batch_form",
+    "serve.dispatch": "dispatch",
+    "serve.device": "device",
+    "serve.collect": "collect",
+    "serve.send": "wire",
+    "serve.reply": "wire",
+    "serve.deliver": "deliver",
+    "fleet.park": "park",
+}
+
+#: Containers: spans that *enclose* phase spans rather than being a
+#: phase themselves. Counting them would double every child phase.
+_CONTAINER_SPANS = frozenset({
+    "serve.request", "serve.batch", "serve.client",
+    "fleet.request", "fleet.attempt", "fleet.lookup", "fleet.proxy",
+})
+
+
+def phase_for_span(name: str, args: Optional[Mapping] = None
+                   ) -> Optional[str]:
+    """Phase for one span event, or None (root / container / unknown).
+
+    ``fleet.attempt`` is a container for the primary attempt but IS a
+    phase for hedges (concurrent duplicate) and retries (serial re-send
+    after a failure): the duplicate attempt's whole duration is the
+    detour's cost.
+    """
+    if name == "fleet.attempt":
+        a = args or {}
+        if a.get("hedge"):
+            return "hedge"
+        try:
+            if int(a.get("attempt", 1) or 1) > 1:
+                return "retry"
+        except (TypeError, ValueError):
+            pass
+        return None
+    return SPAN_PHASES.get(name)
+
+
+#: Typed transition bridges: the gap between two adjacent phase
+#: intervals on the timeline IS a known critical-path leg when the
+#: boundary pair matches — client send end -> server admission start is
+#: request transit + reader wakeup (wire), collect end -> reply start
+#: is the reply-path handoff, reply end -> deliver start is reply
+#: transit + client reader wakeup. Every OTHER inter-phase gap stays in
+#: the residual: an uncovered wait inside a pipeline is exactly what
+#: the conservation check (and the unattributed-wait lint) exists to
+#: surface, so bridging is a closed allowlist, not a blanket fold.
+_BRIDGES: Dict[tuple, str] = {
+    ("wire", "admission"): "wire",
+    ("collect", "wire"): "wire",
+    ("wire", "deliver"): "wire",
+}
+
+
+def _publish_residual(e2e_ms: float, unattributed_ms: float) -> None:
+    from multiverso_tpu.telemetry.metrics import gauge, histogram
+    histogram("latency.unattributed").observe(max(0.0, unattributed_ms))
+    if e2e_ms > 0.0:
+        gauge("latency.unattributed_frac").set(
+            max(0.0, unattributed_ms) / e2e_ms)
+
+
+def decompose(trace_spans: Sequence[Mapping], tolerance: float = 0.10,
+              publish: bool = True) -> Optional[Dict]:
+    """Decompose ONE trace's spans into the phase ledger.
+
+    ``trace_spans`` are Chrome-trace "X" events sharing a trace id (the
+    per-trace buckets the stitcher builds). Returns None when the trace
+    has no root span to anchor e2e. Phase time is the spans' measured
+    durations (clipped to the root interval) plus the allowlisted
+    transition bridges (``_BRIDGES``). The residual (e2e minus
+    attributed phases) is published into ``latency.unattributed``
+    unless ``publish=False`` (offline report over someone else's trace
+    file).
+    """
+    root = None
+    for ev in trace_spans:
+        if not (ev.get("args") or {}).get("parent"):
+            if root is None or ev.get("dur", 0) > root.get("dur", 0):
+                root = ev
+    if root is None:
+        return None
+    t0 = float(root.get("ts", 0))
+    t1 = t0 + float(root.get("dur", 0))
+    e2e_ms = float(root.get("dur", 0)) / 1e3
+    phases: Dict[str, float] = {}
+    intervals = []          # (start_us, end_us, phase), root-clipped
+    for ev in trace_spans:
+        if ev is root:
+            continue
+        name = ev.get("name", "")
+        if name in _CONTAINER_SPANS and name != "fleet.attempt":
+            continue
+        ph = phase_for_span(name, ev.get("args"))
+        if ph is None:
+            continue
+        if ph in CONCURRENT_PHASES:
+            # Reported, never on the serial timeline: a hedge overlaps
+            # the primary attempt by design.
+            phases[ph] = phases.get(ph, 0.0) \
+                + float(ev.get("dur", 0)) / 1e3
+            continue
+        s = max(t0, float(ev.get("ts", 0)))
+        e = min(t1, float(ev.get("ts", 0)) + float(ev.get("dur", 0)))
+        if e <= s:
+            continue
+        intervals.append((s, e, ph))
+        phases[ph] = phases.get(ph, 0.0) + (e - s) / 1e3
+    # Timeline walk: bridge allowlisted boundary pairs. The cursor is
+    # the furthest covered point so far; only a TRUE gap (next interval
+    # starts past it) can bridge.
+    intervals.sort()
+    bridged_ms = 0.0
+    cur_end = None
+    cur_ph = None
+    for s, e, ph in intervals:
+        if cur_end is not None and s > cur_end:
+            b = _BRIDGES.get((cur_ph, ph))
+            if b is not None:
+                gap = (s - cur_end) / 1e3
+                phases[b] = phases.get(b, 0.0) + gap
+                bridged_ms += gap
+        if cur_end is None or e >= cur_end:
+            cur_end, cur_ph = e, ph
+    attributed = sum(v for k, v in phases.items()
+                     if k not in CONCURRENT_PHASES)
+    unattributed = e2e_ms - attributed
+    conserved = (abs(unattributed) <= tolerance * e2e_ms) if e2e_ms > 0 \
+        else True
+    if publish:
+        _publish_residual(e2e_ms, unattributed)
+    return {
+        "trace": (root.get("args") or {}).get("trace", ""),
+        "root": root.get("name", ""),
+        "e2e_ms": round(e2e_ms, 4),
+        "phases": {k: round(v, 4) for k, v in sorted(phases.items())},
+        "attributed_ms": round(attributed, 4),
+        "bridged_ms": round(bridged_ms, 4),
+        "unattributed_ms": round(unattributed, 4),
+        "unattributed_frac": round(unattributed / e2e_ms, 4)
+        if e2e_ms > 0 else 0.0,
+        "conserved": bool(conserved),
+        "n_spans": len(trace_spans),
+    }
+
+
+def _pcts(vals: List[float]) -> Dict[str, float]:
+    if not vals:
+        return {"p50": 0.0, "p95": 0.0, "p99": 0.0, "mean": 0.0}
+    s = sorted(vals)
+
+    def q(p: float) -> float:
+        return s[min(len(s) - 1, int(p * len(s)))]
+    return {"p50": round(q(0.50), 4), "p95": round(q(0.95), 4),
+            "p99": round(q(0.99), 4),
+            "mean": round(sum(s) / len(s), 4)}
+
+
+def analyze_critical_paths(spans: Iterable[Mapping],
+                           tolerance: float = 0.10,
+                           slow_k: int = 3,
+                           publish: bool = True) -> Dict:
+    """Critical-path report over a stitched span stream.
+
+    Groups events by trace id, decomposes each, and aggregates: phase
+    shares of total attributed time, e2e percentiles, the conservation
+    rate (fraction of traces whose ledger sums within tolerance of
+    e2e), and the ``slow_k`` slowest per-trace ledgers verbatim.
+    """
+    by_trace: Dict[str, List[Mapping]] = {}
+    for ev in spans:
+        if ev.get("ph") != "X":
+            continue
+        tid = (ev.get("args") or {}).get("trace")
+        if tid:
+            by_trace.setdefault(tid, []).append(ev)
+    decomps: List[Dict] = []
+    for tid, evs in by_trace.items():
+        # Single-span traces (an unstitched fragment) carry no
+        # decomposition signal: e2e with zero attributable children
+        # would read as 100% unattributed and poison the rate.
+        if len(evs) < 2:
+            continue
+        d = decompose(evs, tolerance=tolerance, publish=publish)
+        if d is not None:
+            decomps.append(d)
+    n = len(decomps)
+    phase_tot: Dict[str, float] = {}
+    for d in decomps:
+        for k, v in d["phases"].items():
+            phase_tot[k] = phase_tot.get(k, 0.0) + v
+    attributed_total = sum(v for k, v in phase_tot.items()
+                           if k not in CONCURRENT_PHASES) or 1.0
+    return {
+        "n_traces": len(by_trace),
+        "n_decomposed": n,
+        "n_conserved": sum(1 for d in decomps if d["conserved"]),
+        "conserved_frac": round(
+            sum(1 for d in decomps if d["conserved"]) / n, 4)
+        if n else 0.0,
+        "tolerance": tolerance,
+        "e2e_ms": _pcts([d["e2e_ms"] for d in decomps]),
+        "phases": {
+            k: {"total_ms": round(v, 4),
+                "share": round(v / attributed_total, 4)}
+            for k, v in sorted(phase_tot.items())},
+        "unattributed": {
+            "mean_ms": round(
+                sum(d["unattributed_ms"] for d in decomps) / n, 4)
+            if n else 0.0,
+            "mean_frac": round(
+                sum(d["unattributed_frac"] for d in decomps) / n, 4)
+            if n else 0.0,
+        },
+        "bridged_mean_ms": round(
+            sum(d.get("bridged_ms", 0.0) for d in decomps) / n, 4)
+        if n else 0.0,
+        "slowest": sorted(decomps, key=lambda d: -d["e2e_ms"])[:slow_k],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Tail exemplars
+# ---------------------------------------------------------------------------
+
+_enabled_override: Optional[bool] = None
+
+
+def set_exemplars_enabled(on: Optional[bool]) -> None:
+    """Force exemplar capture on/off (None = follow the
+    ``-telemetry_exemplars`` flag). The bench A/B leg uses this to build
+    a true no-attribution baseline without re-parsing flags."""
+    global _enabled_override
+    _enabled_override = on
+
+
+def exemplars_enabled() -> bool:
+    if _enabled_override is not None:
+        return _enabled_override
+    from multiverso_tpu.utils.configure import flag_or
+    return bool(flag_or("telemetry_exemplars", True))
+
+
+class ExemplarReservoir:
+    """Bounded slowest-N reservoir with window rotation.
+
+    Two buckets — current and previous window — so a reader always sees
+    up to a full window of history even right after rotation. offer()
+    is hot-path cheap: a lock-free threshold read rejects the fast
+    majority before any allocation or locking.
+    """
+
+    def __init__(self, plane: str, capacity: int = 8,
+                 window_s: float = 60.0):
+        self.plane = plane
+        self.capacity = max(1, int(capacity))
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._cur: List[Dict] = []
+        self._prev: List[Dict] = []
+        self._t_window = time.monotonic()
+        # Lock-free fast-reject threshold: the slowest request NOT worth
+        # keeping. 0.0 while the window has spare capacity.
+        self._floor_ms = 0.0
+
+    def would_admit(self, total_ms: float) -> bool:
+        """Racy-but-safe quick check; callers use it to skip building
+        the phase dict for requests that can't make the reservoir."""
+        return total_ms > self._floor_ms
+
+    def offer(self, total_ms: float, phases: Optional[Mapping] = None,
+              trace: str = "", **tags) -> bool:
+        if not exemplars_enabled():
+            return False
+        if total_ms <= self._floor_ms:
+            return False
+        now = time.monotonic()
+        entry = {
+            "total_ms": round(float(total_ms), 4),
+            "phases": {k: round(float(v), 4)
+                       for k, v in (phases or {}).items()},
+            "trace": trace,
+            "age_s": 0.0,            # recomputed at snapshot time
+            "t_mono": now,
+            "time_unix": time.time(),
+        }
+        if tags:
+            entry.update(tags)
+        with self._lock:
+            if now - self._t_window > self.window_s:
+                self._prev = self._cur
+                self._cur = []
+                self._t_window = now
+            self._cur.append(entry)
+            if len(self._cur) > self.capacity:
+                self._cur.sort(key=lambda e: -e["total_ms"])
+                del self._cur[self.capacity:]
+            self._floor_ms = (self._cur[-1]["total_ms"]
+                              if len(self._cur) >= self.capacity else 0.0)
+        return True
+
+    def snapshot(self, n: Optional[int] = None) -> List[Dict]:
+        """Slowest-first exemplars across current + previous window."""
+        now = time.monotonic()
+        with self._lock:
+            merged = sorted(self._cur + self._prev,
+                            key=lambda e: -e["total_ms"])
+        out = []
+        for e in merged[:(n or self.capacity)]:
+            d = {k: v for k, v in e.items() if k != "t_mono"}
+            d["age_s"] = round(now - e["t_mono"], 2)
+            out.append(d)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cur = []
+            self._prev = []
+            self._floor_ms = 0.0
+            self._t_window = time.monotonic()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cur) + len(self._prev)
+
+
+_reservoirs: Dict[str, ExemplarReservoir] = {}
+_reservoirs_lock = threading.Lock()
+
+
+def get_reservoir(plane: str) -> ExemplarReservoir:
+    """Process-wide reservoir for one plane ("serve", "fleet", ...)."""
+    with _reservoirs_lock:
+        r = _reservoirs.get(plane)
+        if r is None:
+            from multiverso_tpu.utils.configure import flag_or
+            r = ExemplarReservoir(
+                plane, capacity=int(flag_or("telemetry_exemplar_n", 8)))
+            _reservoirs[plane] = r
+        return r
+
+
+def exemplar_payload(plane: str, n: Optional[int] = None) -> List[Dict]:
+    """Heartbeat-compact exemplar list for one plane ([] if none)."""
+    with _reservoirs_lock:
+        r = _reservoirs.get(plane)
+    if r is None:
+        return []
+    out = []
+    for e in r.snapshot(n):
+        out.append({"total_ms": e["total_ms"], "phases": e["phases"],
+                    "trace": e["trace"], "age_s": e["age_s"],
+                    "plane": plane})
+    return out
+
+
+def all_exemplar_payloads(n: Optional[int] = None) -> List[Dict]:
+    """Every plane's exemplars, slowest first (snapshot/postmortem
+    embed)."""
+    with _reservoirs_lock:
+        planes = list(_reservoirs)
+    out: List[Dict] = []
+    for p in planes:
+        out.extend(exemplar_payload(p, n))
+    out.sort(key=lambda e: -e["total_ms"])
+    return out
+
+
+def reset_critical_path() -> None:
+    """Test isolation: drop reservoirs and the enable override."""
+    global _enabled_override
+    with _reservoirs_lock:
+        _reservoirs.clear()
+    _enabled_override = None

@@ -101,6 +101,21 @@ def metrics_snapshot(buckets: bool = True, seq: int = 0) -> Dict:
             snap["sketches"] = hub.snapshot()
     except Exception:  # noqa: BLE001 - additive section, same contract
         pass
+    try:
+        from multiverso_tpu.telemetry.critical_path import \
+            all_exemplar_payloads
+        ex = all_exemplar_payloads()
+        if ex:
+            snap["exemplars"] = ex
+    except Exception:  # noqa: BLE001 - additive section, same contract
+        pass
+    try:
+        from multiverso_tpu.telemetry.profile import profile_state
+        prof = profile_state()
+        if prof is not None and prof.get("samples"):
+            snap["profile"] = prof
+    except Exception:  # noqa: BLE001 - additive section, same contract
+        pass
     return snap
 
 
@@ -435,12 +450,18 @@ def reset_telemetry() -> None:
     """Test isolation: stop the exporter, alert engine and watchdog,
     drop every metric, span, and flight event."""
     from multiverso_tpu.telemetry.alerts import stop_alert_engine
+    from multiverso_tpu.telemetry.critical_path import reset_critical_path
     from multiverso_tpu.telemetry.flight import reset_flight
+    from multiverso_tpu.telemetry.profile import reset_profile
+    from multiverso_tpu.telemetry.roofline import reset_roofline
     from multiverso_tpu.telemetry.sketch import reset_sketches
     stop_alert_engine()
     reset_flight()
     stop_exporter()
     reset_sketches()
+    reset_profile()
+    reset_critical_path()
+    reset_roofline()
     get_registry().reset()
     buf = get_trace_buffer()
     buf.clear()

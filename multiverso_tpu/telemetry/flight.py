@@ -357,6 +357,20 @@ def build_postmortem(reason: Dict) -> Dict:
     except Exception as e:  # noqa: BLE001
         payload["metrics"] = {}
         payload.setdefault("dump_errors", []).append(f"metrics: {e}")
+    try:
+        from multiverso_tpu.telemetry.critical_path import \
+            all_exemplar_payloads
+        payload["exemplars"] = all_exemplar_payloads()
+    except Exception as e:  # noqa: BLE001
+        payload["exemplars"] = []
+        payload.setdefault("dump_errors", []).append(f"exemplars: {e}")
+    try:
+        from multiverso_tpu.telemetry.profile import profile_state
+        prof = profile_state()
+        if prof is not None:
+            payload["profile"] = prof
+    except Exception as e:  # noqa: BLE001
+        payload.setdefault("dump_errors", []).append(f"profile: {e}")
     return payload
 
 

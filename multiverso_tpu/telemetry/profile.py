@@ -1,0 +1,360 @@
+"""Continuous sampling profiler: folded stacks + per-thread CPU planes.
+
+The PR-6 finding — "the GIL is the latency floor; the bench *client* is
+the bound resource" — was established by hand with /proc arithmetic.
+This module makes that diagnosis continuous and automatic:
+
+* A daemon thread samples ``sys._current_frames()`` at a few Hz into a
+  **bounded folded-stack aggregate** (``FoldedStacks``): flamegraph-
+  ready ``plane;frame;frame count`` lines, mergeable across processes
+  exactly like the PR-14 traffic sketches (state dicts sum).
+* Each sample also reads per-thread CPU clocks from
+  ``/proc/self/task/<tid>/stat`` and attributes the deltas to a
+  **plane** derived from the thread's name (``serve-*`` → serve,
+  ``serve-client*`` → client, ``fleet-*`` → fleet, everything else →
+  host). The rolling rates publish as ``profile.host_bound_pct`` (whole
+  process, percent of ONE core — the GIL ceiling) and per-plane
+  ``profile.host_bound_pct.<plane>`` gauges, which is what the roofline
+  classifier (roofline.py) reads to call a plane host-bound.
+
+Sampling cost is a thread-enumerate plus a bounded stack walk a few
+times a second — the serve_bench A/B leg holds the ledger+profiler pair
+to ≤1% throughput overhead. Memory is bounded by construction: at most
+``max_stacks`` distinct folded stacks are kept; the long tail collapses
+into a single ``<other>`` bucket (count preserved, frames dropped).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+__all__ = [
+    "PROFILE_SCHEMA", "PLANES", "FoldedStacks", "SamplingProfiler",
+    "plane_for_thread", "start_profiler", "stop_profiler", "get_profiler",
+    "profile_state", "merge_profiles", "reset_profile",
+]
+
+PROFILE_SCHEMA = "multiverso_tpu.profile/v1"
+
+#: CPU-attribution planes, bounded by construction. "client" is the
+#: serving client's reader threads (the PR-6 bottleneck), "host" is
+#: everything unclassified (main thread, bench load loops, runtimes).
+PLANES = ("serve", "client", "fleet", "telemetry", "host")
+
+_PLANE_PREFIXES: Tuple[Tuple[str, str], ...] = (
+    ("serve-client", "client"),
+    ("serve-", "serve"),
+    ("fleet-", "fleet"),
+    ("router-", "fleet"),
+    ("telemetry-", "telemetry"),
+    ("alerts-", "telemetry"),
+)
+
+
+def plane_for_thread(name: str) -> str:
+    for prefix, plane in _PLANE_PREFIXES:
+        if name.startswith(prefix):
+            return plane
+    return "host"
+
+
+class FoldedStacks:
+    """Bounded ``stack -> count`` aggregate in folded (semicolon) form.
+
+    Bound policy: once ``max_stacks`` distinct stacks exist, new stacks
+    fold into ``<other>`` — counts stay exact in total, only the frame
+    detail of the tail is lost. ``merge()`` sums another instance's
+    state (cross-process merge via ``to_state``/``merge_state``).
+    """
+
+    OTHER = "<other>"
+
+    def __init__(self, max_stacks: int = 2000):
+        self.max_stacks = max(1, int(max_stacks))
+        self._counts: Dict[str, int] = {}
+        self._other = 0
+        self._lock = threading.Lock()
+
+    def add(self, stack: str, n: int = 1) -> None:
+        with self._lock:
+            if stack in self._counts:
+                self._counts[stack] += n
+            elif len(self._counts) < self.max_stacks:
+                self._counts[stack] = n
+            else:
+                self._other += n
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._counts.values()) + self._other
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._counts) + (1 if self._other else 0)
+
+    def to_state(self) -> Dict:
+        with self._lock:
+            return {"stacks": dict(self._counts), "other": self._other,
+                    "max_stacks": self.max_stacks}
+
+    def merge_state(self, state: Mapping) -> None:
+        stacks = state.get("stacks", {}) or {}
+        with self._lock:
+            for stack, n in stacks.items():
+                if stack in self._counts:
+                    self._counts[stack] += int(n)
+                elif len(self._counts) < self.max_stacks:
+                    self._counts[stack] = int(n)
+                else:
+                    self._other += int(n)
+            self._other += int(state.get("other", 0))
+
+    def folded_lines(self, top: Optional[int] = None) -> List[str]:
+        """``stack count`` lines, heaviest first — feed straight to any
+        flamegraph renderer."""
+        with self._lock:
+            items = sorted(self._counts.items(), key=lambda kv: -kv[1])
+            if self._other:
+                items.append((self.OTHER, self._other))
+        return [f"{s} {n}" for s, n in items[:top]]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._other = 0
+
+
+def _frame_stack(frame, max_depth: int = 48) -> str:
+    """Leaf-last folded frames ``module:func;module:func``."""
+    parts: List[str] = []
+    f = frame
+    while f is not None and len(parts) < max_depth:
+        code = f.f_code
+        mod = os.path.basename(code.co_filename)
+        if mod.endswith(".py"):
+            mod = mod[:-3]
+        parts.append(f"{mod}:{code.co_name}")
+        f = f.f_back
+    parts.reverse()
+    return ";".join(parts)
+
+
+def _task_cpu_s(native_id: int) -> Optional[float]:
+    """utime+stime (seconds) for one OS thread of this process."""
+    try:
+        with open(f"/proc/self/task/{native_id}/stat", "rb") as fh:
+            raw = fh.read().decode("ascii", "replace")
+        # comm can contain spaces/parens; fields start after the last ')'
+        fields = raw[raw.rfind(")") + 2:].split()
+        utime, stime = int(fields[11]), int(fields[12])
+        return (utime + stime) / float(os.sysconf("SC_CLK_TCK"))
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+class SamplingProfiler:
+    """Daemon thread sampling every live thread a few times a second."""
+
+    def __init__(self, hz: float = 4.0, max_stacks: int = 2000):
+        self.hz = max(0.2, min(50.0, float(hz)))
+        self.stacks = FoldedStacks(max_stacks)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._samples = 0
+        self._t_start = 0.0
+        # plane -> cumulative CPU seconds attributed; tid -> last reading
+        self._plane_cpu: Dict[str, float] = {p: 0.0 for p in PLANES}
+        self._tid_cpu: Dict[int, float] = {}
+        self._plane_samples: Dict[str, int] = {p: 0 for p in PLANES}
+        self._t_publish = 0.0
+        self._cpu_at_publish: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        from multiverso_tpu.telemetry.metrics import gauge
+        self._g_total = gauge("profile.host_bound_pct")
+        # Literal plane enum above: bounded by construction.
+        # graftlint: disable=unbounded-metric-name
+        self._g_plane = {p: gauge("profile.host_bound_pct." + p)
+                         for p in PLANES}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._t_start = time.monotonic()
+        self._t_publish = self._t_start
+        self._wake.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="telemetry-profiler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    # -- sampling ----------------------------------------------------------
+    def _loop(self) -> None:
+        from multiverso_tpu.telemetry.flight import watchdog_scope
+        period = 1.0 / self.hz
+        with watchdog_scope("telemetry-profiler", 30.0) as wd:
+            while self._running:
+                wd.beat()
+                try:
+                    self._sample_once()
+                except Exception:  # noqa: BLE001 - never kill the host
+                    pass
+                self._wake.wait(period)
+
+    def _sample_once(self) -> None:
+        me = threading.get_ident()
+        frames = sys._current_frames()
+        threads = {t.ident: t for t in threading.enumerate()}
+        cpu_delta: Dict[str, float] = {}
+        with self._lock:
+            self._samples += 1
+            for ident, frame in frames.items():
+                if ident == me:
+                    continue
+                t = threads.get(ident)
+                name = t.name if t is not None else "?"
+                plane = plane_for_thread(name)
+                self._plane_samples[plane] = \
+                    self._plane_samples.get(plane, 0) + 1
+                self.stacks.add(plane + ";" + _frame_stack(frame))
+                nid = getattr(t, "native_id", None) if t is not None \
+                    else None
+                if nid:
+                    now_cpu = _task_cpu_s(nid)
+                    if now_cpu is not None:
+                        prev = self._tid_cpu.get(nid)
+                        if prev is not None and now_cpu >= prev:
+                            cpu_delta[plane] = (cpu_delta.get(plane, 0.0)
+                                                + now_cpu - prev)
+                        self._tid_cpu[nid] = now_cpu
+            for plane, d in cpu_delta.items():
+                self._plane_cpu[plane] = self._plane_cpu.get(plane, 0.0) + d
+            now = time.monotonic()
+            if now - self._t_publish >= 1.0:
+                self._publish_locked(now)
+
+    def _publish_locked(self, now: float) -> None:
+        dt = now - self._t_publish
+        if dt <= 0:
+            return
+        total_pct = 0.0
+        for plane in PLANES:
+            cur = self._plane_cpu.get(plane, 0.0)
+            prev = self._cpu_at_publish.get(plane, 0.0)
+            pct = 100.0 * max(0.0, cur - prev) / dt
+            self._g_plane[plane].set(pct)
+            self._cpu_at_publish[plane] = cur
+            total_pct += pct
+        self._g_total.set(total_pct)
+        self._t_publish = now
+
+    # -- readout -----------------------------------------------------------
+    def plane_cpu_s(self, plane: str) -> float:
+        with self._lock:
+            return self._plane_cpu.get(plane, 0.0)
+
+    def state(self) -> Dict:
+        with self._lock:
+            planes = {
+                p: {"samples": self._plane_samples.get(p, 0),
+                    "cpu_s": round(self._plane_cpu.get(p, 0.0), 4)}
+                for p in PLANES
+                if self._plane_samples.get(p) or self._plane_cpu.get(p)}
+            samples = self._samples
+            wall = (time.monotonic() - self._t_start) \
+                if self._t_start else 0.0
+        st = self.stacks.to_state()
+        st.update({
+            "schema": PROFILE_SCHEMA,
+            "pid": os.getpid(),
+            "hz": self.hz,
+            "samples": samples,
+            "wall_s": round(wall, 3),
+            "planes": planes,
+        })
+        return st
+
+
+def merge_profiles(states: Iterable[Mapping],
+                   max_stacks: int = 4000) -> Dict:
+    """Merge per-process profile states (same shape as one state, pid
+    list preserved) — the cross-process flamegraph for a fleet run."""
+    agg = FoldedStacks(max_stacks)
+    pids: List[int] = []
+    samples = 0
+    wall = 0.0
+    planes: Dict[str, Dict[str, float]] = {}
+    for st in states:
+        if st.get("schema") != PROFILE_SCHEMA:
+            continue
+        agg.merge_state(st)
+        pids.append(int(st.get("pid", 0)))
+        samples += int(st.get("samples", 0))
+        wall = max(wall, float(st.get("wall_s", 0.0)))
+        for p, d in (st.get("planes") or {}).items():
+            acc = planes.setdefault(p, {"samples": 0, "cpu_s": 0.0})
+            acc["samples"] += int(d.get("samples", 0))
+            acc["cpu_s"] = round(acc["cpu_s"] + float(d.get("cpu_s", 0.0)),
+                                 4)
+    out = agg.to_state()
+    out.update({"schema": PROFILE_SCHEMA, "pids": pids, "samples": samples,
+                "wall_s": wall, "planes": planes})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton
+# ---------------------------------------------------------------------------
+_profiler: Optional[SamplingProfiler] = None
+_profiler_lock = threading.Lock()
+
+
+def start_profiler(hz: Optional[float] = None) -> SamplingProfiler:
+    """Start (idempotently) the process profiler. Default rate comes
+    from ``-telemetry_profile_hz``."""
+    global _profiler
+    with _profiler_lock:
+        if _profiler is None:
+            if hz is None:
+                from multiverso_tpu.utils.configure import flag_or
+                hz = float(flag_or("telemetry_profile_hz", 4.0))
+            _profiler = SamplingProfiler(hz=hz)
+        _profiler.start()
+        return _profiler
+
+
+def stop_profiler() -> None:
+    with _profiler_lock:
+        if _profiler is not None:
+            _profiler.stop()
+
+
+def get_profiler() -> Optional[SamplingProfiler]:
+    return _profiler
+
+
+def profile_state() -> Optional[Dict]:
+    """Current profile aggregate, or None when no profiler ever ran."""
+    p = _profiler
+    return p.state() if p is not None else None
+
+
+def reset_profile() -> None:
+    global _profiler
+    with _profiler_lock:
+        if _profiler is not None:
+            _profiler.stop()
+        _profiler = None

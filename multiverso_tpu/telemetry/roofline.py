@@ -1,0 +1,203 @@
+"""Per-plane bound classifier: name the resource that binds each plane.
+
+ROADMAP item 4: every committed number is suspect until the plane it
+came from states whether it is bound by dispatch, host, wire, or the
+device (the roofline framing — a plane sits under exactly one ceiling
+at a time). This module folds the attribution signals the telemetry
+plane already collects — the unconditional ``serve.latency.*`` stage
+histograms (their ``sum`` is exact busy-time, unlike the sampled
+``span.*`` histograms), the pipeline-occupancy gauge, and the
+continuous profiler's per-plane CPU attribution (profile.py) — into one
+published verdict per plane:
+
+    roofline.<plane>.bound ∈ {idle, dispatch, host, wire, device}
+
+plus the utilization fractions behind it. ``classify`` is a pure truth
+table over a utilization dict (unit-testable on synthetic mixes);
+``verdict`` gathers a plane's live reading, differentiates it against
+the previous call's (so repeated verdicts classify the *window* between
+them, the heartbeat's natural cadence), classifies, and publishes.
+
+Verdict semantics:
+
+* ``idle``      — no traffic and no busy resource; nothing to bind.
+* ``device``    — accelerator residency dominates (window occupancy or
+                  device-time fraction): buy/use more device.
+* ``host``      — host CPU is the ceiling (the PR-6 GIL floor): the
+                  plane's Python threads are compute-saturated.
+* ``wire``      — serialization + socket time dominates.
+* ``dispatch``  — host-side batch-form/launch path dominates without
+                  saturating a core: batching/launch overheads bind.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Mapping, Optional
+
+__all__ = ["PLANES", "BOUNDS", "BOUND_CODES", "classify", "plane_reading",
+           "verdict", "reset_roofline"]
+
+#: Planes this process can self-classify. "serve" is the replica's
+#: batcher/device path; "client" is the requesting side (bench load +
+#: reader threads) scored on whole-process CPU — the PR-6 bottleneck.
+PLANES = ("serve", "client")
+
+BOUNDS = ("idle", "dispatch", "host", "wire", "device")
+BOUND_CODES = {b: i for i, b in enumerate(BOUNDS)}
+
+#: Utilization keys ``classify`` understands, all fractions in [0, 1]
+#: except qps. Missing keys read as 0.
+UTIL_KEYS = ("qps", "host_cpu", "device_occ", "device_frac",
+             "wire_frac", "dispatch_frac", "queue_frac")
+
+
+def classify(util: Mapping[str, float]) -> str:
+    """Pure truth table: utilization mix -> bound verdict.
+
+    Precedence device > host > wire > dispatch mirrors cost: a
+    saturated device binds regardless of host noise; a pinned host core
+    binds whatever the smaller fractions say (everything downstream of
+    a GIL-saturated process is starved, not slow).
+    """
+    u = {k: float(util.get(k, 0.0) or 0.0) for k in UTIL_KEYS}
+    if u["qps"] < 0.5 and u["host_cpu"] < 0.05 and u["device_frac"] < 0.05:
+        return "idle"
+    if u["device_occ"] >= 0.75 or u["device_frac"] >= 0.60:
+        return "device"
+    if u["host_cpu"] >= 0.85:
+        return "host"
+    if u["wire_frac"] >= 0.35 and u["wire_frac"] >= u["dispatch_frac"]:
+        return "wire"
+    if u["dispatch_frac"] >= 0.30:
+        return "dispatch"
+    candidates = {
+        "device": max(u["device_occ"], u["device_frac"]),
+        "host": u["host_cpu"],
+        "wire": u["wire_frac"],
+        "dispatch": u["dispatch_frac"],
+    }
+    best = max(candidates, key=lambda k: candidates[k])
+    return best if candidates[best] >= 0.05 else "idle"
+
+
+def _proc_self_cpu_s() -> float:
+    """This process's utime+stime in seconds (0.0 off-Linux)."""
+    try:
+        with open("/proc/self/stat", "rb") as fh:
+            raw = fh.read().decode("ascii", "replace")
+        fields = raw[raw.rfind(")") + 2:].split()
+        return (int(fields[11]) + int(fields[12])) \
+            / float(os.sysconf("SC_CLK_TCK"))
+    except (OSError, IndexError, ValueError):
+        return 0.0
+
+
+def plane_reading(plane: str) -> Dict[str, float]:
+    """CUMULATIVE raw reading for one plane, from the process registry.
+
+    Built on the unconditional ``serve.latency.*`` histograms — their
+    ``sum`` advances for EVERY request, so busy-time fractions are
+    exact. The sampled ``span.*`` histograms would undercount by the
+    sample rate.
+    """
+    from multiverso_tpu.telemetry.metrics import get_registry
+    reg = get_registry()
+    now = time.monotonic()
+    if plane == "client":
+        # The client plane is Python-thread work (load loops + reader
+        # threads): whole-process CPU is its ceiling — the GIL caps the
+        # sum at one core no matter the thread count.
+        return {"t": now, "requests": 0.0, "cpu_s": _proc_self_cpu_s(),
+                "queue_ms": 0.0, "dispatch_ms": 0.0, "device_ms": 0.0,
+                "wire_ms": 0.0, "occ_sum": 0.0, "occ_n": 0.0,
+                "depth": 0.0}
+    prof_cpu = 0.0
+    try:
+        from multiverso_tpu.telemetry.profile import get_profiler
+        p = get_profiler()
+        if p is not None:
+            prof_cpu = p.plane_cpu_s("serve")
+    except Exception:  # noqa: BLE001 - profiler optional
+        prof_cpu = 0.0
+    occ = reg.gauge("serve.pipeline.inflight").snapshot()
+    return {
+        "t": now,
+        "requests": float(reg.counter("serve.replies").value),
+        "cpu_s": prof_cpu,
+        "queue_ms": float(reg.histogram("serve.latency.admit").sum),
+        "dispatch_ms": float(reg.histogram("serve.latency.batch").sum),
+        "device_ms": float(reg.histogram("serve.latency.device").sum),
+        "wire_ms": float(reg.histogram("serve.latency.reply").sum),
+        "occ_sum": float(occ["mean"]) * occ["samples"],
+        "occ_n": float(occ["samples"]),
+        "depth": float(reg.gauge("serve.pipeline.depth").last),
+    }
+
+
+_prev: Dict[str, Dict[str, float]] = {}
+_lock = threading.Lock()
+
+
+def _utilization(cur: Mapping[str, float],
+                 prev: Optional[Mapping[str, float]]) -> Dict[str, float]:
+    if prev is None:
+        # First call: classify cumulative totals over a 1s trailing
+        # floor (monotonic clocks give no process-start anchor); the
+        # verdict self-corrects on the next differentiated call.
+        prev = {k: 0.0 for k in cur}
+        prev["t"] = cur["t"] - 1.0
+    dt = max(1e-6, cur["t"] - prev["t"])
+
+    def d(key: str) -> float:
+        return max(0.0, cur.get(key, 0.0) - prev.get(key, 0.0))
+    occ_n = d("occ_n")
+    depth = cur.get("depth", 0.0)
+    occ = (d("occ_sum") / occ_n / depth) if (occ_n > 0 and depth > 0) \
+        else 0.0
+    return {
+        "qps": d("requests") / dt,
+        "host_cpu": d("cpu_s") / dt,
+        "device_occ": max(0.0, min(1.0, occ)),
+        "device_frac": min(1.0, d("device_ms") / 1e3 / dt),
+        "wire_frac": min(1.0, d("wire_ms") / 1e3 / dt),
+        "dispatch_frac": min(1.0, d("dispatch_ms") / 1e3 / dt),
+        "queue_frac": min(1.0, d("queue_ms") / 1e3 / dt),
+        "window_s": dt,
+    }
+
+
+def verdict(plane: str,
+            overrides: Optional[Mapping[str, float]] = None) -> Dict:
+    """Classify one plane's CURRENT window and publish the verdict.
+
+    The window is the span since the previous ``verdict(plane)`` call
+    (first call: trailing ~1s floor). ``overrides`` patches utilization
+    keys the caller measured out-of-band (the bench sweep passes its
+    own qps and CPU%), without touching the differentiation state.
+    """
+    cur = plane_reading(plane)
+    with _lock:
+        prev = _prev.get(plane)
+        _prev[plane] = cur
+    util = _utilization(cur, prev)
+    if overrides:
+        util.update({k: float(v) for k, v in overrides.items()})
+    bound = classify(util)
+    from multiverso_tpu.telemetry.metrics import gauge
+    # Two-member literal plane enum: bounded by construction.
+    # graftlint: disable=unbounded-metric-name
+    gauge("roofline." + plane + ".bound").set(BOUND_CODES[bound])
+    return {
+        "plane": plane,
+        "bound": bound,
+        "util": {k: round(v, 4) for k, v in util.items()},
+    }
+
+
+def reset_roofline() -> None:
+    """Test isolation: forget differentiation baselines."""
+    with _lock:
+        _prev.clear()

@@ -431,6 +431,23 @@ define_bool("telemetry_flight", True, "arm the flight recorder's wedge "
 define_double("telemetry_ts_interval", 1.0, "seconds between timeseries "
               "ticks / alert rule evaluations (the downsampled window "
               "width burn rates are computed over)")
+# Attribution layer: continuous profiler + tail exemplars
+# (telemetry/profile.py, telemetry/critical_path.py;
+# docs/OBSERVABILITY.md "Attribution").
+define_bool("telemetry_profile", False, "run the continuous sampling "
+            "profiler: a daemon thread samples sys._current_frames() at "
+            "-telemetry_profile_hz into a bounded folded-stack aggregate "
+            "with per-thread CPU attribution (profile.host_bound_pct "
+            "per plane feeds the roofline classifier)")
+define_double("telemetry_profile_hz", 4.0, "continuous profiler sample "
+              "rate in Hz (bounded 0.2..50; each sample is one thread "
+              "enumerate + bounded stack walk)")
+define_bool("telemetry_exemplars", True, "keep per-plane tail-exemplar "
+            "reservoirs: the slowest-N requests per window with their "
+            "full phase ledgers and trace ids, shipped in heartbeats "
+            "and embedded in snapshots/postmortems")
+define_int("telemetry_exemplar_n", 8, "tail-exemplar reservoir capacity "
+           "per plane per rotation window")
 # Data-plane traffic sketches (telemetry/sketch.py; docs/OBSERVABILITY.md
 # "Data-plane load").
 define_bool("telemetry_sketch", True, "record streaming hot-key sketches "

@@ -90,12 +90,33 @@ def box_fingerprint(record):
     return (box.get("cores"), box.get("machine"))
 
 
-def evaluate(records, tolerance=0.15, window=8, min_history=3):
+def _p99(record):
+    """Tail latency of a record, or None for legs that don't carry one
+    (pre-v3 history, non-latency benchmarks)."""
+    lat = record.get("latency_ms")
+    if not isinstance(lat, dict) or "p99" not in lat:
+        return None
+    try:
+        return float(lat["p99"])
+    except (TypeError, ValueError):
+        return None
+
+
+def evaluate(records, tolerance=0.15, window=8, min_history=3,
+             lat_tolerance=0.50):
     """The gate decision for the NEWEST record against its trailing
     history. Returns a dict with ``status`` in
     {"ok", "regression", "warn_box_mismatch", "insufficient_history",
     "empty"} plus the numbers behind it — pure function, unit-testable,
-    shared by the CLI and its --dry-run self-test."""
+    shared by the CLI and its --dry-run self-test.
+
+    Two gated axes (ISSUE 18): throughput (achieved_qps below the
+    trailing median's noise band) AND tail latency (p99 above the
+    band). A serving change that holds QPS while doubling p99 is a
+    regression the QPS-only gate waved through. Same comparability and
+    box-fingerprint discipline for both; records without a p99 (old
+    history) simply drop out of the latency basis, abstaining on that
+    axis rather than inventing a ceiling."""
     if not records:
         return {"status": "empty"}
     newest = records[-1]
@@ -114,6 +135,7 @@ def evaluate(records, tolerance=0.15, window=8, min_history=3):
         "n_same_box": len(same_box),
         "window": len(basis),
         "tolerance": tolerance,
+        "lat_tolerance": lat_tolerance,
     }
     if len(basis) < min_history:
         out["status"] = "insufficient_history"
@@ -123,8 +145,21 @@ def evaluate(records, tolerance=0.15, window=8, min_history=3):
     floor = med * (1.0 - tolerance)
     out["trailing_median_qps"] = round(med, 1)
     out["floor_qps"] = round(floor, 1)
-    regressed = out["achieved_qps"] < floor
-    if not regressed:
+    regressed_axes = []
+    if out["achieved_qps"] < floor:
+        regressed_axes.append("qps")
+    p99 = _p99(newest)
+    lat_basis = [v for v in (_p99(r) for r in basis) if v is not None]
+    if p99 is not None and len(lat_basis) >= min_history:
+        lat_med = statistics.median(lat_basis)
+        ceiling = lat_med * (1.0 + lat_tolerance)
+        out["p99_ms"] = round(p99, 3)
+        out["trailing_median_p99_ms"] = round(lat_med, 3)
+        out["ceiling_p99_ms"] = round(ceiling, 3)
+        if p99 > ceiling:
+            regressed_axes.append("p99")
+    out["regressed_axes"] = regressed_axes
+    if not regressed_axes:
         out["status"] = "ok"
     elif strict:
         out["status"] = "regression"
@@ -136,10 +171,13 @@ def evaluate(records, tolerance=0.15, window=8, min_history=3):
     return out
 
 
-def _fake(qps, benchmark="serve_lookup", cores=4, rows=1000):
-    return {"benchmark": benchmark, "achieved_qps": qps,
-            "box": {"cores": cores, "machine": "x86_64"},
-            "config": {"replicas": 0, "dry_run": False, "rows": rows}}
+def _fake(qps, benchmark="serve_lookup", cores=4, rows=1000, p99=None):
+    r = {"benchmark": benchmark, "achieved_qps": qps,
+         "box": {"cores": cores, "machine": "x86_64"},
+         "config": {"replicas": 0, "dry_run": False, "rows": rows}}
+    if p99 is not None:
+        r["latency_ms"] = {"p99": p99}
+    return r
 
 
 def _rebal(qps):
@@ -177,6 +215,18 @@ def self_test():
         ("rebalance-enabled history gates rebalance-enabled runs",
          [_rebal(q) for q in (500.0, 510.0, 495.0, 505.0)]
          + [_rebal(400.0)], "regression"),
+        # p99 axis (ISSUE 18): QPS can hold while the tail blows up.
+        ("p99 spike with steady QPS fails",
+         [_fake(q, p99=5.0) for q in (500.0, 510.0, 495.0, 505.0)]
+         + [_fake(502.0, p99=9.0)], "regression"),
+        ("p99 inside the band passes",
+         [_fake(q, p99=5.0) for q in (500.0, 510.0, 495.0, 505.0)]
+         + [_fake(502.0, p99=6.0)], "ok"),
+        ("p99 spike on a DIFFERENT box only warns",
+         [_fake(q, p99=5.0) for q in (500.0, 510.0, 495.0, 505.0)]
+         + [_fake(502.0, cores=1, p99=9.0)], "warn_box_mismatch"),
+        ("p99-less history abstains on latency, still gates QPS",
+         steady + [_fake(502.0, p99=9.0)], "ok"),
     ]
     failures = 0
     for name, records, want in cases:
@@ -205,6 +255,10 @@ def main():
     p.add_argument("--tolerance", type=float, default=0.15,
                    help="allowed fractional drop below the trailing "
                    "median before the gate fails (noise band)")
+    p.add_argument("--lat-tolerance", type=float, default=0.50,
+                   help="allowed fractional p99 rise above the trailing "
+                   "median before the gate fails (tails are noisier "
+                   "than medians, so the band is wider than --tolerance)")
     p.add_argument("--window", type=int, default=8,
                    help="trailing comparable records the median spans")
     p.add_argument("--min-history", type=int, default=3,
@@ -223,15 +277,24 @@ def main():
         return 2
     result = evaluate(load_history(args.history),
                       tolerance=args.tolerance, window=args.window,
-                      min_history=args.min_history)
+                      min_history=args.min_history,
+                      lat_tolerance=args.lat_tolerance)
     print(json.dumps(result, indent=1))
     status = result["status"]
     if status == "regression":
-        print(f"FAIL: achieved_qps {result['achieved_qps']} fell below "
-              f"{result['floor_qps']} (trailing median "
-              f"{result['trailing_median_qps']} - "
-              f"{100 * result['tolerance']:.0f}%) on the same box",
-              file=sys.stderr)
+        axes = result.get("regressed_axes", [])
+        if "qps" in axes:
+            print(f"FAIL: achieved_qps {result['achieved_qps']} fell "
+                  f"below {result['floor_qps']} (trailing median "
+                  f"{result['trailing_median_qps']} - "
+                  f"{100 * result['tolerance']:.0f}%) on the same box",
+                  file=sys.stderr)
+        if "p99" in axes:
+            print(f"FAIL: p99 {result['p99_ms']}ms rose above "
+                  f"{result['ceiling_p99_ms']}ms (trailing median "
+                  f"{result['trailing_median_p99_ms']}ms + "
+                  f"{100 * result['lat_tolerance']:.0f}%) on the same "
+                  "box", file=sys.stderr)
         return 1
     if status == "warn_box_mismatch":
         print("warning: newest record regressed vs history from a "

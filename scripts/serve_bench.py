@@ -339,22 +339,40 @@ def _trace_smoke(spans, idx) -> dict:
     return smoke
 
 
-def _trace_report(tdir: str, k: int) -> dict:
+def _trace_report(tdir: str, k: int, probe_traces=None) -> dict:
     """Stitch every per-process trace under ``tdir`` and distill the
     bench-record tracing block."""
-    from multiverso_tpu.telemetry import stitch_traces, trace_index
+    from multiverso_tpu.telemetry import (analyze_critical_paths,
+                                          stitch_traces, trace_index)
     paths = glob.glob(os.path.join(tdir, "trace-*.json"))
     stitched_path = os.path.join(tdir, "stitched.json")
     stitched = stitch_traces(paths, out_path=stitched_path)
     spans = [e for e in stitched["traceEvents"]
              if e.get("ph") == "X" and e.get("args", {}).get("trace")]
     idx = trace_index(spans)
+    # Critical-path decomposition (ISSUE 18): every stitched trace's
+    # phase ledger, with the conservation rate and the published
+    # residual. The probe sub-report restricts to the paced attribution
+    # probe's traces — low-load serial requests whose scheduling gaps
+    # are small, so conservation there is the acceptance gate.
+    cp = analyze_critical_paths(spans, slow_k=k)
+    if probe_traces:
+        want = set(probe_traces)
+        cp["probe"] = analyze_critical_paths(
+            [e for e in spans if e["args"]["trace"] in want],
+            slow_k=k, publish=False)
+    # Witness that the residual actually reached the metrics plane
+    # (decompose publishes latency.unattributed per trace).
+    from multiverso_tpu.telemetry import get_registry
+    cp["published_residual"] = \
+        get_registry().histogram("latency.unattributed").snapshot()
     return {
         "n_trace_files": len(paths),
         "n_traces": len(idx),
         "n_spans": len(spans),
         "stitched_path": stitched_path,
         "stage_breakdown": _stage_breakdown(spans),
+        "critical_path": cp,
         "slowest": _slowest_timelines(spans, idx, k),
         "trace_smoke": _trace_smoke(spans, idx),
     }
@@ -776,6 +794,16 @@ def run_single(args) -> dict:
                 .astype(np.int32), deadline_ms=10_000, timeout=120)
     warm.close()
 
+    # Attribution plane (ISSUE 18): the continuous profiler feeds the
+    # serve-plane roofline verdict its CPU attribution; priming both
+    # plane baselines here makes the end-of-run verdicts classify the
+    # whole load window, not a 1s trailing floor.
+    from multiverso_tpu.telemetry import start_profiler
+    from multiverso_tpu.telemetry.roofline import verdict as _rl_verdict
+    start_profiler()
+    _rl_verdict("serve")
+    _rl_verdict("client")
+
     clients = [ServingClient(*service.address) for _ in range(args.threads)]
     next_client = [0]
     pick_lock = threading.Lock()
@@ -844,6 +872,7 @@ def run_single(args) -> dict:
                              dur, args.rows, args.keys_per_req, sampler)
         observability = {
             "ab": _observability_ab(args, ab_window),
+            "attribution_ab": _attribution_ab(args, ab_window),
             "slo_breach": _slo_breach_probe(args),
             # Stuck-free steady state: the bench process runs the
             # batcher/collector/exporter loops — none may have tripped.
@@ -854,12 +883,26 @@ def run_single(args) -> dict:
                     "telemetry.watchdog.loops").last),
             },
         }
+        start_profiler()    # the A/B's last leg stopped the singleton;
+                            # the end-of-run roofline verdict wants it
 
     # Hot-key sketch recovery + cache-headroom advisor witness
     # (ISSUE 14): planted-Zipf stream through the live serving path.
     hotkeys = None
     if args.dry_run or args.zipf > 0.0:
         hotkeys = _hotkey_probe(args, do_request)
+
+    # Critical-path attribution probe (ISSUE 18) — LAST load against the
+    # live service, so its paced traces land at the tail of the span
+    # buffer, then the per-plane roofline verdicts over the whole run.
+    probe_traces = _attribution_probe(args, clients[0])
+    roofline = {"serve": _rl_verdict("serve"),
+                "client": _rl_verdict("client")}
+    # Snapshot the tail exemplars NOW: the decode leg below runs its own
+    # (untraced) requests through the serve reservoir and its ~100 ms
+    # decode batches would evict every resolvable lookup exemplar.
+    from multiverso_tpu.telemetry import exemplar_payload, profile_state
+    exemplars = exemplar_payload("serve")
 
     for cli in clients:
         cli.close()
@@ -877,6 +920,16 @@ def run_single(args) -> dict:
                           _metric_families(("serve.",)))
     record["process_cpu_pct"] = {"bench": cpu_pct}
     record["pipeline"] = probe
+    # Attribution embeds (ISSUE 18): per-plane bound verdicts, the
+    # slowest-request exemplar ledgers (trace ids resolvable against the
+    # stitched file below), and the process profile aggregate.
+    record["roofline"] = roofline
+    record["exemplars"] = exemplars
+    prof = profile_state()
+    if prof is not None:
+        record["profile"] = {k: v for k, v in prof.items()
+                             if k != "stacks"}
+        record["profile"]["n_stacks"] = len(prof.get("stacks", {}))
     if observability is not None:
         record["observability"] = observability
     if hotkeys is not None:
@@ -893,7 +946,7 @@ def run_single(args) -> dict:
     tdir = args.telemetry_dir or tempfile.mkdtemp(prefix="serve_trace_")
     _export_local_trace(tdir)
     record["tracing"] = _tracing_block(args, tdir, record["achieved_qps"],
-                                       qps_untraced)
+                                       qps_untraced, probe_traces)
     return record
 
 
@@ -983,28 +1036,34 @@ def _run_qps_sweep(args, run_at_qps, cpu_probe, cores: int) -> dict:
         knee = p["offered_qps"]
     out = {"points": points, "knee_qps": knee,
            "knee_ratio_threshold": 0.9}
-    # Client-bound warning: at the first point past the knee, the bench
-    # process is pinned (>= 85% of one core) while every server-side
-    # process still has headroom — the measured ceiling is the load
-    # generator/box, not the serving plane.
+    # Client-bound warning, via the roofline classifier (replaces the
+    # PR-9 ad-hoc CPU%% threshold): at the first point past the knee,
+    # classify the bench client's plane from its measured CPU — a
+    # ``host`` verdict while every server-side process has headroom
+    # means the measured ceiling is the load generator/box, not the
+    # serving plane.
+    from multiverso_tpu.telemetry.roofline import classify
     past = [p for p in points if knee is None
             or p["offered_qps"] > knee] or points[-1:]
     if past:
         p = past[0]
         bench = p["cpu_pct"].get("bench", 0.0)
         servers = [v for k, v in p["cpu_pct"].items() if k != "bench"]
-        if bench >= 85.0 and (not servers or max(servers) < 80.0):
+        bound = classify({"qps": p["achieved_qps"],
+                          "host_cpu": bench / 100.0})
+        out["client_bound"] = bound
+        if bound == "host" and (not servers or max(servers) < 80.0):
             out["warning"] = (
-                f"bench client CPU-bound at {p['offered_qps']} offered "
-                f"QPS (client {bench}%, max server "
-                f"{max(servers) if servers else 'n/a'}% of one core, "
-                f"{cores} cores): the knee measures the bench box, not "
-                "the serving plane")
+                f"bench client host-bound at {p['offered_qps']} offered "
+                f"QPS (roofline verdict 'host': client {bench}%, max "
+                f"server {max(servers) if servers else 'n/a'}% of one "
+                f"core, {cores} cores): the knee measures the bench "
+                "box, not the serving plane")
     return out
 
 
 def _tracing_block(args, tdir: str, qps_traced: float,
-                   qps_untraced: float) -> dict:
+                   qps_untraced: float, probe_traces=None) -> dict:
     overhead = round(100.0 * (1.0 - qps_traced / qps_untraced), 2) \
         if qps_untraced > 0 else 0.0
     return {
@@ -1013,8 +1072,85 @@ def _tracing_block(args, tdir: str, qps_traced: float,
         "qps_untraced": round(qps_untraced, 1),
         "overhead_pct": overhead,
         "telemetry_dir": tdir,
-        **_trace_report(tdir, args.slow_k),
+        **_trace_report(tdir, args.slow_k, probe_traces),
     }
+
+
+def _attribution_probe(args, client, n: int = 40) -> list:
+    """Paced, guaranteed-sampled serial requests for the critical-path
+    conservation witness. Serial + paced matters: the ledger's phases
+    are measured spans, so the residual is pure scheduling gap — under
+    concurrent load those gaps are queueing someone else caused, while
+    here they must stay under the conservation tolerance. Returns the
+    probe requests' trace ids (the ``tracing.critical_path.probe``
+    sub-report restricts to exactly these)."""
+    from multiverso_tpu.serving import ShedError
+    _set_sample_rate(1.0)
+    rng = np.random.default_rng(23)
+    traces = []
+    for _ in range(n):
+        keys = rng.integers(0, args.rows, args.keys_per_req) \
+            .astype(np.int32)
+        try:
+            res = client.request_async(keys, deadline_ms=10_000)
+            res.wait(60)
+        except ShedError:
+            continue
+        ctx = getattr(res, "ctx", None)
+        if ctx is not None and getattr(ctx, "sampled", False):
+            traces.append(ctx.trace_hex)
+        time.sleep(0.004)
+    # Tail-exemplar leg: a SAMPLED concurrent burst. The burst queues on
+    # itself, so its stragglers land in the slowest-N reservoir with
+    # trace ids the stitched file can resolve — the "why was p99 slow"
+    # evidence chain from exemplar to cross-process timeline. Burst
+    # traces stay OUT of the conservation probe set: their residual is
+    # send-lock convoy the serial probe exists to avoid.
+    keys = rng.integers(0, args.rows, args.keys_per_req).astype(np.int32)
+    burst = [client.request_async(keys, deadline_ms=10_000)
+             for _ in range(max(8 * args.max_batch, 64))]
+    for res in burst:
+        try:
+            res.wait(60)
+        except ShedError:
+            pass    # past the admission bound: shedding is the design
+    _set_sample_rate(0.0)
+    return traces
+
+
+def _attribution_ab(args, run_window) -> dict:
+    """Interleaved A/B (plain, attributed, plain, attributed): QPS with
+    the continuous profiler + exemplar reservoirs running vs without.
+    The unconditional stage histograms run in BOTH legs (they predate
+    this plane); the A/B isolates what ``-telemetry_profile`` /
+    ``-telemetry_exemplars`` can turn off — the acceptance bound is
+    <= 1% on a quiet box."""
+    from multiverso_tpu.telemetry import (set_exemplars_enabled,
+                                          start_profiler, stop_profiler)
+    dur = max(args.duration / 2, 1.0)
+    n = {"plain": 0, "attributed": 0}
+    elapsed = {"plain": 0.0, "attributed": 0.0}
+    for _round in range(2):
+        for mode in ("plain", "attributed"):
+            set_exemplars_enabled(mode == "attributed")
+            if mode == "attributed":
+                start_profiler()
+            stats = _LoadStats()
+            el = run_window(stats, dur)
+            if mode == "attributed":
+                stop_profiler()
+            set_exemplars_enabled(None)
+            n[mode] += len(stats.latencies)
+            elapsed[mode] += el
+    qps_plain = n["plain"] / elapsed["plain"] if elapsed["plain"] else 0.0
+    qps_attr = n["attributed"] / elapsed["attributed"] \
+        if elapsed["attributed"] else 0.0
+    overhead = round(100.0 * (1.0 - qps_attr / qps_plain), 2) \
+        if qps_plain > 0 else 0.0
+    return {"qps_plain": round(qps_plain, 1),
+            "qps_attributed": round(qps_attr, 1),
+            "overhead_pct": overhead,
+            "windows": 4, "window_s": dur}
 
 
 # ---------------------------------------------------------------------------
@@ -1081,6 +1217,10 @@ def _spawn_replica(args, router_addr, idx: int,
            "-telemetry_interval=2",
            "-telemetry_alerts=true", "-telemetry_flight=true",
            "-telemetry_ts_interval=0.25",
+           # Attribution plane (ISSUE 18): the replica's continuous
+           # profiler feeds its serve-plane roofline verdict, which
+           # ships on the heartbeat into Fleet_Stats.
+           "-telemetry_profile=true",
            "-serve_device=cpu"]
     if slo_ms is not None:
         cmd.append(f"-serve_slo_ms={slo_ms}")
@@ -2345,6 +2485,11 @@ def run_fleet(args) -> dict:
             fleet.lookup(rng.integers(0, args.rows, args.keys_per_req)
                          .astype(np.int32), deadline_ms=10_000, timeout=60)
 
+        # Roofline baseline for the bench client's own plane — the
+        # end-of-run verdict then classifies the whole load window.
+        from multiverso_tpu.telemetry.roofline import verdict as _rl_verdict
+        _rl_verdict("client")
+
         parity_ok = _parity_check(fleet, table, args.rows,
                                   args.keys_per_req)
         sampler = _key_sampler(args.rows, args.keys_per_req,
@@ -2650,18 +2795,35 @@ def run_fleet(args) -> dict:
         }
         if sweep is not None:
             record["qps_sweep"] = sweep
-        # Box-constraint honesty: when the bench client is pinned while
-        # every replica has headroom, the achieved number measures the
-        # bench box (ROADMAP 2(a)), and the record says so.
+        # Attribution embeds (ISSUE 18): the bench client classifies its
+        # own plane locally; each replica's serve-plane verdict + tail
+        # exemplars arrived on the heartbeat and sit in the rollup.
+        client_verdict = _rl_verdict(
+            "client", overrides={"qps": record["achieved_qps"],
+                                 "host_cpu":
+                                 cpu_pct.get("bench", 0.0) / 100.0})
+        record["roofline"] = {
+            "client": client_verdict,
+            "replicas": {rid: row.get("roofline", {})
+                         for rid, row in
+                         fleet_stats.get("replicas", {}).items()},
+        }
+        record["exemplars"] = fleet_stats.get("fleet", {}) \
+            .get("exemplars", [])
+        # Box-constraint honesty via the roofline verdict (replaces the
+        # PR-9 ad-hoc CPU%% threshold): a host-bound bench client while
+        # every replica has headroom means the achieved number measures
+        # the bench box (ROADMAP 2(a)), and the record says so.
         replica_cpu = [v for k, v in cpu_pct.items()
                        if k.startswith("replica")]
-        if cpu_pct.get("bench", 0.0) >= 85.0 and replica_cpu \
+        if client_verdict["bound"] == "host" and replica_cpu \
                 and max(replica_cpu) < 80.0:
             record["warning"] = (
-                f"bench client CPU-bound (client {cpu_pct['bench']}%, "
-                f"max replica {max(replica_cpu)}% of one core): achieved "
-                "QPS is capped by the load generator/box, not the "
-                "serving plane")
+                f"bench client host-bound (roofline verdict 'host': "
+                f"client {cpu_pct['bench']}%, max replica "
+                f"{max(replica_cpu)}% of one core): achieved QPS is "
+                "capped by the load generator/box, not the serving "
+                "plane")
         if drill:
             record["drill"] = drill
         if args.baseline and os.path.exists(args.baseline):
@@ -2743,7 +2905,16 @@ def _make_record(benchmark: str, args, stats: _LoadStats,
         # router-kill round (SIGKILL the router, respawn on the same
         # port, replicas + clients reconnect via connect_with_backoff);
         # config grows hotkey_replicas/rebalance/cache_mem_budget.
-        "schema": "multiverso_tpu.bench_serve/v10",
+        # v11: + attribution layer (ISSUE 18): tracing.critical_path
+        # (per-trace phase ledgers, conservation rate, published
+        # residual, paced-probe sub-report), roofline (per-plane bound
+        # verdicts — client locally, replica serve planes via the
+        # heartbeat rollup), exemplars (slowest-request phase ledgers
+        # with resolvable trace ids), profile (sampling-profiler
+        # summary), observability.attribution_ab (ledger+profiler
+        # overhead A/B, acceptance <= 1%); the client-CPU-bound
+        # warnings now come from the roofline classifier.
+        "schema": "multiverso_tpu.bench_serve/v11",
         "benchmark": benchmark,
         "time_unix": time.time(),
         "box": {"cores": os.cpu_count(),

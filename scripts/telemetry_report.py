@@ -33,6 +33,15 @@ Usage:
     # merged across the run's processes
     python scripts/telemetry_report.py /tmp/t --hotkeys
 
+    # cross-process flamegraph: merged sampling-profiler aggregates
+    # (folded stacks + per-plane CPU attribution) from the snapshots
+    python scripts/telemetry_report.py /tmp/t --profile
+
+    # critical-path attribution: per-trace phase ledgers over the
+    # stitched spans — phase shares, conservation rate, residual, the
+    # slowest requests' ledgers verbatim
+    python scripts/telemetry_report.py /tmp/t --critical-path
+
 No jax import: usable on any host, including ones without the TPU tunnel.
 """
 
@@ -255,6 +264,82 @@ def print_hotkeys(telemetry_dir, snapshots, topn=10):
     return len(surfaces)
 
 
+def print_profile(telemetry_dir, snapshots, top=20):
+    """Merged sampling-profiler view across the run's processes: plane
+    CPU attribution + the hottest folded stacks (paste into a
+    flamegraph tool as-is). Returns the number of merged profiles."""
+    from multiverso_tpu.telemetry import merge_profiles
+    states = [s["profile"] for s in snapshots if s.get("profile")]
+    if not states:
+        print(f"no profile section in any snapshot under {telemetry_dir} "
+              f"(was -telemetry_profile off?)")
+        return 0
+    merged = merge_profiles(states)
+    wall = max(merged.get("wall_s", 0.0), 1e-9)
+    print(f"== profile: {len(states)} process(es), "
+          f"{merged['samples']} samples over {merged['wall_s']:.1f}s wall")
+    planes = merged.get("planes") or {}
+    if planes:
+        total_cpu = sum(d.get("cpu_s", 0.0) for d in planes.values())
+        print(f"{'plane':12s} {'samples':>8s} {'cpu_s':>9s} "
+              f"{'cpu%wall':>9s} {'share%':>7s}")
+        for name in sorted(planes, key=lambda p: -planes[p]["cpu_s"]):
+            d = planes[name]
+            print(f"{name:12s} {d['samples']:8d} {d['cpu_s']:9.3f} "
+                  f"{100 * d['cpu_s'] / wall:9.1f} "
+                  f"{100 * d['cpu_s'] / max(total_cpu, 1e-9):7.1f}")
+    stacks = sorted((merged.get("stacks") or {}).items(),
+                    key=lambda kv: -kv[1])[:top]
+    if stacks:
+        print(f"\ntop {len(stacks)} folded stacks (count stack):")
+        for stack, count in stacks:
+            print(f"{count:6d} {stack}")
+    return len(states)
+
+
+def print_critical_path(telemetry_dir, slow_k=3):
+    """Phase-ledger attribution over the run's stitched spans
+    (telemetry/critical_path.py): aggregate phase shares, the
+    conservation rate, the mean residual, and the slowest requests'
+    per-trace ledgers. Returns the number of decomposed traces."""
+    from multiverso_tpu.telemetry import (analyze_critical_paths,
+                                          stitch_traces)
+    paths = glob.glob(os.path.join(telemetry_dir, "trace-*.json"))
+    if not paths:
+        print(f"no trace-*.json under {telemetry_dir}", file=sys.stderr)
+        return 0
+    stitched = stitch_traces(paths)
+    spans = [e for e in stitched["traceEvents"] if e.get("ph") == "X"]
+    cp = analyze_critical_paths(spans, slow_k=slow_k, publish=False)
+    print(f"== critical path: {cp['n_traces']} trace(s), "
+          f"{cp['n_decomposed']} decomposed, conservation "
+          f"{100 * cp['conserved_frac']:.1f}% within "
+          f"{100 * cp['tolerance']:.0f}% tolerance")
+    ua = cp["unattributed"]
+    print(f"   residual: mean {ua['mean_ms']:.3f} ms "
+          f"({100 * ua['mean_frac']:.1f}% of e2e), bridged transit "
+          f"{cp['bridged_mean_ms']:.3f} ms/trace")
+    e2e = cp.get("e2e_ms") or {}
+    if e2e:
+        print(f"   e2e ms: p50 {e2e.get('p50', 0.0):.3f}  "
+              f"p95 {e2e.get('p95', 0.0):.3f}  "
+              f"p99 {e2e.get('p99', 0.0):.3f}")
+    if cp["phases"]:
+        print(f"\n{'phase':12s} {'total_ms':>12s} {'share%':>7s}")
+        for name, d in sorted(cp["phases"].items(),
+                              key=lambda kv: -kv[1]["total_ms"]):
+            print(f"{name:12s} {d['total_ms']:12.3f} "
+                  f"{100 * d['share']:7.1f}")
+    for d in cp.get("slowest", []):
+        cells = " ".join(
+            f"{k}={v:.2f}" for k, v in
+            sorted(d["phases"].items(), key=lambda kv: -kv[1]))
+        flag = "" if d["conserved"] else "  [NOT CONSERVED]"
+        print(f"\nslow {d['trace'][:16]}…  e2e {d['e2e_ms']:.3f} ms  "
+              f"residual {d['unattributed_ms']:.3f} ms{flag}\n   {cells}")
+    return cp["n_decomposed"]
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("telemetry_dir", help="run's -telemetry_dir")
@@ -276,6 +361,14 @@ def main():
                    help="print per-surface data-plane hot-key tables "
                    "from the snapshots' traffic-sketch sections "
                    "(merged across processes) and exit")
+    p.add_argument("--profile", action="store_true",
+                   help="print the merged sampling-profiler view "
+                   "(plane CPU attribution + hottest folded stacks) "
+                   "from the snapshots' profile sections and exit")
+    p.add_argument("--critical-path", action="store_true",
+                   help="stitch the run's traces and print the "
+                   "phase-ledger attribution: phase shares, "
+                   "conservation rate, residual, slowest ledgers; exits")
     p.add_argument("--full", action="store_true",
                    help="with --postmortem: print every thread stack "
                    "and the whole log tail")
@@ -293,6 +386,18 @@ def main():
             return 1
         return 0 if print_hotkeys(args.telemetry_dir, snapshots) > 0 \
             else 1
+
+    if args.profile:
+        snapshots = latest_snapshots(args.telemetry_dir)
+        if not snapshots:
+            print(f"no metrics-*.json under {args.telemetry_dir}",
+                  file=sys.stderr)
+            return 1
+        return 0 if print_profile(args.telemetry_dir, snapshots) > 0 \
+            else 1
+
+    if args.critical_path:
+        return 0 if print_critical_path(args.telemetry_dir) > 0 else 1
 
     if args.merge_trace:
         from multiverso_tpu.telemetry import merge_traces

@@ -1,0 +1,30 @@
+"""Positive fixture: hot-path waits no phase-ledger span can see."""
+import time
+
+
+def drain_queue(q):
+    return q.get()  # expect: unattributed-wait
+
+
+def drain_queue_timeout(q):
+    return q.get(timeout=0.5)  # expect: unattributed-wait
+
+
+def park_on_event(evt):
+    evt.wait(1.0)  # expect: unattributed-wait
+
+
+def paced_retry():
+    time.sleep(0.01)  # expect: unattributed-wait
+
+
+def read_frame(sock):
+    return sock.recv(4096)  # expect: unattributed-wait
+
+
+class Reader:
+    def __init__(self, sock):
+        self._sock = sock
+
+    def accept_peer(self):
+        return self._sock.accept()  # expect: unattributed-wait

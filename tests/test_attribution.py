@@ -1,0 +1,390 @@
+"""Unit tests for the latency truth layer (telemetry/critical_path.py,
+telemetry/profile.py, telemetry/roofline.py): critical-path conservation
+on deterministic synthetic traces, tail-exemplar reservoir bounds and the
+heartbeat round-trip, the profiler's folded-stack merge + memory bound,
+and the roofline truth table. Everything here drives the code with
+synthetic inputs — no sleeps against tickers, no wall-clock assertions
+beyond coarse sample counts."""
+
+import threading
+import time
+
+import pytest
+
+from multiverso_tpu.telemetry.critical_path import (CONCURRENT_PHASES,
+                                                    ExemplarReservoir,
+                                                    analyze_critical_paths,
+                                                    decompose,
+                                                    exemplar_payload,
+                                                    get_reservoir,
+                                                    phase_for_span,
+                                                    reset_critical_path,
+                                                    set_exemplars_enabled)
+from multiverso_tpu.telemetry.profile import (PROFILE_SCHEMA, FoldedStacks,
+                                              SamplingProfiler,
+                                              merge_profiles,
+                                              plane_for_thread)
+from multiverso_tpu.telemetry.roofline import (BOUND_CODES, BOUNDS, classify,
+                                               reset_roofline, verdict)
+
+
+# ---------------------------------------------------------------------------
+# synthetic Chrome-trace spans
+# ---------------------------------------------------------------------------
+
+def _ev(name, ts_us, dur_us, trace="t01", parent="root", **args):
+    a = {"trace": trace}
+    if parent:
+        a["parent"] = parent
+    a.update(args)
+    return {"ph": "X", "name": name, "ts": float(ts_us),
+            "dur": float(dur_us), "args": a}
+
+
+def _pipeline_trace(trace="t01"):
+    """A fully contiguous 10ms request: every microsecond covered by
+    exactly one phase span — the ledger must conserve exactly."""
+    return [
+        _ev("serve.client", 0, 10000, trace=trace, parent=None),
+        _ev("serve.send", 0, 300, trace=trace),          # wire
+        _ev("serve.admission", 300, 300, trace=trace),
+        _ev("serve.admit_wait", 600, 1400, trace=trace),  # queue
+        _ev("serve.batch_form", 2000, 600, trace=trace),
+        _ev("serve.dispatch", 2600, 600, trace=trace),
+        _ev("serve.device", 3200, 4000, trace=trace),
+        _ev("serve.collect", 7200, 600, trace=trace),
+        _ev("serve.reply", 7800, 800, trace=trace),       # wire
+        _ev("serve.deliver", 8600, 1400, trace=trace),
+    ]
+
+
+def test_decompose_conserves_contiguous_pipeline():
+    d = decompose(_pipeline_trace(), publish=False)
+    assert d is not None
+    assert d["root"] == "serve.client"
+    assert d["e2e_ms"] == pytest.approx(10.0)
+    assert d["conserved"] is True
+    assert d["unattributed_ms"] == pytest.approx(0.0, abs=1e-6)
+    assert d["bridged_ms"] == pytest.approx(0.0, abs=1e-6)
+    # phase durations are the spans', in ms
+    assert d["phases"]["device"] == pytest.approx(4.0)
+    assert d["phases"]["queue"] == pytest.approx(1.4)
+    assert d["phases"]["wire"] == pytest.approx(0.3 + 0.8)
+    assert d["attributed_ms"] == pytest.approx(10.0)
+
+
+def test_decompose_bridges_typed_transit_gaps():
+    """Gaps at the three allowlisted boundaries (send->admission,
+    collect->reply, reply->deliver) are wire transit: bridged into the
+    wire phase and tracked in bridged_ms — the ledger still conserves."""
+    spans = [
+        _ev("serve.client", 0, 10000, parent=None),
+        _ev("serve.send", 0, 300),            # wire ... 400us gap
+        _ev("serve.admission", 700, 300),
+        _ev("serve.admit_wait", 1000, 1000),
+        _ev("serve.batch_form", 2000, 600),
+        _ev("serve.dispatch", 2600, 600),
+        _ev("serve.device", 3200, 3800),
+        _ev("serve.collect", 7000, 500),      # ... 300us gap
+        _ev("serve.reply", 7800, 800),        # wire ... 600us gap
+        _ev("serve.deliver", 9200, 800),
+    ]
+    d = decompose(spans, publish=False)
+    assert d["conserved"] is True
+    assert d["bridged_ms"] == pytest.approx(1.3)
+    assert d["unattributed_ms"] == pytest.approx(0.0, abs=1e-6)
+    # bridges land in the wire phase: 0.3 + 0.8 measured + 1.3 bridged
+    assert d["phases"]["wire"] == pytest.approx(2.4)
+
+
+def test_decompose_inner_gap_stays_unattributed():
+    """A hole at a NON-allowlisted boundary (queue -> batch_form) is an
+    uncovered wait: it must land in the residual and break conservation
+    — this is the property the unattributed-wait lint exists to keep."""
+    spans = [
+        _ev("serve.client", 0, 10000, parent=None),
+        _ev("serve.send", 0, 300),
+        _ev("serve.admission", 300, 300),
+        _ev("serve.admit_wait", 600, 400),
+        # 3000us uncovered hole: 1000 -> 4000
+        _ev("serve.batch_form", 4000, 600),
+        _ev("serve.dispatch", 4600, 400),
+        _ev("serve.device", 5000, 3000),
+        _ev("serve.collect", 8000, 400),
+        _ev("serve.reply", 8400, 600),
+        _ev("serve.deliver", 9000, 1000),
+    ]
+    d = decompose(spans, publish=False)
+    assert d["bridged_ms"] == pytest.approx(0.0, abs=1e-6)
+    assert d["unattributed_ms"] == pytest.approx(3.0)
+    assert d["unattributed_frac"] == pytest.approx(0.30)
+    assert d["conserved"] is False
+
+
+def test_decompose_hedge_reported_but_excluded():
+    """A hedge overlaps the primary attempt in wall clock: its duration
+    is reported as the hedge phase but excluded from the conservation
+    sum (a losing hedge added no e2e latency)."""
+    spans = _pipeline_trace()
+    spans.append(_ev("fleet.attempt", 2000, 5000, hedge=1))
+    d = decompose(spans, publish=False)
+    assert d["phases"]["hedge"] == pytest.approx(5.0)
+    assert "hedge" in CONCURRENT_PHASES
+    # conservation unchanged: attributed excludes the concurrent phase
+    assert d["attributed_ms"] == pytest.approx(10.0)
+    assert d["conserved"] is True
+
+
+def test_phase_for_span_attempt_taxonomy():
+    assert phase_for_span("fleet.attempt", {"hedge": 1}) == "hedge"
+    assert phase_for_span("fleet.attempt", {"attempt": 2}) == "retry"
+    assert phase_for_span("fleet.attempt", {"attempt": 1}) is None
+    assert phase_for_span("serve.device") == "device"
+    assert phase_for_span("serve.request") is None      # container
+    assert phase_for_span("no.such.span") is None
+
+
+def test_decompose_clips_overshooting_span_to_root():
+    """A child stamped past the root's end (clock skew, late flush)
+    contributes only its in-root portion."""
+    spans = [
+        _ev("serve.client", 0, 10000, parent=None),
+        _ev("serve.device", 0, 9000),
+        _ev("serve.deliver", 9000, 5000),   # overshoots by 4000us
+    ]
+    d = decompose(spans, publish=False)
+    assert d["phases"]["deliver"] == pytest.approx(1.0)
+    assert d["attributed_ms"] == pytest.approx(10.0)
+
+
+def test_analyze_critical_paths_aggregates(mv_env):
+    from multiverso_tpu.telemetry import get_registry
+    spans = []
+    spans += _pipeline_trace("aaaa")                    # conserved
+    bad = [
+        _ev("serve.client", 0, 10000, trace="bbbb", parent=None),
+        _ev("serve.device", 0, 5000, trace="bbbb"),     # 50% uncovered
+    ]
+    spans += bad
+    # single-span trace: no decomposition signal, must be skipped
+    spans.append(_ev("serve.client", 0, 1000, trace="cccc", parent=None))
+    reg = get_registry()
+    before = reg.histogram("latency.unattributed").snapshot()["count"]
+    out = analyze_critical_paths(spans, slow_k=2)
+    assert out["n_traces"] == 3
+    assert out["n_decomposed"] == 2
+    assert out["n_conserved"] == 1
+    assert out["conserved_frac"] == pytest.approx(0.5)
+    assert out["slowest"][0]["e2e_ms"] >= out["slowest"][-1]["e2e_ms"]
+    assert out["phases"]["device"]["total_ms"] == pytest.approx(9.0)
+    shares = sum(v["share"] for k, v in out["phases"].items()
+                 if k not in CONCURRENT_PHASES)
+    assert shares == pytest.approx(1.0, abs=1e-3)
+    # publish=True (default): the residual histogram saw both traces
+    after = reg.histogram("latency.unattributed").snapshot()["count"]
+    assert after == before + 2
+    assert reg.gauge("latency.unattributed_frac").last is not None
+
+
+# ---------------------------------------------------------------------------
+# tail exemplars
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def exemplars_on():
+    set_exemplars_enabled(True)
+    yield
+    reset_critical_path()       # drops reservoirs AND the override
+
+
+def test_exemplar_reservoir_keeps_slowest_n(exemplars_on):
+    r = ExemplarReservoir("t", capacity=4, window_s=60.0)
+    for ms in (3.0, 9.0, 1.0, 7.0, 5.0, 10.0, 2.0, 8.0):
+        r.offer(ms, {"device": ms / 2}, trace=f"t{ms}")
+    snap = r.snapshot()
+    assert [e["total_ms"] for e in snap] == [10.0, 9.0, 8.0, 7.0]
+    assert len(r) <= 4
+    # floor = slowest kept entry: cheap reject below it, admit above
+    assert not r.would_admit(6.5)
+    assert r.would_admit(7.5)
+    assert not r.offer(6.5, trace="reject")
+    for e in snap:
+        assert e["phases"]["device"] == pytest.approx(e["total_ms"] / 2)
+        assert e["trace"]
+        assert e["age_s"] >= 0.0
+
+
+def test_exemplar_window_rotation(exemplars_on):
+    r = ExemplarReservoir("t", capacity=4, window_s=0.05)
+    r.offer(10.0, trace="old")
+    time.sleep(0.06)
+    r.offer(5.0, trace="new")       # rotates: old -> prev window
+    snap = r.snapshot()
+    assert [e["trace"] for e in snap] == ["old", "new"]
+    time.sleep(0.06)
+    r.offer(4.0, trace="newer")     # second rotation: "old" ages out
+    traces = [e["trace"] for e in r.snapshot()]
+    assert "old" not in traces
+    assert set(traces) == {"new", "newer"}
+
+
+def test_exemplar_gate_off_rejects():
+    set_exemplars_enabled(False)
+    try:
+        r = ExemplarReservoir("t", capacity=4)
+        assert r.offer(100.0, trace="x") is False
+        assert len(r) == 0
+    finally:
+        reset_critical_path()
+
+
+def test_exemplar_heartbeat_roundtrip(mv_env, exemplars_on):
+    """A replica's reservoir rides the health heartbeat: the payload the
+    router rolls into Fleet_Stats carries the trace id verbatim."""
+    from multiverso_tpu.fleet.health import metrics_payload
+    get_reservoir("serve").offer(123.4, {"device": 100.0, "queue": 20.0},
+                                 trace="deadbeef")
+    payload = metrics_payload()
+    ex = payload["exemplars"]
+    assert ex and ex[0]["trace"] == "deadbeef"
+    assert ex[0]["plane"] == "serve"
+    assert ex[0]["total_ms"] == pytest.approx(123.4)
+    assert ex[0]["phases"]["device"] == pytest.approx(100.0)
+    assert payload["roofline"].get("bound") in BOUNDS
+    # and the generic payload helper agrees
+    assert exemplar_payload("serve")[0]["trace"] == "deadbeef"
+    reset_roofline()
+
+
+# ---------------------------------------------------------------------------
+# sampling profiler
+# ---------------------------------------------------------------------------
+
+def test_folded_stacks_bound_folds_into_other():
+    fs = FoldedStacks(max_stacks=3)
+    for i in range(6):
+        fs.add(f"host;mod:f{i}")
+    fs.add("host;mod:f0")           # existing stack still increments
+    assert fs.total() == 7          # counts stay exact in total
+    assert len(fs) == 4             # 3 kept + <other>
+    lines = fs.folded_lines()
+    assert lines[0] == "host;mod:f0 2"
+    assert lines[-1] == f"{FoldedStacks.OTHER} 3"
+
+
+def test_folded_stacks_merge_state_sums():
+    a = FoldedStacks(max_stacks=10)
+    b = FoldedStacks(max_stacks=10)
+    a.add("s1", 3)
+    a.add("s2", 1)
+    b.add("s1", 2)
+    b.add("s3", 5)
+    a.merge_state(b.to_state())
+    merged = dict(line.rsplit(" ", 1) for line in a.folded_lines())
+    assert merged == {"s1": "5", "s2": "1", "s3": "5"}
+    assert a.total() == 11
+    # merging past the bound preserves totals via <other>
+    tiny = FoldedStacks(max_stacks=1)
+    tiny.merge_state(a.to_state())
+    assert tiny.total() == 11
+    assert len(tiny) == 2
+
+
+def test_merge_profiles_sums_planes_and_skips_alien_schemas():
+    st1 = {"schema": PROFILE_SCHEMA, "pid": 100, "samples": 10,
+           "wall_s": 2.0, "stacks": {"serve;a:b": 4}, "other": 0,
+           "planes": {"serve": {"samples": 4, "cpu_s": 0.5}}}
+    st2 = {"schema": PROFILE_SCHEMA, "pid": 200, "samples": 6,
+           "wall_s": 3.0, "stacks": {"serve;a:b": 1, "host;c:d": 2},
+           "other": 1,
+           "planes": {"serve": {"samples": 1, "cpu_s": 0.25},
+                      "host": {"samples": 2, "cpu_s": 1.0}}}
+    alien = {"schema": "something/else", "samples": 999}
+    out = merge_profiles([st1, alien, st2])
+    assert out["schema"] == PROFILE_SCHEMA
+    assert out["pids"] == [100, 200]
+    assert out["samples"] == 16
+    assert out["wall_s"] == pytest.approx(3.0)
+    assert out["stacks"]["serve;a:b"] == 5
+    assert out["planes"]["serve"]["samples"] == 5
+    assert out["planes"]["serve"]["cpu_s"] == pytest.approx(0.75)
+    assert out["planes"]["host"]["cpu_s"] == pytest.approx(1.0)
+
+
+def test_plane_for_thread_prefixes():
+    assert plane_for_thread("serve-client-0") == "client"
+    assert plane_for_thread("serve-collector") == "serve"
+    assert plane_for_thread("fleet-heartbeat") == "fleet"
+    assert plane_for_thread("router-0") == "fleet"
+    assert plane_for_thread("telemetry-profiler") == "telemetry"
+    assert plane_for_thread("MainThread") == "host"
+
+
+def test_sampling_profiler_samples_and_stays_bounded(mv_env):
+    p = SamplingProfiler(hz=50.0, max_stacks=64)
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            sum(i * i for i in range(500))
+
+    t = threading.Thread(target=spin, name="serve-spin", daemon=True)
+    t.start()
+    p.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while p.state()["samples"] < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        p.stop()
+        stop.set()
+        t.join(timeout=2.0)
+    st = p.state()
+    assert st["schema"] == PROFILE_SCHEMA
+    assert st["samples"] >= 3
+    assert st["planes"]["serve"]["samples"] >= 1   # the spinner, by name
+    assert len(st["stacks"]) + (1 if st["other"] else 0) <= 65
+    assert any(line.startswith("serve;") for line in p.stacks.folded_lines())
+
+
+# ---------------------------------------------------------------------------
+# roofline truth table
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("util,expect", [
+    ({}, "idle"),
+    ({"qps": 0.0, "host_cpu": 0.02, "device_frac": 0.01}, "idle"),
+    ({"qps": 100.0, "device_occ": 0.80}, "device"),
+    ({"qps": 100.0, "device_frac": 0.65}, "device"),
+    # precedence: a saturated device binds regardless of host noise
+    ({"qps": 100.0, "device_occ": 0.80, "host_cpu": 0.95}, "device"),
+    ({"qps": 100.0, "host_cpu": 0.90}, "host"),
+    ({"qps": 100.0, "wire_frac": 0.40, "dispatch_frac": 0.20}, "wire"),
+    # wire loses its rule when dispatch exceeds it, dispatch rule fires
+    ({"qps": 100.0, "wire_frac": 0.40, "dispatch_frac": 0.45}, "dispatch"),
+    ({"qps": 100.0, "dispatch_frac": 0.32}, "dispatch"),
+    # argmax fallback: traffic present, nothing over a rule threshold
+    ({"qps": 10.0, "wire_frac": 0.10, "host_cpu": 0.06}, "wire"),
+    # traffic but every resource under 5%: nothing binds
+    ({"qps": 10.0, "wire_frac": 0.04, "host_cpu": 0.03}, "idle"),
+])
+def test_roofline_classify_truth_table(util, expect):
+    assert classify(util) == expect
+
+
+def test_roofline_verdict_publishes_and_takes_overrides(mv_env):
+    from multiverso_tpu.telemetry import get_registry
+    reset_roofline()
+    try:
+        v = verdict("client", overrides={"qps": 100.0, "host_cpu": 0.95})
+        assert v["plane"] == "client"
+        assert v["bound"] == "host"
+        assert v["util"]["host_cpu"] == pytest.approx(0.95)
+        g = get_registry().gauge("roofline.client.bound")
+        assert g.last == BOUND_CODES["host"]
+        # second call differentiates against the first's baseline
+        v2 = verdict("client")
+        assert v2["bound"] in BOUNDS
+        assert v2["util"]["window_s"] < 10.0
+    finally:
+        reset_roofline()

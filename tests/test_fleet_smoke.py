@@ -43,7 +43,7 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert line["replicas"] == 2
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v10"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v11"
     assert record["replicas"] == 2
 
     # Routed lookups bitwise-equal to the direct table gather.
@@ -214,6 +214,23 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     for r in per.values():
         assert "pipeline_inflight" in r and "cache_hits" in r
 
+    # -- ISSUE-18 attribution layer across the fleet ----------------------
+    # Every replica self-classifies its serve plane via the heartbeat
+    # (roofline verdict rides metrics_payload), the bench client
+    # classifies its own plane locally, and the fleet rollup carries the
+    # merged tail exemplars with phase ledgers.
+    rl = record["roofline"]
+    assert rl["client"]["bound"] in (
+        "dispatch", "host", "wire", "device", "idle"), rl
+    assert len(rl["replicas"]) >= 1, rl
+    for rid, v in rl["replicas"].items():
+        assert v.get("bound") in (
+            "dispatch", "host", "wire", "device", "idle"), (rid, v)
+    for r in per.values():
+        assert "roofline" in r and "exemplars" in r
+    assert "exemplars" in record
+    assert "critical_path" in tracing
+
 
 def test_serve_bench_chaos_drill_dry_run(tmp_path):
     """ISSUE-16 chaos drill smoke: one seeded round over a 2-shard
@@ -234,7 +251,7 @@ def test_serve_bench_chaos_drill_dry_run(tmp_path):
     assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v10"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v11"
     chaos = record["chaos"]
     assert chaos["seed"] == 16
     assert chaos["shards"] == 2

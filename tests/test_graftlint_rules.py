@@ -30,7 +30,7 @@ RULES = ("implicit-host-sync", "block-until-ready-in-loop",
          "bare-thread-no-join", "bare-print", "unbounded-queue-append",
          "span-in-traced-fn", "daemon-loop-no-watchdog",
          "unbounded-metric-name", "blocking-call-no-timeout",
-         "poll-loop-no-backoff")
+         "poll-loop-no-backoff", "unattributed-wait")
 
 
 def _expected_lines(path, rule):

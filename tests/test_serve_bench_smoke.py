@@ -30,7 +30,7 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     record = json.loads(out.read_text())
     # v9: + chaos block (--chaos-drill seeded kill-any-subset rounds);
     # config grows chaos_seed/chaos_rounds/rpc_timeout_ms
-    assert record["schema"] == "multiverso_tpu.bench_serve/v10"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v11"
     assert record["box"]["cores"] >= 1
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
@@ -116,6 +116,36 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     assert f32["users_per_chip_paged"] > f32["users_per_chip_prealloc"]
     assert pref["users_per_chip_prefix_shared"] \
         >= f32["users_per_chip_paged"]
+    # ISSUE-18 acceptance witnesses: the attribution layer's phase
+    # ledgers conserve on the paced probe (phases sum within 10% of
+    # measured e2e, residual published into latency.unattributed), the
+    # slowest-request exemplars carry trace ids resolvable against the
+    # stitched trace file, every serving plane got a roofline verdict,
+    # and the ledger+profiler A/B recorded its own overhead (box-noisy
+    # on 1 core, so the smoke bounds it loosely; full runs gate at 1%).
+    cp = record["tracing"]["critical_path"]
+    probe = cp["probe"]
+    assert probe["n_decomposed"] >= 10, probe
+    assert probe["unattributed"]["mean_frac"] <= 0.10, probe
+    assert probe["conserved_frac"] >= 0.5, probe
+    assert cp["published_residual"]["count"] > 0, cp["published_residual"]
+    assert cp["phases"].get("device", {}).get("total_ms", 0) > 0, cp
+    ex = record["exemplars"]
+    assert len(ex) > 0
+    stitched = json.load(open(record["tracing"]["stitched_path"]))
+    ids = {e.get("args", {}).get("trace")
+           for e in stitched["traceEvents"] if e.get("ph") == "X"}
+    assert any(e.get("trace") in ids for e in ex), ex
+    assert all("phases" in e and e["total_ms"] > 0 for e in ex), ex
+    rl = record["roofline"]
+    for plane in ("serve", "client"):
+        assert rl[plane]["bound"] in (
+            "dispatch", "host", "wire", "device", "idle"), rl
+    ab = obs["attribution_ab"]
+    assert ab["qps_plain"] > 0 and ab["qps_attributed"] > 0
+    assert ab["overhead_pct"] < 15.0, ab
+    prof = record["profile"]
+    assert prof["samples"] > 0 and prof["n_stacks"] > 0, prof
 
 
 def test_serve_main_cli_end_to_end(tmp_path):
