@@ -34,6 +34,13 @@ _LOCK_FACTORIES = {
     "threading.Lock": "lock",
     "threading.RLock": "rlock",
     "threading.Condition": "condition",
+    # The graftsan witness seam (utils/locks.py): same kinds as the bare
+    # primitives they return, plus a literal witness name the
+    # static/runtime cross-check joins on (analysis/interproc.py reads
+    # the first argument).
+    "multiverso_tpu.utils.locks.make_lock": "lock",
+    "multiverso_tpu.utils.locks.make_rlock": "rlock",
+    "multiverso_tpu.utils.locks.make_condition": "condition",
 }
 _MUTATORS = {"append", "add", "update", "setdefault", "pop", "clear",
              "extend", "remove", "insert", "discard", "popitem"}

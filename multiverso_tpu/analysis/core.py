@@ -183,7 +183,7 @@ def register(cls: type) -> type:
 def all_rules() -> List[Rule]:
     # rule modules self-register on import
     from multiverso_tpu.analysis import (concurrency, hotpath,  # noqa: F401
-                                         style)
+                                         interproc, style)
     return [cls() for _, cls in sorted(_REGISTRY.items())]
 
 

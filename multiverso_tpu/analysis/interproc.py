@@ -1,0 +1,652 @@
+"""graftsan static side: whole-program call graph + interprocedural rules.
+
+Every existing concurrency rule (including ``lock-order-cycle``) reasons
+over one resolution hop; the bugs that actually shipped — the
+fsync-held-across-``_io_lock`` throughput hit (PR 15), the ``json.dump``
+encoder convoy (PR 16), the compute-then-publish ``_slots_lock`` race
+(PR 14) — all lived in call chains *between* files. This module builds
+one call graph over the whole scanned tree (module-qualified defs,
+resolved self-method and cross-module calls, one level of indirection
+through assigned callables and constructor-typed attributes) and runs
+three rules over it:
+
+* ``cross-module-lock-order`` — a lock-order inversion whose two locks
+  are *defined in different modules*: the exact gap a per-file reviewer
+  (and the one-hop resolver) cannot see, because each file's order looks
+  locally consistent;
+* ``lock-held-across-blocking`` — a call chain from inside a
+  ``with lock:`` body that reaches a blocking sink (fsync/fdatasync,
+  socket send/recv/accept/connect, zero-arg ``queue.get()``,
+  subprocess, ``json.dump``, device sync) through any number of hops —
+  the generalized PR-15 finding;
+* ``condition-wait-no-predicate-loop`` — a ``cv.wait()`` not enclosed
+  in a while-predicate loop: one spurious or stolen wakeup and the
+  caller proceeds on a false predicate.
+
+The graph also exports :func:`cross_module_witness_claims`: the
+statically-claimed cross-module edges between *witness-named* locks
+(built through ``utils.locks.make_lock``), which the tier-1 witness test
+cross-checks against the runtime ledger — a static claim reality never
+exercises is a finding too.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from multiverso_tpu.analysis import astutil
+from multiverso_tpu.analysis.concurrency import (_held_lock, _lock_defs,
+                                                 _lock_ref)
+from multiverso_tpu.analysis.core import (FileContext, Finding, Project,
+                                          Rule, register)
+
+_WITNESS_FACTORIES = ("multiverso_tpu.utils.locks.make_lock",
+                      "multiverso_tpu.utils.locks.make_rlock",
+                      "multiverso_tpu.utils.locks.make_condition")
+
+#: Blocking sinks by resolved dotted name. Values are the label shown in
+#: the finding's call chain.
+_SINK_NAMES = {
+    "os.fsync": "os.fsync",
+    "os.fdatasync": "os.fdatasync",
+    "subprocess.run": "subprocess.run",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+    "subprocess.Popen": "subprocess.Popen",
+    "socket.create_connection": "socket.create_connection",
+    "json.dump": "json.dump (serialize+write)",
+    "jax.block_until_ready": "jax.block_until_ready (device sync)",
+}
+#: Blocking sinks by method name (receiver type unknowable statically;
+#: these names are socket/array-specific enough to carry the verdict).
+_SINK_ATTRS = {
+    "sendall": "socket sendall",
+    "recv": "socket recv",
+    "recv_into": "socket recv_into",
+    "recvfrom": "socket recvfrom",
+    "accept": "socket accept",
+    "block_until_ready": "device sync",
+}
+
+
+def _blocking_sink(call: ast.Call, ctx: FileContext) -> Optional[str]:
+    """Label when ``call`` is itself a blocking sink, else None."""
+    resolved = astutil.resolve_name(call.func, ctx.aliases)
+    if resolved in _SINK_NAMES:
+        return _SINK_NAMES[resolved]
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        label = _SINK_ATTRS.get(attr)
+        if label is not None:
+            return label
+        # Zero-arg .get(): a dict .get() needs an argument, so this is
+        # the queue.Queue().get() block-forever form.
+        if attr == "get" and not call.args and not call.keywords:
+            base = call.func.value
+            if not (isinstance(base, ast.Name) and
+                    base.id.lstrip("_")[:1].isupper()):
+                return "queue get (no timeout)"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Whole-program call graph
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class _CallSite:
+    node: ast.Call
+    held: Optional[str]           # innermost lock id held at the call
+    cands: Tuple[str, ...]        # resolved callee quals
+    sink: Optional[str]           # label when the call IS a sink
+
+
+@dataclasses.dataclass
+class _Def:
+    qual: str                     # module.fn / module.Class.meth
+    rel: str
+    node: ast.AST
+    ctx: FileContext
+    sites: List[_CallSite] = dataclasses.field(default_factory=list)
+    acquires: List[Tuple[str, ast.With]] = \
+        dataclasses.field(default_factory=list)
+
+
+class CallGraph:
+    """Module-qualified defs + resolved call edges over a whole Project.
+
+    Resolution covers: bare/imported function calls, ``self.m()`` /
+    ``cls.m()`` / ``ClassName.m()`` methods, imported ``mod.fn()``, and
+    one level of indirection — ``self._cb()`` through a callable
+    assigned to the attribute, ``self.obj.m()`` / local ``obj.m()``
+    through a constructor-typed attribute or local. Unresolvable calls
+    simply contribute no edges (the rules stay sound-by-silence, never
+    guessy)."""
+
+    def __init__(self, project: Project) -> None:
+        self.defs: Dict[str, _Def] = {}
+        self.classes: Set[str] = set()
+        self.locks: Dict[str, str] = {}             # id -> kind
+        self.witness: Dict[str, str] = {}           # id -> literal name
+        #: (module.Class, attr) -> candidate quals (class or function)
+        self._attr_types: Dict[Tuple[str, str], Set[str]] = {}
+        #: module.NAME -> quals (module-level callable rebinding)
+        self._name_binds: Dict[str, Set[str]] = {}
+        self.edges: Dict[str, Set[str]] = {}
+        self._collect(project)
+        self._resolve(project)
+        self.reach = self._sink_reachability()
+
+    # -- pass 1: defs, classes, locks, indirection tables -------------------
+    def _collect(self, project: Project) -> None:
+        for ctx in project.files:
+            self.locks.update(_lock_defs(ctx))
+            self.witness.update(_witness_defs(ctx))
+            for node in ctx.walk():
+                if isinstance(node, ast.ClassDef):
+                    self.classes.add(f"{ctx.module}.{node.name}")
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    cls = astutil.enclosing_class(node)
+                    qual = (f"{ctx.module}.{cls.name}.{node.name}"
+                            if cls is not None
+                            else f"{ctx.module}.{node.name}")
+                    self.defs.setdefault(
+                        qual, _Def(qual=qual, rel=ctx.rel,
+                                   node=node, ctx=ctx))
+        for ctx in project.files:
+            for node in ctx.walk():
+                if not isinstance(node, ast.Assign):
+                    continue
+                quals = self._value_refs(node.value, ctx)
+                if not quals:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        cls = astutil.enclosing_class(node)
+                        if cls is not None:
+                            key = (f"{ctx.module}.{cls.name}", tgt.attr)
+                            self._attr_types.setdefault(
+                                key, set()).update(quals)
+                    elif isinstance(tgt, ast.Name) and \
+                            astutil.enclosing_function(node) is None and \
+                            astutil.enclosing_class(node) is None:
+                        self._name_binds.setdefault(
+                            f"{ctx.module}.{tgt.id}", set()).update(quals)
+
+    def _value_refs(self, value: ast.expr,
+                    ctx: FileContext) -> Set[str]:
+        """Quals an assigned value may denote: ``Ctor(...)`` types the
+        target with the class; a bare callable reference binds it to
+        that function/class (the one level of indirection)."""
+        if isinstance(value, ast.Call):
+            name = self._qualify(value.func, ctx)
+            if name in self.classes:
+                return {name}
+            return set()
+        if isinstance(value, (ast.Name, ast.Attribute)):
+            name = self._qualify(value, ctx)
+            if name in self.defs or name in self.classes:
+                return {name}
+            # self._cb = self._flush: method handle on this class
+            if isinstance(value, ast.Attribute) and \
+                    isinstance(value.value, ast.Name) and \
+                    value.value.id == "self":
+                cls = astutil.enclosing_class(value)
+                if cls is not None:
+                    q = f"{ctx.module}.{cls.name}.{value.attr}"
+                    if q in self.defs:
+                        return {q}
+        return set()
+
+    def _qualify(self, expr: ast.expr, ctx: FileContext) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            resolved = ctx.aliases.get(expr.id)
+            if resolved and "." in resolved:
+                return resolved
+            return f"{ctx.module}.{expr.id}"
+        resolved = astutil.resolve_name(expr, ctx.aliases)
+        if resolved:
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id not in ctx.aliases:
+                return f"{ctx.module}.{resolved}"
+            return resolved
+        return None
+
+    # -- pass 2: call sites + edges ------------------------------------------
+    def _resolve(self, project: Project) -> None:
+        for d in self.defs.values():
+            for sub in ast.walk(d.node):
+                if astutil.enclosing_function(sub) is not d.node:
+                    continue
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        ref = _lock_ref(item.context_expr, d.ctx)
+                        if ref is not None and ref in self.locks:
+                            d.acquires.append((ref, sub))
+                elif isinstance(sub, ast.Call):
+                    cands = tuple(sorted(self.resolve_call(sub, d.ctx)))
+                    sink = _blocking_sink(sub, d.ctx)
+                    if cands or sink:
+                        d.sites.append(_CallSite(
+                            node=sub,
+                            held=_held_lock(sub, d.ctx, d.node),
+                            cands=cands, sink=sink))
+            self.edges[d.qual] = {c for s in d.sites for c in s.cands}
+
+    def resolve_call(self, call: ast.Call,
+                     ctx: FileContext) -> List[str]:
+        fn = call.func
+        out: List[str] = []
+        if isinstance(fn, ast.Name):
+            q = self._qualify(fn, ctx)
+            if q:
+                self._emit_callable(q, out)
+        elif isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls"):
+                    cls = astutil.enclosing_class(call)
+                    if cls is not None:
+                        clsq = f"{ctx.module}.{cls.name}"
+                        q = f"{clsq}.{fn.attr}"
+                        if q in self.defs:
+                            out.append(q)
+                        else:       # self._cb() through an assigned callable
+                            for t in self._attr_types.get(
+                                    (clsq, fn.attr), ()):
+                                self._emit_callable(t, out)
+                else:
+                    q = self._qualify(fn, ctx)
+                    if q and q in self.defs:
+                        out.append(q)
+                    else:
+                        # local var typed by a constructor in this fn
+                        owner = astutil.enclosing_function(call)
+                        if owner is not None:
+                            for t in self._local_types(owner, base.id,
+                                                       ctx):
+                                m = f"{t}.{fn.attr}"
+                                if m in self.defs:
+                                    out.append(m)
+            elif isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self":
+                # self.obj.m() through a constructor-typed attribute
+                cls = astutil.enclosing_class(call)
+                if cls is not None:
+                    clsq = f"{ctx.module}.{cls.name}"
+                    for t in self._attr_types.get((clsq, base.attr), ()):
+                        m = f"{t}.{fn.attr}"
+                        if m in self.defs:
+                            out.append(m)
+        return out
+
+    def _emit_callable(self, qual: str, out: List[str]) -> None:
+        if qual in self.defs:
+            out.append(qual)
+        elif qual in self.classes:
+            init = f"{qual}.__init__"
+            if init in self.defs:
+                out.append(init)
+        for t in self._name_binds.get(qual, ()):
+            if t in self.defs:
+                out.append(t)
+            elif t in self.classes and f"{t}.__init__" in self.defs:
+                out.append(f"{t}.__init__")
+
+    def _local_types(self, owner: ast.AST, name: str,
+                     ctx: FileContext) -> Set[str]:
+        out: Set[str] = set()
+        for sub in ast.walk(owner):
+            if isinstance(sub, ast.Assign) and \
+                    any(isinstance(t, ast.Name) and t.id == name
+                        for t in sub.targets):
+                out |= {q for q in self._value_refs(sub.value, ctx)
+                        if q in self.classes}
+        return out
+
+    # -- pass 3: which functions reach a blocking sink ----------------------
+    def _sink_reachability(self) -> Dict[str, Tuple[str, ...]]:
+        """qual -> shortest known chain ``(callee, ..., sink label)``
+        proving the function may block. Fixpoint over the call graph."""
+        reach: Dict[str, Tuple[str, ...]] = {}
+        for q, d in sorted(self.defs.items()):
+            site = next((s for s in sorted(
+                d.sites, key=lambda s: s.node.lineno) if s.sink), None)
+            if site is not None:
+                reach[q] = (site.sink,)
+        changed, iters = True, 0
+        while changed and iters < 50:
+            changed, iters = False, iters + 1
+            for q in sorted(self.defs):
+                for c in sorted(self.edges.get(q, ())):
+                    if c in reach and c != q:
+                        chain = (c,) + reach[c]
+                        if q not in reach or len(chain) < len(reach[q]):
+                            reach[q] = chain
+                            changed = True
+        return reach
+
+    # -- lock-order edges over the graph -------------------------------------
+    def lock_order_edges(self) -> Dict[Tuple[str, str],
+                                       Tuple[str, ast.AST, str]]:
+        """``held -> acquired`` edges with provenance ``(rel, node,
+        via)``, through lexical nesting and resolved call chains."""
+        may_acquire: Dict[str, Set[str]] = {
+            q: {ref for ref, _ in d.acquires}
+            for q, d in self.defs.items()}
+        changed, iters = True, 0
+        while changed and iters < 50:
+            changed, iters = False, iters + 1
+            for q in self.defs:
+                cur = may_acquire[q]
+                for c in self.edges.get(q, ()):
+                    extra = may_acquire.get(c)
+                    if extra and not extra <= cur:
+                        cur |= extra
+                        changed = True
+
+        edges: Dict[Tuple[str, str], Tuple[str, ast.AST, str]] = {}
+
+        def add(src: str, dst: str, rel: str, node: ast.AST,
+                via: str) -> None:
+            edges.setdefault((src, dst), (rel, node, via))
+
+        for d in self.defs.values():
+            by_with: Dict[int, Tuple[ast.With, List[str]]] = {}
+            for ref, with_node in d.acquires:
+                by_with.setdefault(
+                    id(with_node), (with_node, []))[1].append(ref)
+            for with_node, refs in by_with.values():
+                held = _held_lock(with_node, d.ctx, d.node)
+                if held is not None and held in self.locks:
+                    add(held, refs[0], d.rel, with_node, "nested with")
+                for a, b in zip(refs, refs[1:]):
+                    add(a, b, d.rel, with_node, "multi-item with")
+            for site in d.sites:
+                if site.held is None or site.held not in self.locks:
+                    continue
+                for c in site.cands:
+                    for dst in sorted(may_acquire.get(c, ())):
+                        add(site.held, dst, d.rel, site.node,
+                            f"call to {c}")
+        return edges
+
+
+def _witness_defs(ctx: FileContext) -> Dict[str, str]:
+    """lock id -> witness-name literal, for locks built through the
+    ``utils.locks.make_*`` seam with a string-literal first argument."""
+    out: Dict[str, str] = {}
+    for node in ctx.walk():
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        resolved = astutil.resolve_name(node.value.func, ctx.aliases)
+        if resolved not in _WITNESS_FACTORIES:
+            continue
+        args = node.value.args
+        if not args or not isinstance(args[0], ast.Constant) or \
+                not isinstance(args[0].value, str):
+            continue
+        name = args[0].value
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                cls = astutil.enclosing_class(node)
+                fn = astutil.enclosing_function(node)
+                if fn is None and cls is None:
+                    out[f"{ctx.module}.{tgt.id}"] = name
+                elif fn is None and cls is not None:
+                    out[f"{ctx.module}.{cls.name}.{tgt.id}"] = name
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                cls = astutil.enclosing_class(node)
+                if cls is not None:
+                    out[f"{ctx.module}.{cls.name}.{tgt.attr}"] = name
+    return out
+
+
+def _graph(project: Project) -> CallGraph:
+    """One CallGraph per engine run: the three rules (and the witness
+    claim API) share it instead of re-walking every file each."""
+    g = getattr(project, "_graftsan_graph", None)
+    if g is None:
+        g = CallGraph(project)
+        project._graftsan_graph = g
+    return g
+
+
+def _lock_module(lock_id: str, locks_kind: Dict[str, str]) -> str:
+    """The defining module of a qualified lock id (strip the trailing
+    attr, and the class segment when present)."""
+    parts = lock_id.split(".")
+    # module.Class._attr when the 2nd-to-last segment is CamelCase
+    if len(parts) >= 3 and parts[-2][:1].isupper():
+        return ".".join(parts[:-2])
+    return ".".join(parts[:-1])
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+@register
+class CrossModuleLockOrder(Rule):
+    id = "cross-module-lock-order"
+    severity = "error"
+    rationale = (
+        "If module A nests its lock inside module B's while module B "
+        "(through any call chain, including one hop of indirection "
+        "through an assigned callable) nests B's inside A's, each file "
+        "looks locally consistent and only the whole-program "
+        "acquisition graph shows the inversion — the PR-14 "
+        "_slots_lock-vs-fleet-view shape. Same-module cycles are "
+        "lock-order-cycle's job; this rule owns the edges that cross a "
+        "file boundary, where no single reviewer sees both sides.")
+
+    def finalize(self, project: Project) -> Iterator[Finding]:
+        g = _graph(project)
+        edges = g.lock_order_edges()
+        graph: Dict[str, Set[str]] = {}
+        for (src, dst) in edges:
+            graph.setdefault(src, set()).add(dst)
+            graph.setdefault(dst, set())
+        from multiverso_tpu.analysis.concurrency import LockOrderCycle
+        seen: Set[Tuple[str, ...]] = set()
+        for cycle in LockOrderCycle._cycles(graph):
+            if len(cycle) < 2:
+                continue        # self-deadlock is same-module by definition
+            canon = tuple(sorted(cycle))
+            if canon in seen:
+                continue
+            seen.add(canon)
+            mods = {_lock_module(lock, g.locks) for lock in cycle}
+            if len(mods) < 2:
+                continue        # same-module cycle: lock-order-cycle's turf
+            first = (cycle[0], cycle[1 % len(cycle)])
+            rel, node, via = edges.get(first) or next(
+                v for k, v in edges.items()
+                if k[0] in cycle and k[1] in cycle)
+            yield Finding(
+                rule=self.id, path=rel,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0),
+                message=("cross-module lock-order inversion: "
+                         + " -> ".join(cycle + (cycle[0],))
+                         + f" spans modules {sorted(mods)} "
+                         f"(edge here via {via}) — pick one order and "
+                         "rank it in docs/CONCURRENCY.md"),
+                symbol=astutil.qualname(node), severity=self.severity)
+
+
+@register
+class LockHeldAcrossBlocking(Rule):
+    id = "lock-held-across-blocking"
+    severity = "error"
+    rationale = (
+        "A lock held across fsync/socket IO/subprocess/device-sync "
+        "convoys every other acquirer behind a syscall that can take "
+        "milliseconds to forever — the PR-15 fsync-under-staging-lock "
+        "bug cost 26% add throughput, and the PR-16 json.dump convoy "
+        "260s of tier-1 wall time. The blocking call is usually hidden "
+        "two calls deep in another file; the call graph walks there. "
+        "Move the slow call outside the critical section (snapshot-"
+        "then-publish), or suppress with a reason when the lock exists "
+        "precisely to serialize that IO (a WAL's dedicated io-lock).")
+
+    def finalize(self, project: Project) -> Iterator[Finding]:
+        g = _graph(project)
+        for q in sorted(g.defs):
+            d = g.defs[q]
+            reported: Set[int] = set()
+            for site in d.sites:
+                if site.held is None or site.held not in g.locks:
+                    continue
+                if id(site.node) in reported:
+                    continue
+                if site.sink is not None:
+                    reported.add(id(site.node))
+                    yield self._finding(d, site, (site.sink,))
+                    continue
+                for c in site.cands:
+                    chain = g.reach.get(c)
+                    if chain is not None:
+                        reported.add(id(site.node))
+                        yield self._finding(d, site, (c,) + chain)
+                        break
+
+    def _finding(self, d: _Def, site: _CallSite,
+                 chain: Tuple[str, ...]) -> Finding:
+        shown = " -> ".join(chain)
+        return Finding(
+            rule=self.id, path=d.rel,
+            line=site.node.lineno, col=site.node.col_offset,
+            message=(f"lock {site.held} held across blocking call: "
+                     f"{shown} — move the blocking step outside the "
+                     "critical section (snapshot under the lock, "
+                     "publish/IO after release)"),
+            symbol=astutil.qualname(site.node), severity=self.severity)
+
+
+@register
+class ConditionWaitNoPredicateLoop(Rule):
+    id = "condition-wait-no-predicate-loop"
+    severity = "error"
+    rationale = (
+        "Condition.wait() can return spuriously, and a notify can be "
+        "consumed by another waiter before this thread re-acquires the "
+        "lock — so a wait() whose predicate is checked with `if` (or "
+        "not at all) proceeds on a false premise exactly once per "
+        "blue moon, which is the worst reproduction rate there is. "
+        "The only correct shapes are `while not pred: cv.wait(...)` "
+        "and cv.wait_for(pred, ...).")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        conds = {i for i, k in _lock_defs(ctx).items()
+                 if k == "condition"}
+        if not conds:
+            return
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute) or \
+                    node.func.attr != "wait":
+                continue
+            if _lock_ref(node.func.value, ctx) not in conds:
+                continue
+            if self._in_predicate_loop(node):
+                continue
+            yield self.finding(
+                ctx, node,
+                "cv.wait() outside a while-predicate loop: a spurious "
+                "wakeup (or a notify consumed by another waiter) lets "
+                "this thread proceed on a false predicate — use "
+                "`while not <pred>: cv.wait(timeout)` or "
+                "cv.wait_for(<pred>, timeout)")
+
+    @staticmethod
+    def _in_predicate_loop(node: ast.AST) -> bool:
+        for anc in astutil.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+            if isinstance(anc, ast.While):
+                test = anc.test
+                if not (isinstance(test, ast.Constant) and test.value):
+                    return True     # a real predicate governs the loop
+                # `while True:` + a conditional break/return inside the
+                # loop is the predicate-with-escape spelling.
+                return any(
+                    isinstance(sub, ast.If) and any(
+                        isinstance(s, (ast.Break, ast.Return))
+                        for b in (sub.body, sub.orelse) for s in b)
+                    for sub in ast.walk(anc))
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Witness cross-check API (consumed by tests/test_lock_witness.py)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class EdgeClaim:
+    """One statically-claimed acquisition-order edge between two
+    witness-named locks, ready to join against the runtime ledger."""
+    src: str                      # qualified lock id
+    dst: str
+    src_witness: str              # make_lock literal — the join key
+    dst_witness: str
+    src_module: str
+    dst_module: str
+    rel: str                      # file carrying the edge's site
+    line: int
+    via: str
+
+    @property
+    def cross_module(self) -> bool:
+        return self.src_module != self.dst_module
+
+
+def witness_edge_claims(project: Project) -> List[EdgeClaim]:
+    """Every static acquisition-order edge whose BOTH locks carry
+    witness names (i.e. were built through the make_lock seam)."""
+    g = _graph(project)
+    out: List[EdgeClaim] = []
+    for (src, dst), (rel, node, via) in sorted(
+            g.lock_order_edges().items(),
+            key=lambda kv: (kv[0][0], kv[0][1])):
+        sw, dw = g.witness.get(src), g.witness.get(dst)
+        if sw is None or dw is None or src == dst:
+            continue
+        out.append(EdgeClaim(
+            src=src, dst=dst, src_witness=sw, dst_witness=dw,
+            src_module=_lock_module(src, g.locks),
+            dst_module=_lock_module(dst, g.locks),
+            rel=rel, line=getattr(node, "lineno", 1), via=via))
+    return out
+
+
+def cross_module_witness_claims(paths, root) -> List[EdgeClaim]:
+    """One-call API: scan ``paths``, return the cross-module witness
+    edges the runtime must observe (or the test must suppress with a
+    reason). Parse errors surface as a ValueError — a silent partial
+    scan would under-claim."""
+    import os
+
+    from multiverso_tpu.analysis.core import iter_python_files
+    engine_root = os.path.abspath(root)
+    contexts: List[FileContext] = []
+    errors: List[str] = []
+    for path in iter_python_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), engine_root)
+        try:
+            contexts.append(FileContext(path, rel))
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            errors.append(f"{rel}: {exc}")
+    if errors:
+        raise ValueError(f"unparseable files in witness scan: {errors}")
+    project = Project(engine_root, contexts)
+    return [c for c in witness_edge_claims(project) if c.cross_module]

@@ -47,6 +47,7 @@ from typing import List, Tuple
 
 from multiverso_tpu.telemetry import counter, gauge, histogram
 from multiverso_tpu.utils.log import check, log
+from multiverso_tpu.utils.locks import make_condition
 
 
 class VectorClock:
@@ -113,7 +114,7 @@ class SyncCoordinator:
         # same worker must order after them (ref ``num_waited_add_`` in
         # src/server.cpp ProcessGet).
         self._inflight_adds = [0] * num_workers
-        self._cv = threading.Condition()
+        self._cv = make_condition("core.sync.cv")
         # -- elastic membership state --------------------------------------
         self._leave_timeout_s = max(0.0, float(leave_timeout_s))
         self._active = set(range(num_workers))

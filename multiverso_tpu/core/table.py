@@ -40,6 +40,7 @@ from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.telemetry import gauge
 from multiverso_tpu.utils.configure import get_flag
 from multiverso_tpu.utils.log import check
+from multiverso_tpu.utils.locks import make_lock
 
 # XLA's CPU collectives deadlock under concurrent dispatch: a sharded
 # store kernel expands to one participant per virtual device, all of which
@@ -57,7 +58,7 @@ from multiverso_tpu.utils.log import check
 # CPU collective dispatch is deferred until such an interleaving is
 # actually observed (worker collectives in tests run on the main thread
 # between store ops, and CPU meshes exist only in tests).
-_CPU_COLLECTIVE_LOCK = threading.Lock()
+_CPU_COLLECTIVE_LOCK = make_lock("core.cpu_collective")
 
 
 def _physical_bytes(arr: jax.Array) -> int:
@@ -180,7 +181,7 @@ class ServerStore:
                 self._pallas_cap = cap
         self._pallas_rows = self._pallas_cap is not None
         self._build_kernels()
-        self._lock = threading.Lock()
+        self._lock = make_lock("core.store")
         devices = list(self.sharding.device_set)
         self._serial_exec = (len(devices) > 1
                              and devices[0].platform == "cpu")
@@ -499,7 +500,7 @@ class WorkerTable:
         self._msg_id = 0
         self._pending: "collections.OrderedDict[int, Callable[[], Any]]" = \
             collections.OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = make_lock("core.worker_table")
         from multiverso_tpu.core.zoo import Zoo
         zoo = Zoo.get()
         self.table_id = zoo.register_table(self)

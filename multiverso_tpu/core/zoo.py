@@ -23,6 +23,7 @@ import jax
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.utils import configure
 from multiverso_tpu.utils.log import log, check
+from multiverso_tpu.utils.locks import make_lock
 
 
 class Role:
@@ -64,7 +65,7 @@ class Node:
 
 class Zoo:
     _instance: Optional["Zoo"] = None
-    _lock = threading.Lock()
+    _lock = make_lock("core.zoo")
 
     def __init__(self) -> None:
         self.started = False

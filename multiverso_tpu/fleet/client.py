@@ -29,6 +29,7 @@ that were in flight on it at kill time.
 
 from __future__ import annotations
 
+import socket
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -49,6 +50,7 @@ from multiverso_tpu.telemetry import counter, emit_span, histogram
 from multiverso_tpu.telemetry import context as trace_context
 from multiverso_tpu.telemetry.sketch import record_keys
 from multiverso_tpu.telemetry.context import TraceContext
+from multiverso_tpu.utils.locks import make_lock
 from multiverso_tpu.utils.log import check, log
 
 _SUSPECT_TTL_S = 1.0    # local quarantine until the router confirms death
@@ -140,47 +142,95 @@ class _RouterFeed:
         self.addr = (str(addr[0]), int(addr[1]))
         self._sock = None
         self._msg_id = 0
-        self._lock = threading.Lock()
+        # Two locks, deliberately: _io_lock serializes the whole
+        # dial+request+reply exchange (one fetch at a time on the one
+        # persistent socket), while _state_lock guards only the small
+        # shared state (_sock publication, the reconnect flag, the
+        # closed bit). Control ops — consume_reconnected(), close() —
+        # take _state_lock alone, so they never wait out a 4-attempt
+        # backoff dial or a parked recv the way they did when one lock
+        # covered both. Order: _io_lock -> _state_lock, never reversed.
+        self._io_lock = make_lock("fleet.feed.io")
+        self._state_lock = make_lock("fleet.feed.state")
         self._reconnected = False
+        self._closed = False
 
     def consume_reconnected(self) -> bool:
         """True once after each re-dial: a restarted router's version
         counter restarts too, so the consumer must accept the next table
         even if its version regressed."""
-        with self._lock:
+        with self._state_lock:
             fresh, self._reconnected = self._reconnected, False
             return fresh
 
     def fetch(self) -> Dict:
-        with self._lock:
-            if self._sock is None:
-                self._sock = connect_with_backoff(*self.addr, attempts=4)
-                self._reconnected = True
+        with self._io_lock:
+            with self._state_lock:
+                if self._closed:
+                    raise OSError("routing feed is closed")
+                sock = self._sock
+            if sock is None:
+                # _io_lock (not _state_lock) held across the dial ON
+                # PURPOSE: it serializes exactly this exchange, and a
+                # concurrent close() must stay free to interrupt it.
+                # graftlint: disable=lock-held-across-blocking
+                sock = connect_with_backoff(*self.addr, attempts=4)
+                with self._state_lock:
+                    if self._closed:        # close() raced the dial
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        raise OSError("routing feed is closed")
+                    self._sock = sock
+                    self._reconnected = True
             try:
                 self._msg_id += 1
-                send_message(self._sock, Message(
+                # Same contract: _io_lock IS the exchange serializer.
+                # graftlint: disable=lock-held-across-blocking
+                send_message(sock, Message(
                     type=MsgType.Fleet_Route, msg_id=self._msg_id,
                     data=[pack_json_blob({})]))
-                reply = recv_message(self._sock)
+                # graftlint: disable=lock-held-across-blocking
+                reply = recv_message(sock)
             except (IOError, OSError):
-                self._close_locked()
+                self._drop(sock)
                 raise
             if reply is None or not reply.data:
-                self._close_locked()
+                self._drop(sock)
                 raise OSError("fleet router closed the routing feed")
             return unpack_json_blob(reply.data[0])
 
-    def _close_locked(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+    def _drop(self, sock) -> None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+        with self._state_lock:
+            if self._sock is sock:
+                self._sock = None
 
     def close(self) -> None:
-        with self._lock:
-            self._close_locked()
+        """Idempotent, and deliberately NOT serialized behind fetch():
+        closing the socket out from under an in-flight exchange is the
+        wakeup — the blocked recv raises instead of waiting out a dead
+        router."""
+        with self._state_lock:
+            self._closed = True
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                # shutdown() — not just close() — is what actually wakes
+                # a thread parked in recv on this socket; a bare close
+                # only drops the fd refcount and can leave the reader
+                # blocked until the peer speaks.
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class _GroupFeed:
@@ -286,7 +336,7 @@ class FleetClient:
             else float(rpc_timeout_ms) / 1e3
         self._c_deadline = counter("fleet.rpc_deadline_exceeded")
         self._sched = scheduler or default_scheduler()
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.client")
         self._conns: Dict[str, ServingClient] = {}
         self._suspects: Dict[str, float] = {}
         self._table: Optional[RoutingTable] = None

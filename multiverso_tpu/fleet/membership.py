@@ -39,6 +39,7 @@ from multiverso_tpu.parallel.net import (pack_json_blob, recv_message,
                                          send_message, unpack_json_blob)
 from multiverso_tpu.telemetry import counter, gauge, span, watchdog_scope
 from multiverso_tpu.utils.log import check, log
+from multiverso_tpu.utils.locks import make_lock
 
 
 class MemberInfo:
@@ -118,7 +119,7 @@ class ReplicaGroup:
         self.vnodes = int(vnodes)
         self.heartbeat_ms = float(heartbeat_ms)
         self.liveness_misses = max(1, int(liveness_misses))
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.membership")
         self._members: Dict[str, MemberInfo] = {}
         self._version = 0
         self._stats_seq = 0     # bumps per metrics-bearing heartbeat

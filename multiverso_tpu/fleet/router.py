@@ -41,6 +41,7 @@ from multiverso_tpu.parallel.net import (pack_json_blob, pack_serve_payload,
                                          unpack_json_blob, unpack_trace_ctx)
 from multiverso_tpu.telemetry import activate, counter, gauge, span
 from multiverso_tpu.utils.log import check, log
+from multiverso_tpu.utils.locks import make_lock
 
 
 class FleetRouter:
@@ -79,7 +80,7 @@ class FleetRouter:
         self._proxy_client = None
         self._proxy_on = bool(proxy)
         self._drain_driver = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.router")
         self._running = True
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -123,7 +124,7 @@ class FleetRouter:
                 if len(self._conns) >= self.MAX_CONNS:
                     conn.close()
                     continue
-                self._conns[conn] = threading.Lock()
+                self._conns[conn] = make_lock("fleet.router.conn")
                 self._g_conns.set(len(self._conns))
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._conn_loop, args=(conn,),

@@ -43,6 +43,7 @@ from typing import Callable, Dict, List, Optional
 
 from multiverso_tpu.telemetry import counter, gauge, watchdog_scope
 from multiverso_tpu.utils.log import log
+from multiverso_tpu.utils.locks import make_lock
 
 #: Alert names whose firing drives scale-UP (replica-reported, shipped
 #: on heartbeats into the rollup rows).
@@ -163,7 +164,7 @@ class ReplicaSupervisor:
         #: still-draining scale-down of the same index would put two
         #: live processes behind one member id.
         self._next_index = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.supervisor")
         self._burn_streak = 0
         self._quiet_since: Optional[float] = None
         self._last_action = 0.0       # global scaling cooldown stamp

@@ -30,6 +30,7 @@ from multiverso_tpu.runtime.ffi import DeltaBuffer
 from multiverso_tpu.telemetry import gauge
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check
+from multiverso_tpu.utils.locks import make_lock
 
 
 def _stageable(updater: Updater) -> bool:
@@ -62,7 +63,7 @@ class AsyncTableEngine:
         # negate the merged sum (both are linear).
         self.flush_pending = flush_pending
         self.sparse_drain_max = sparse_drain_max
-        self._flush_lock = threading.Lock()
+        self._flush_lock = make_lock("ps.async.flush")
         # Telemetry: staged-delta depth, sampled at every stage/drain
         # (ASYNC_FLUSH latency rides the monitor below). Qualified by the
         # wrapped table's name so two engines don't share one stream —

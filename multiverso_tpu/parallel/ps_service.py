@@ -51,6 +51,7 @@ from multiverso_tpu.utils.configure import get_flag
 from multiverso_tpu.utils.dashboard import monitor
 from multiverso_tpu.utils.log import check, log
 from multiverso_tpu.utils.quantization import OneBitsFilter, SparseFilter
+from multiverso_tpu.utils.locks import make_lock, make_rlock
 
 
 class _TableSyncGate:
@@ -300,7 +301,7 @@ class PSService:
         self._sparse: Dict[int, _SparseShardState] = {}
         self._directory: Dict[int, Tuple[str, int]] = {}
         self.rank: Optional[int] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("ps.service")
         self._register_timeout = register_timeout
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -1447,9 +1448,9 @@ class PeerClient:
         # persistent connection that legitimately sits idle.
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._send_lock = threading.Lock()
+        self._send_lock = make_lock("ps.peer.send")
         self._waiters: Dict[int, Tuple[threading.Event, List]] = {}
-        self._waiters_lock = threading.Lock()
+        self._waiters_lock = make_lock("ps.peer.waiters")
         self._dead = False
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
@@ -1464,6 +1465,9 @@ class PeerClient:
         with self._waiters_lock:
             self._waiters[msg.msg_id] = (event, slot)
         with self._send_lock:
+            # _send_lock exists to serialize frame writes on the one
+            # shared peer socket — the wire wait IS the serialized step.
+            # graftlint: disable=lock-held-across-blocking
             send_message(self._sock, msg)
         return event, slot
 
@@ -1593,7 +1597,7 @@ class DistributedTableBase:
     # server's exactly-once reply cache — a collision there would silently
     # swallow the new incarnation's Adds.
     _msg_counter = int.from_bytes(os.urandom(6), "little")
-    _counter_lock = threading.Lock()
+    _counter_lock = make_lock("ps.client.msgid")
 
     MAX_PENDING = 256        # tracked-but-unwaited op ids (oldest evicted)
     MAX_INFLIGHT_ADDS = 32   # unwaited fire-and-forget add batches
@@ -1638,7 +1642,7 @@ class DistributedTableBase:
         # OVERWRITTEN by the restore, silently losing an acked write.
         if announce:
             service.enable_directory(rank, peers)
-        self._op_lock = threading.RLock()
+        self._op_lock = make_rlock("ps.client.op")
         self._pending: "collections.OrderedDict[int, _PendingOp]" = \
             collections.OrderedDict()
         self._inflight_adds: "collections.deque[_PendingOp]" = \
@@ -2378,7 +2382,7 @@ class KVServerStore:
         self.name = name
         self.dtype = np.dtype(dtype)
         self._map: Dict[int, float] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("ps.sparse.shard")
 
     def apply_rows(self, keys: np.ndarray, values: np.ndarray,
                    opt: Optional[AddOption] = None) -> None:

@@ -49,6 +49,10 @@ def load() -> ctypes.CDLL:
                  os.path.getmtime(_SO) < max(os.path.getmtime(s)
                                              for s in _SRCS))
         if stale:
+            # _lib_lock held across the compile ON PURPOSE: exactly one
+            # builder per process; latecomers must wait for the finished
+            # .so, not race a second g++ at the same output path.
+            # graftlint: disable=lock-held-across-blocking
             _build()
         lib = ctypes.CDLL(_SO)
         _declare(lib)

@@ -49,6 +49,7 @@ from multiverso_tpu.telemetry import (child_of, counter, current_context,
                                       watchdog_scope)
 from multiverso_tpu.telemetry.context import TraceContext
 from multiverso_tpu.utils.log import check, log
+from multiverso_tpu.utils.locks import make_condition, make_lock
 
 
 class ShedError(RuntimeError):
@@ -96,7 +97,7 @@ class BucketLadder:
 # the unlabeled process-wide gauges are SUMS across live batchers — the
 # coherent aggregate the SaturationRule reads.
 # ---------------------------------------------------------------------------
-_slots_lock = threading.Lock()
+_slots_lock = make_lock("serve.slots")
 _slots: dict = {}
 _totals = {"depth": 0, "bound": 0}   # running sums over live batchers
 
@@ -196,7 +197,7 @@ class DynamicBatcher:
         self.max_batch = max(1, int(max_batch))
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self.max_queue = max(1, int(max_queue))
-        self._cv = threading.Condition()
+        self._cv = make_condition("serve.batcher.cv")
         self._queue: "collections.deque[ServeRequest]" = collections.deque()
         self._running = True
         self._busy = False      # a batch is mid-dispatch (quiesce barrier)

@@ -40,6 +40,7 @@ import numpy as np
 
 from multiverso_tpu.telemetry import counter, gauge, histogram
 from multiverso_tpu.telemetry.sketch import get_sketch_hub, record_keys
+from multiverso_tpu.utils.locks import make_lock
 
 
 class StampedRows(np.ndarray):
@@ -74,7 +75,7 @@ class HotRowCache:
         #: overhead), learned from the first insert — what converts the
         #: autosizer's -serve_cache_mem_budget into a row bound.
         self.row_nbytes = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.cache")
         self._rows: "collections.OrderedDict[int, Tuple[float, np.ndarray]]" \
             = collections.OrderedDict()
         self._c_hit = counter("serve.cache.hit")

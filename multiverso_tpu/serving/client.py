@@ -35,6 +35,7 @@ from multiverso_tpu.telemetry import context as trace_context
 from multiverso_tpu.telemetry import emit_span
 from multiverso_tpu.telemetry.context import TraceContext
 from multiverso_tpu.utils.log import check, log
+from multiverso_tpu.utils.locks import make_lock
 
 
 class ReplicaUnavailableError(OSError):
@@ -117,7 +118,7 @@ class ServeResult:
         self.event = threading.Event()
         self.slot: List[object] = []
         self._callbacks: List[Callable[["ServeResult"], None]] = []
-        self._cb_lock = threading.Lock()
+        self._cb_lock = make_lock("serve.result.cb")
         #: Wire id of the request this result waits on — what
         #: :meth:`ServingClient.cancel` takes to cancel a hedged loser.
         self.msg_id = -1
@@ -189,16 +190,16 @@ class ServingClient:
     # Random 48-bit start: a restarted client can't collide with its
     # previous incarnation's in-flight ids on a long-lived server conn.
     _msg_counter = int.from_bytes(os.urandom(6), "little")
-    _counter_lock = threading.Lock()
+    _counter_lock = make_lock("serve.client.msgid")
 
     def __init__(self, host: str, port: int, connect_attempts: int = 4):
         self._sock = connect_with_backoff(host, port,
                                           attempts=connect_attempts)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._send_lock = threading.Lock()
+        self._send_lock = make_lock("serve.client.send")
         self._waiters: Dict[int, ServeResult] = {}
-        self._waiters_lock = threading.Lock()
+        self._waiters_lock = make_lock("serve.client.waiters")
         self._dead = False
         self._reader = threading.Thread(target=self._read_loop,
                                         name="serve-client", daemon=True)
@@ -258,6 +259,9 @@ class ServingClient:
         t_wire0 = time.monotonic()
         try:
             with self._send_lock:
+                # _send_lock exists to serialize frame writes on the one
+                # shared socket — the wire wait IS the serialized step.
+                # graftlint: disable=lock-held-across-blocking
                 send_message(self._sock, msg)
         except OSError as e:
             with self._waiters_lock:
@@ -281,6 +285,8 @@ class ServingClient:
                       msg_id=msg_id, data=[])
         try:
             with self._send_lock:
+                # Same frame-serialization contract as request_async.
+                # graftlint: disable=lock-held-across-blocking
                 send_message(self._sock, msg)
         except OSError:
             pass    # dead conn: the waiter completes via the read loop

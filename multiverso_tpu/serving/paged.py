@@ -53,6 +53,7 @@ from multiverso_tpu.serving.quant import (has_scale, jnp_dtype,
                                           storage_dtype)
 from multiverso_tpu.telemetry import counter, gauge
 from multiverso_tpu.utils.log import check, log
+from multiverso_tpu.utils.locks import make_lock
 
 #: Reserved physical page: the garbage sink unbacked logical pages map to.
 GARBAGE_PAGE = 0
@@ -153,7 +154,7 @@ class PagePool:
         sshape = shape[:-1] + (1,)
         self.ks = jnp.ones(sshape, jnp.float32)
         self.vs = jnp.ones(sshape, jnp.float32)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.paged")
         self._free: List[int] = list(range(self.capacity, 0, -1))
         self._ref: Dict[int, int] = {}
         #: High-water mark of resident pages (per-pool, unlike the

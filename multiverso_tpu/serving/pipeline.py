@@ -39,6 +39,7 @@ import numpy as np
 
 from multiverso_tpu.telemetry import counter, gauge, watchdog_scope
 from multiverso_tpu.utils.log import check, log
+from multiverso_tpu.utils.locks import make_condition
 
 # Depth decision table (AUTO): measured one-dispatch round-trip latency
 # -> in-flight window. Below DISPATCH_FAST_MS a double buffer already
@@ -66,13 +67,19 @@ def measured_dispatch_latency_ms(n: int = 7) -> float:
 
         f = jax.jit(lambda a: a + 1.0)
         x = jnp.zeros(8, jnp.float32)
+        # _probe_lock held across the sync ON PURPOSE: one prober per
+        # process; concurrent resolvers wait for the cached median
+        # instead of racing duplicate device probes.
+        # graftlint: disable=lock-held-across-blocking
         f(x).block_until_ready()            # compile outside the timing
         times = []
         for _ in range(n):
             t0 = time.perf_counter()
             # The probe MEASURES the dispatch+sync round trip; the wait
-            # is the quantity being sampled.
-            f(x).block_until_ready()  # graftlint: disable=block-until-ready-in-loop
+            # is the quantity being sampled (under _probe_lock by the
+            # same one-prober design as the warmup sync above).
+            # graftlint: disable=block-until-ready-in-loop,lock-held-across-blocking
+            f(x).block_until_ready()
             times.append((time.perf_counter() - t0) * 1e3)
         _probe_cache.append(float(np.median(times)))
         return _probe_cache[0]
@@ -150,7 +157,7 @@ class DispatchPipeline:
 
     def __init__(self, depth: int):
         self.depth = max(2, int(depth))
-        self._cv = threading.Condition()
+        self._cv = make_condition("serve.pipeline.cv")
         self._fifo: "collections.deque[InflightBatch]" = collections.deque()
         self._collecting = False     # oldest batch popped, mid-delivery
         self._inflight_reqs = 0

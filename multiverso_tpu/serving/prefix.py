@@ -42,6 +42,7 @@ import numpy as np
 
 from multiverso_tpu.serving.paged import GARBAGE_PAGE, PagePool
 from multiverso_tpu.telemetry import counter, gauge
+from multiverso_tpu.utils.locks import make_lock
 
 
 def prompt_key(tokens: np.ndarray, bucket: int) -> Tuple[int, bytes]:
@@ -96,7 +97,7 @@ class PrefixStore:
     def __init__(self, pool: PagePool, capacity: int):
         self.pool = pool
         self.capacity = max(1, int(capacity))
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.prefix")
         self._entries: "collections.OrderedDict[Tuple[int, bytes], PrefixEntry]" \
             = collections.OrderedDict()
         self._params_token: Optional[int] = None

@@ -30,6 +30,7 @@ from multiverso_tpu.core.checkpoint import (checkpoint_manifests,
                                             read_table_payload)
 from multiverso_tpu.telemetry import counter, gauge
 from multiverso_tpu.utils.log import check, log
+from multiverso_tpu.utils.locks import make_lock
 
 
 class ReplicaSnapshot:
@@ -50,7 +51,7 @@ class ReplicaSnapshot:
         self.dtype = dtype
         self._tables = tables
         self._dequant: Dict[str, np.ndarray] = {}
-        self._dequant_lock = threading.Lock()
+        self._dequant_lock = make_lock("serve.replica.dequant")
 
     def storage(self, name: str) -> Tuple:
         """``(payload, scale-or-None)`` in storage form — what the
@@ -147,7 +148,7 @@ class CheckpointReplica:
         self.table_dtype = storage_dtype(table_dtype)
         self.directory = directory
         self._snap: Optional[ReplicaSnapshot] = None
-        self._refresh_lock = threading.Lock()   # one loader at a time
+        self._refresh_lock = make_lock("serve.replica.refresh")   # one loader at a time
         self._poll: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._g_step = gauge("serve.replica_step")

@@ -41,6 +41,7 @@ from multiverso_tpu.serving.quant import (decode_rows, encode_rows,
                                           storage_dtype)
 from multiverso_tpu.telemetry.sketch import record_keys
 from multiverso_tpu.utils.log import check
+from multiverso_tpu.utils.locks import make_lock
 
 try:                     # 3.8+ typing.Protocol
     from typing import Protocol
@@ -313,7 +314,7 @@ class AttentionLMRunner:
         check(self.kv_dtype == "f32" or self.paged,
               "quantized KV storage requires the paged cache")
         self._params = jax.tree.map(jnp.asarray, params)
-        self._params_lock = threading.Lock()
+        self._params_lock = make_lock("serve.runner.params")
         self._params_version = 0
         # bucket -> preallocated (ck, cv): [L, B, H, bucket+max_new, dh]
         self._caches: Dict[int, Tuple[jax.Array, jax.Array]] = {}

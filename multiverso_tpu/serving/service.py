@@ -33,6 +33,7 @@ from multiverso_tpu.parallel.net import (pack_serve_payload, recv_message,
 from multiverso_tpu.serving.batcher import DynamicBatcher, ShedError
 from multiverso_tpu.telemetry import (activate, child_of, counter, emit_span,
                                       gauge, histogram)
+from multiverso_tpu.utils.locks import make_lock
 from multiverso_tpu.utils.log import check, log
 
 
@@ -56,7 +57,7 @@ class ServingService:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._batchers: Dict[int, DynamicBatcher] = {}
         self._runners: Dict[int, object] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.service")
         self._running = True
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -70,7 +71,7 @@ class ServingService:
         # once per admitted request — the map is bounded by true inflight.
         self._inflight: Dict[Tuple[int, int],
                              Tuple[DynamicBatcher, object]] = {}
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = make_lock("serve.inflight")
         self._g_conns = gauge("serve.connections")
         self._c_replies = counter("serve.replies")
         self._c_cancel_req = counter("serve.cancel.requests")
@@ -126,11 +127,19 @@ class ServingService:
               "-serve_kv_dtype requires -serve_paged_kv")
         check(int(prefix_entries) == 0 or paged,
               "-serve_prefix_cache requires -serve_paged_kv")
+        # Reserve the id under the lock, BUILD OUTSIDE it, publish under
+        # it again. Batcher construction spawns dispatcher threads and —
+        # with pipeline_depth="auto" — runs a measured device-sync
+        # probe; holding the registry lock across that convoyed
+        # quiesce()/warmup() and every concurrent registration behind
+        # one runner's bring-up (lock-held-across-blocking caught it).
         with self._lock:
-            check(runner_id not in self._batchers,
+            check(runner_id not in self._batchers
+                  and runner_id not in self._runners,
                   f"runner id {runner_id} already registered")
-            self._runners[runner_id] = runner
-            batcher = None
+            self._runners[runner_id] = runner       # reserves the id
+        batcher = None
+        try:
             if continuous and hasattr(runner, "params_ref"):
                 from multiverso_tpu.serving.continuous import \
                     ContinuousBatcher
@@ -154,6 +163,11 @@ class ServingService:
                     runner, buckets, max_batch=max_batch,
                     max_wait_ms=max_wait_ms, max_queue=max_queue,
                     pipeline_depth=pipeline_depth)
+        except BaseException:
+            with self._lock:        # un-reserve on a failed build
+                self._runners.pop(runner_id, None)
+            raise
+        with self._lock:
             self._batchers[runner_id] = batcher
 
     def batcher(self, runner_id: int = 0) -> DynamicBatcher:
@@ -208,7 +222,7 @@ class ServingService:
                 if len(self._conns) >= self.MAX_CONNS:
                     conn.close()
                     continue
-                self._conns[conn] = threading.Lock()
+                self._conns[conn] = make_lock("serve.conn")
                 self._g_conns.set(len(self._conns))
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(target=self._conn_loop, args=(conn,),

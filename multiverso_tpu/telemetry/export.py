@@ -452,6 +452,7 @@ def reset_telemetry() -> None:
     from multiverso_tpu.telemetry.alerts import stop_alert_engine
     from multiverso_tpu.telemetry.critical_path import reset_critical_path
     from multiverso_tpu.telemetry.flight import reset_flight
+    from multiverso_tpu.telemetry.lockwitness import reset_lockwitness
     from multiverso_tpu.telemetry.profile import reset_profile
     from multiverso_tpu.telemetry.roofline import reset_roofline
     from multiverso_tpu.telemetry.sketch import reset_sketches
@@ -459,6 +460,7 @@ def reset_telemetry() -> None:
     reset_flight()
     stop_exporter()
     reset_sketches()
+    reset_lockwitness()
     reset_profile()
     reset_critical_path()
     reset_roofline()

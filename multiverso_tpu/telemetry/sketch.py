@@ -50,6 +50,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from multiverso_tpu.telemetry.metrics import counter, gauge
+from multiverso_tpu.utils.locks import make_lock
 
 __all__ = ["CountMinSketch", "SpaceSaving", "TrafficSketch", "SketchHub",
            "get_sketch_hub", "record_keys", "set_sketch_enabled",
@@ -387,7 +388,7 @@ class SketchHub:
         self.topk = int(topk if topk is not None
                         else flag_or("telemetry_sketch_topk", 128))
         self.enabled = bool(flag_or("telemetry_sketch", True))
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.sketch")
         self._sketches: Dict[str, TrafficSketch] = {}
         #: (owner thread, buffer) pairs — the owner reference exists so
         #: dead threads' drained buffers can be pruned (see _drain).
@@ -630,7 +631,7 @@ class SketchHub:
 
 
 _hub: Optional[SketchHub] = None
-_hub_lock = threading.Lock()
+_hub_lock = make_lock("telemetry.sketch.hub")
 
 
 def get_sketch_hub() -> SketchHub:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import threading
 from typing import Callable, Generic, Optional, TypeVar
+from multiverso_tpu.utils.locks import make_condition
 
 T = TypeVar("T")
 
@@ -21,7 +22,7 @@ class ASyncBuffer(Generic[T]):
         self._ready: Optional[T] = None
         self._has_item = False
         self._done = False
-        self._cv = threading.Condition()
+        self._cv = make_condition("core.async_buffer.cv")
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 

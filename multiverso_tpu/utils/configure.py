@@ -464,6 +464,15 @@ define_int("telemetry_sketch_topk", 128, "Space-Saving heavy-hitter "
            "capacity per surface: every key above stream/topk frequency "
            "is guaranteed tracked (fleet_top hot-keys + the cache "
            "advisor's CDF read from these)")
+# Lock witness (telemetry/lockwitness.py via utils/locks.py seam;
+# docs/CONCURRENCY.md). Default off: make_lock() returns the bare
+# threading primitive, so the hot planes pay exactly nothing.
+define_bool("lockwitness", False, "instrument locks built through "
+            "utils.locks.make_lock(name): per-thread acquisition-order "
+            "edges into the lock-order ledger, lock.<name>.held_ms "
+            "histograms, and blocking-while-held flight events; "
+            "check_inversions() audits the ledger and a cycle trips a "
+            "postmortem (also: MULTIVERSO_LOCKWITNESS env var)")
 # Shard-imbalance alerting (fed by the router's per-replica key rates).
 define_double("fleet_imbalance_ratio", 1.7, "p99-to-mean per-replica "
               "key-rate ratio at/over which the router's "

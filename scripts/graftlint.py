@@ -6,6 +6,8 @@
     python scripts/graftlint.py --baseline graftlint-baseline.json ...
     python scripts/graftlint.py --write-baseline out.json ...
     python scripts/graftlint.py --list-rules
+    python scripts/graftlint.py --changed            # vs origin/main
+    python scripts/graftlint.py --changed HEAD~3     # vs a committish
 
 Exit codes: 0 clean (every finding suppressed or baselined, no stale
 baseline entries), 1 findings (or stale baseline entries — the baseline
@@ -32,6 +34,47 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join(_REPO, "graftlint-baseline.json")
 
 
+def _changed_python_files(base, root):
+    """Resolve ``--changed`` into concrete .py paths under ``root``.
+
+    The diff is taken against the working tree (so staged AND unstaged
+    edits show up) and untracked files ride along; deletions drop out
+    because the path no longer exists.  ``base`` is "auto" for the
+    merge-base with origin/main (falling back to HEAD when there is no
+    such remote ref), or any committish the caller names.  Only files
+    under the default lint roots count — tests/ (and its deliberately
+    offending fixtures) are out of scope here just as they are for the
+    tier-1 gate.
+    """
+    import subprocess
+
+    def git(*argv):
+        proc = subprocess.run(("git", "-C", root) + argv,
+                              capture_output=True, text=True, timeout=60)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(argv)} failed: {proc.stderr.strip()}")
+        return proc.stdout
+
+    if base == "auto":
+        try:
+            base = git("merge-base", "HEAD", "origin/main").strip()
+        except RuntimeError:
+            base = "HEAD"
+    names = git("diff", "--name-only", base, "--").splitlines()
+    names += git("ls-files", "--others",
+                 "--exclude-standard").splitlines()
+    roots = ("multiverso_tpu" + os.sep, "scripts" + os.sep)
+    out = []
+    for name in sorted(set(names)):
+        if not name.endswith(".py") or not name.startswith(roots):
+            continue
+        path = os.path.join(root, name)
+        if os.path.exists(path):
+            out.append(path)
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="graftlint",
@@ -51,6 +94,13 @@ def main(argv=None) -> int:
                         "exit 0")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--changed", nargs="?", const="auto", default=None,
+                   metavar="BASE",
+                   help="lint only .py files changed vs BASE (default: "
+                        "the merge-base with origin/main, falling back "
+                        "to HEAD), plus untracked ones — the pre-commit "
+                        "fast path; the tier-1 gate still runs the "
+                        "whole-program pass")
     p.add_argument("--root", default=_REPO,
                    help="repo root for relative finding paths")
     args = p.parse_args(argv)
@@ -64,8 +114,22 @@ def main(argv=None) -> int:
                   f"{' '.join(rule.rationale.split())}")
         return 0
 
-    paths = args.paths or [os.path.join(_REPO, "multiverso_tpu"),
-                           os.path.join(_REPO, "scripts")]
+    if args.changed is not None:
+        if args.paths:
+            print("graftlint: --changed and explicit paths are mutually "
+                  "exclusive", file=sys.stderr)
+            return 2
+        try:
+            paths = _changed_python_files(args.changed, args.root)
+        except RuntimeError as exc:
+            print(f"graftlint: {exc}", file=sys.stderr)
+            return 2
+        if not paths:
+            print("graftlint: no changed python files")
+            return 0
+    else:
+        paths = args.paths or [os.path.join(_REPO, "multiverso_tpu"),
+                               os.path.join(_REPO, "scripts")]
     for path in paths:
         if not os.path.exists(path):
             print(f"graftlint: no such path: {path}", file=sys.stderr)

@@ -938,6 +938,10 @@ def run_single(args) -> dict:
         record["qps_sweep"] = sweep
     if decode_block is not None:
         record["decode_memory"] = decode_block
+    if args.dry_run:
+        # graftsan witness leg AFTER the serve.* family snapshot above,
+        # so its toy locks never leak into the bench's own metrics.
+        record["lockwitness"] = _lockwitness_leg(args)
     if args.recovery_drill:
         # Single mode runs the PS-side halves only (the replica
         # self-heal leg needs a fleet).
@@ -1865,6 +1869,61 @@ def _spawn_ps_shard(parent_addr, tmp: str, addr_file: str,
            "-serve_device=cpu", "-telemetry_alerts=false",
            "-telemetry_flight=false"]
     return subprocess.Popen(cmd, cwd=_REPO)
+
+
+def _lockwitness_leg(args) -> dict:
+    """graftsan witness leg (dry-run): a small witnessed workload in
+    this process — a WAL group commit (the ``wal.io -> wal.staging``
+    pair) plus a two-lock nest — must record acquisition-order edges,
+    populate the ``lock.*`` hold-time histograms, and observe ZERO
+    inversions. The A/B half is structural, not statistical: with the
+    witness OFF, ``make_lock`` must hand back the bare ``threading``
+    primitive — the exact type, no wrapper — so the overhead when off
+    is exactly zero by construction."""
+    import threading as _threading
+
+    from multiverso_tpu.core.wal import WriteAheadLog
+    from multiverso_tpu.telemetry import get_registry
+    from multiverso_tpu.telemetry.lockwitness import (check_inversions,
+                                                      observed_edges,
+                                                      reset_lockwitness)
+    from multiverso_tpu.utils.locks import make_lock, set_witness_enabled
+
+    # A/B gate first, while the witness is off (the bench default).
+    set_witness_enabled(False)
+    try:
+        ab_off_is_bare = type(make_lock("bench.ab")) \
+            is type(_threading.Lock())
+    finally:
+        set_witness_enabled(None)
+
+    set_witness_enabled(True)
+    reset_lockwitness()
+    try:
+        wal = WriteAheadLog(tempfile.mkdtemp(prefix="witness_wal_"))
+        for i in range(128):
+            wal.append(b"witness-%03d" % i)
+        wal.append(b"commit", sync=True)
+        wal.close()
+        outer, inner = make_lock("bench.outer"), make_lock("bench.inner")
+        for _ in range(64):
+            with outer:
+                with inner:
+                    pass
+        edges = {f"{s} -> {d}": n
+                 for (s, d), n in sorted(observed_edges().items())}
+        cycles = check_inversions(postmortem=False)
+        held = {name: {"count": snap["count"],
+                       "p95_ms": snap["p95"]}
+                for name, snap in get_registry().snapshot(
+                    buckets=False)["histograms"].items()
+                if name.startswith("lock.") and snap["count"]}
+    finally:
+        set_witness_enabled(None)
+    return {"ab_off_is_bare_lock": ab_off_is_bare,
+            "inversions": len(cycles),
+            "cycles": [" -> ".join(c + (c[0],)) for c in cycles],
+            "edges": edges, "held_ms": held}
 
 
 def _wal_recovery_leg(args) -> dict:
@@ -2914,7 +2973,11 @@ def _make_record(benchmark: str, args, stats: _LoadStats,
         # summary), observability.attribution_ab (ledger+profiler
         # overhead A/B, acceptance <= 1%); the client-CPU-bound
         # warnings now come from the roofline classifier.
-        "schema": "multiverso_tpu.bench_serve/v11",
+        # v12: + lockwitness (graftsan, ISSUE 19): dry-run witness leg —
+        # observed acquisition-order edges, lock.* hold-time histograms,
+        # inversions (must be 0), and the structural witness-off A/B
+        # (make_lock hands back the bare threading primitive).
+        "schema": "multiverso_tpu.bench_serve/v12",
         "benchmark": benchmark,
         "time_unix": time.time(),
         "box": {"cores": os.cpu_count(),

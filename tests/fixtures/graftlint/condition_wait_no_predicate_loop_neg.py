@@ -1,0 +1,27 @@
+"""Fixture (negative): the three correct spellings — while-predicate
+loop, ``wait_for``, and ``while True:`` with a conditional escape."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def take(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(1.0)
+            return self._items.pop(0)
+
+    def take_for(self):
+        with self._cv:
+            self._cv.wait_for(lambda: self._items, timeout=1.0)
+            return self._items.pop(0)
+
+    def take_escape(self):
+        with self._cv:
+            while True:
+                if self._items:
+                    return self._items.pop(0)
+                self._cv.wait(0.5)

@@ -1,0 +1,21 @@
+"""Fixture: ``cv.wait()`` guarded by ``if`` (or nothing at all) — one
+spurious wakeup, or one notify stolen by a sibling waiter, and the
+caller proceeds on a false predicate."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def take_if_guarded(self):
+        with self._cv:
+            if not self._items:
+                self._cv.wait()  # expect: condition-wait-no-predicate-loop
+            return self._items.pop(0)
+
+    def take_unguarded(self):
+        with self._cv:
+            self._cv.wait(1.0)  # expect: condition-wait-no-predicate-loop
+            return self._items.pop(0)

@@ -1,0 +1,12 @@
+"""Fixture (negative, half A): both modules agree on one order — the
+gate lock always OUTSIDE the note lock. No cycle, no finding."""
+import threading
+
+from cross_module_lock_order_neg_b import registry_note
+
+_GATE_LOCK = threading.Lock()
+
+
+def admit(key):
+    with _GATE_LOCK:
+        registry_note(key)           # consistent: gate -> note everywhere

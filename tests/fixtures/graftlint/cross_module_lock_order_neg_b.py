@@ -1,0 +1,16 @@
+"""Fixture (negative, half B): only ever takes its own lock — never
+calls back into half A while holding it."""
+import threading
+
+_NOTE_LOCK = threading.Lock()
+_NOTES = {}
+
+
+def registry_note(key):
+    with _NOTE_LOCK:
+        _NOTES[key] = True
+
+
+def registry_flush():
+    with _NOTE_LOCK:
+        _NOTES.clear()

@@ -1,0 +1,18 @@
+"""Fixture (cross-module inversion, half A): this module nests B's lock
+inside its own — locally consistent, inverted only against half B."""
+import threading
+
+from cross_module_lock_order_pos_b import registry_put
+
+_SERVE_LOCK = threading.Lock()
+_SLOTS = {}
+
+
+def admit(key, value):
+    with _SERVE_LOCK:
+        registry_put(key, value)     # acquires B's _REG_LOCK under ours
+
+
+def serve_apply(fn):
+    with _SERVE_LOCK:
+        return fn()

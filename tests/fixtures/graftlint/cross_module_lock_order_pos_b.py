@@ -1,0 +1,18 @@
+"""Fixture (cross-module inversion, half B): nests A's lock inside its
+own — B-then-A against half A's A-then-B."""
+import threading
+
+from cross_module_lock_order_pos_a import serve_apply
+
+_REG_LOCK = threading.Lock()
+_REG = {}
+
+
+def registry_put(key, value):
+    with _REG_LOCK:
+        _REG[key] = value
+
+
+def registry_sync():
+    with _REG_LOCK:
+        serve_apply(lambda: None)    # acquires A's _SERVE_LOCK under ours

@@ -1,0 +1,19 @@
+"""Fixture (historical, PR 14, half A): the batcher slots lock wrapping
+a fleet-view fetch — locally consistent, inverted only against the
+fleet side's rebalance path. Must keep firing forever."""
+import threading
+
+from hist_pr14_slots_b import fleet_view
+
+_SLOTS_LOCK = threading.Lock()
+_SLOTS = {}
+
+
+def admit(runner_id):
+    with _SLOTS_LOCK:
+        _SLOTS[runner_id] = fleet_view()
+
+
+def slots_for(runner_id):
+    with _SLOTS_LOCK:
+        return _SLOTS.get(runner_id)

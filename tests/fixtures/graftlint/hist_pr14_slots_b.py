@@ -1,0 +1,18 @@
+"""Fixture (historical, PR 14, half B): the fleet view lock wrapping a
+slots readback — B-then-A against half A's A-then-B."""
+import threading
+
+from hist_pr14_slots_a import slots_for
+
+_VIEW_LOCK = threading.Lock()
+_VIEW = {}
+
+
+def fleet_view():
+    with _VIEW_LOCK:
+        return dict(_VIEW)
+
+
+def rebalance(runner_id):
+    with _VIEW_LOCK:
+        _VIEW[runner_id] = slots_for(runner_id)

@@ -1,0 +1,23 @@
+"""Fixture (historical, PR 15): the WAL staging lock held across
+``fdatasync`` one call deep — the shape that cost 26% add throughput
+before the staging/io lock split. Must keep firing forever."""
+import os
+import threading
+
+
+class MiniWal:
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self._path = path
+        self._staged = []
+
+    def append(self, rec):
+        with self._lock:
+            self._staged.append(rec)
+            self._flush()  # expect: lock-held-across-blocking
+
+    def _flush(self):
+        with open(self._path, "ab") as f:
+            f.write(b"".join(self._staged))
+            f.flush()
+            os.fdatasync(f.fileno())

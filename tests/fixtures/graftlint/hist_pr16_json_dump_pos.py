@@ -1,0 +1,21 @@
+"""Fixture (historical, PR 16): roster publication serializing JSON to
+disk while holding the membership lock — the encoder convoy that added
+260s of tier-1 wall time. Must keep firing forever."""
+import json
+import threading
+
+
+class MiniRoster:
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self._path = path
+        self._members = {}
+
+    def admit(self, name, addr):
+        with self._lock:
+            self._members[name] = addr
+            self._publish()  # expect: lock-held-across-blocking
+
+    def _publish(self):
+        with open(self._path, "w") as f:
+            json.dump(self._members, f)

@@ -1,0 +1,38 @@
+"""Fixture (negative): the idiomatic counterparts — snapshot under the
+lock and do the IO after release; pure compute under a lock; a timed
+queue get (not the block-forever zero-arg form)."""
+import json
+import os
+import queue
+import threading
+
+_LOCK = threading.Lock()
+_STATE = {}
+
+
+def checkpoint(path, fd):
+    with _LOCK:
+        snap = dict(_STATE)          # snapshot under the lock ...
+    with open(path, "w") as f:       # ... publish/IO after release
+        json.dump(snap, f)
+    os.fsync(fd)
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def bump(self, key):
+        with self._lock:
+            _STATE[key] = _STATE.get(key, 0) + 1
+
+    def render(self, key):
+        with self._lock:
+            return self._fmt(key)    # chain to a non-blocking helper
+
+    def _fmt(self, key):
+        return "%s=%d" % (key, _STATE.get(key, 0))
+
+    def take(self):
+        return self._q.get(timeout=1.0)

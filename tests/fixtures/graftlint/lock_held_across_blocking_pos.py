@@ -1,0 +1,35 @@
+"""Fixture: locks held across blocking sinks — a direct syscall, a
+one-hop chain into another method, a chain through an ASSIGNED-CALLABLE
+indirection, and a json.dump serialize+write."""
+import json
+import os
+import threading
+
+_LOCK = threading.Lock()
+
+
+def flush_direct(fd):
+    with _LOCK:
+        os.fsync(fd)  # expect: lock-held-across-blocking
+
+
+class Publisher:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+        self._emit = self._send_frame        # one level of indirection
+
+    def _send_frame(self, payload):
+        self._sock.sendall(payload)
+
+    def publish(self, payload):
+        with self._lock:
+            self._emit(payload)  # expect: lock-held-across-blocking
+
+    def snapshot_to(self, path, state):
+        with self._lock:
+            self._write(path, state)  # expect: lock-held-across-blocking
+
+    def _write(self, path, state):
+        with open(path, "w") as f:
+            json.dump(state, f)

@@ -14,6 +14,7 @@ bare print and an ``.item()`` inside a jitted step MUST trip the pass —
 proving the gate guards the exact regressions it exists for.
 """
 
+import json
 import os
 import shutil
 import textwrap
@@ -22,6 +23,13 @@ from multiverso_tpu.analysis import LintEngine, run_lint
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _BASELINE = os.path.join(_REPO, "graftlint-baseline.json")
+
+# The ratchet ceiling: the checked-in baseline may hold AT MOST this
+# many entries.  Lower it when the baseline shrinks; never raise it —
+# new findings get fixed or inline-suppressed with a reviewed comment,
+# not grandfathered.  (Stale entries already fail the gate above, so
+# the file can only move in one direction: toward and staying at zero.)
+_BASELINE_RATCHET = 0
 
 
 def test_repo_is_lint_clean():
@@ -40,6 +48,25 @@ def test_repo_is_lint_clean():
     # the pass actually covered the tree (81 files at the time of
     # writing; a collapse to near-zero means the walker broke)
     assert result.files > 50
+
+
+def test_baseline_ratchet_only_shrinks():
+    """The baseline is a one-way valve.  Growing it means a new finding
+    was grandfathered instead of fixed or visibly suppressed — that is
+    a review decision, so it must show up as an edit to BOTH the json
+    and this ceiling, not as a silent json-only change."""
+    with open(_BASELINE, encoding="utf-8") as f:
+        payload = json.load(f)
+    assert payload["version"] == 1, payload
+    entries = payload["entries"]
+    assert len(entries) <= _BASELINE_RATCHET, (
+        f"baseline grew to {len(entries)} entries (ratchet is "
+        f"{_BASELINE_RATCHET}) — fix the finding or suppress it inline "
+        "with a justifying comment instead of baselining it")
+    for e in entries:
+        assert e.get("reason", "").strip(), e
+        assert "FIXME" not in e["reason"], (
+            "bootstrap placeholder reason left in the baseline", e)
 
 
 def test_gate_trips_on_seeded_violations(tmp_path):

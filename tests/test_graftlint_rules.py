@@ -30,7 +30,8 @@ RULES = ("implicit-host-sync", "block-until-ready-in-loop",
          "bare-thread-no-join", "bare-print", "unbounded-queue-append",
          "span-in-traced-fn", "daemon-loop-no-watchdog",
          "unbounded-metric-name", "blocking-call-no-timeout",
-         "poll-loop-no-backoff", "unattributed-wait")
+         "poll-loop-no-backoff", "unattributed-wait",
+         "lock-held-across-blocking", "condition-wait-no-predicate-loop")
 
 
 def _expected_lines(path, rule):
@@ -104,6 +105,69 @@ def test_self_deadlock_through_call_chain():
     assert [f.line for f in got] == expected, \
         [f.render() for f in got]
     assert "self-deadlock" in got[0].message
+
+
+def test_cross_module_lock_order_positive():
+    """A-then-B in one module against B-then-A in another, each half
+    locally consistent — only the whole-program graph shows it."""
+    got = _findings(["cross_module_lock_order_pos_a.py",
+                     "cross_module_lock_order_pos_b.py"],
+                    "cross-module-lock-order")
+    assert len(got) == 1, [f.render() for f in got]
+    msg = got[0].message
+    assert "_SERVE_LOCK" in msg and "_REG_LOCK" in msg, msg
+    assert "cross_module_lock_order_pos_a" in msg, msg
+    assert "cross_module_lock_order_pos_b" in msg, msg
+    assert "docs/CONCURRENCY.md" in msg, msg
+
+
+def test_cross_module_lock_order_negative():
+    """Two modules that agree on one order produce no finding."""
+    got = _findings(["cross_module_lock_order_neg_a.py",
+                     "cross_module_lock_order_neg_b.py"],
+                    "cross-module-lock-order")
+    assert not got, [f.render() for f in got]
+
+
+def test_cross_module_rule_leaves_same_module_cycles_alone():
+    """Same-module cycles are lock-order-cycle's turf — the cross-module
+    rule must not double-report them."""
+    got = _findings(["lock_order_cycle_pos.py"], "cross-module-lock-order")
+    assert not got, [f.render() for f in got]
+
+
+def test_historical_pr15_fsync_shape_still_fires():
+    """PR-15 regression pin: fdatasync one call below a held staging
+    lock. If this stops firing, the rule regressed — not the fixture."""
+    name = "hist_pr15_fsync_pos.py"
+    expected = _expected_lines(os.path.join(_FIXTURES, name),
+                               "lock-held-across-blocking")
+    got = _findings([name], "lock-held-across-blocking")
+    assert sorted(f.line for f in got) == expected, \
+        [f.render() for f in got]
+    assert "os.fdatasync" in got[0].message, got[0].message
+
+
+def test_historical_pr16_json_dump_shape_still_fires():
+    """PR-16 regression pin: json.dump (serialize+write) under a held
+    membership lock, one call deep."""
+    name = "hist_pr16_json_dump_pos.py"
+    expected = _expected_lines(os.path.join(_FIXTURES, name),
+                               "lock-held-across-blocking")
+    got = _findings([name], "lock-held-across-blocking")
+    assert sorted(f.line for f in got) == expected, \
+        [f.render() for f in got]
+    assert "json.dump" in got[0].message, got[0].message
+
+
+def test_historical_pr14_cross_module_shape_still_fires():
+    """PR-14 regression pin: the slots-lock-vs-fleet-view inversion,
+    split across two files so each looks locally consistent."""
+    got = _findings(["hist_pr14_slots_a.py", "hist_pr14_slots_b.py"],
+                    "cross-module-lock-order")
+    assert len(got) == 1, [f.render() for f in got]
+    msg = got[0].message
+    assert "_SLOTS_LOCK" in msg and "_VIEW_LOCK" in msg, msg
 
 
 def test_suppressions_all_forms():
@@ -224,6 +288,79 @@ def test_cli_json_output_and_exit_codes(tmp_path):
         [sys.executable, script, os.path.join(_FIXTURES, "nope.py")],
         capture_output=True, text=True, env=env, timeout=240)
     assert proc.returncode == 2
+
+
+def test_cli_changed_mode_lints_only_the_diff(tmp_path):
+    """--changed resolves the git diff (committed, unstaged, untracked)
+    against a base, scopes it to the lint roots, and lints exactly that
+    set — the pre-commit fast path."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    script = os.path.join(_REPO, "scripts", "graftlint.py")
+
+    def git(*argv):
+        subprocess.run(("git", "-C", str(tmp_path)) + argv, check=True,
+                       capture_output=True, timeout=60)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    pkg = tmp_path / "multiverso_tpu"
+    pkg.mkdir()
+    clean = pkg / "clean.py"
+    clean.write_text("print('untouched')\n", encoding="utf-8")
+    dirty = pkg / "dirty.py"
+    dirty.write_text("X = 1\n", encoding="utf-8")
+    (tmp_path / "tests").mkdir()
+    git("add", "-A")
+    git("commit", "-q", "-m", "seed")
+
+    # clean tree first: nothing changed -> exit 0, no lint run at all
+    proc = subprocess.run(
+        [sys.executable, script, "--changed", "HEAD", "--no-baseline",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no changed python files" in proc.stdout
+
+    # an unstaged edit, an untracked package file, and an out-of-scope
+    # tests/ file — only the first two may be linted ('clean.py' holds
+    # a bare-print that would fire if the scoping leaked)
+    dirty.write_text("def f():\n    print('dbg')\n", encoding="utf-8")
+    (pkg / "fresh.py").write_text("def g():\n    print('new')\n",
+                                  encoding="utf-8")
+    (tmp_path / "tests" / "t.py").write_text("print('fixture')\n",
+                                             encoding="utf-8")
+    proc = subprocess.run(
+        [sys.executable, script, "--changed", "HEAD", "--no-baseline",
+         "--format", "json", "--root", str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["files"] == 2, payload
+    hit = {f["path"] for f in payload["findings"]
+           if f["rule"] == "bare-print"}
+    assert hit == {os.path.join("multiverso_tpu", "dirty.py"),
+                   os.path.join("multiverso_tpu", "fresh.py")}, payload
+
+    # --changed with explicit paths is a usage error
+    proc = subprocess.run(
+        [sys.executable, script, "--changed", "HEAD", "--root",
+         str(tmp_path), str(clean)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+def test_list_rules_in_sync_with_docs():
+    """Every registered rule has a row in docs/LINTS.md's catalog table
+    and vice versa — the CLI's --list-rules and the docs cannot drift."""
+    doc = open(os.path.join(_REPO, "docs", "LINTS.md"),
+               encoding="utf-8").read()
+    documented = set(re.findall(r"^\| `([a-z0-9\-]+)` \|", doc,
+                                flags=re.MULTILINE))
+    registered = {r.id for r in all_rules()}
+    assert registered == documented, (
+        f"undocumented rules: {sorted(registered - documented)}; "
+        f"doc rows with no rule: {sorted(documented - registered)}")
 
 
 def test_run_lint_one_call_api():

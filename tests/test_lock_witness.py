@@ -1,0 +1,469 @@
+"""graftsan runtime side: the lock witness, and the static<->runtime
+cross-check that is the subsystem's whole point.
+
+Mechanics first (zero overhead off, edges, hold histograms, reentrancy,
+condition integration, inversion detection, cross-process ledger merge),
+then the two regression tests for the real bugs the static triage found
+(serving registry lock held across batcher build; the router-feed lock
+monopolized by an in-flight fetch), a concurrent stress of the
+HotRowCache/CacheAutosizer under the witness, and finally the tier-1
+scenario: a train+serve+fleet workload under the witness must observe
+ZERO lock-order inversions, and every statically-claimed cross-module
+edge (``analysis.interproc.cross_module_witness_claims``) must either be
+observed live or carry a reasoned suppression below.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def witness():
+    """Witness ON for locks constructed inside the test; always restored
+    (the autouse telemetry reset clears the ledger afterwards)."""
+    from multiverso_tpu.telemetry.lockwitness import reset_lockwitness
+    from multiverso_tpu.utils.locks import set_witness_enabled
+    set_witness_enabled(True)
+    reset_lockwitness()
+    yield
+    set_witness_enabled(None)
+
+
+# ---------------------------------------------------------------------------
+# Zero overhead when off — by construction, not by measurement
+# ---------------------------------------------------------------------------
+def test_witness_off_returns_bare_primitives():
+    from multiverso_tpu.utils.locks import (make_condition, make_lock,
+                                            make_rlock,
+                                            set_witness_enabled,
+                                            witness_enabled)
+    set_witness_enabled(None)
+    assert not witness_enabled()
+    # The factory returns the exact threading type: no wrapper frame,
+    # no extra attribute, nothing for the hot path to pay for.
+    assert type(make_lock("off.x")) is type(threading.Lock())
+    assert type(make_rlock("off.x")) is type(threading.RLock())
+    cv = make_condition("off.x")
+    assert type(cv) is threading.Condition
+    # and nothing was registered in the ledger
+    from multiverso_tpu.telemetry.lockwitness import observed_locks
+    assert "off.x" not in observed_locks()
+
+
+def test_witness_forced_on_returns_instrumented_locks(witness):
+    from multiverso_tpu.telemetry.lockwitness import (WitnessCondition,
+                                                      WitnessLock,
+                                                      WitnessRLock)
+    from multiverso_tpu.utils.locks import (make_condition, make_lock,
+                                            make_rlock)
+    assert isinstance(make_lock("on.x"), WitnessLock)
+    assert isinstance(make_rlock("on.x"), WitnessRLock)
+    assert isinstance(make_condition("on.x"), WitnessCondition)
+
+
+# ---------------------------------------------------------------------------
+# Ledger mechanics
+# ---------------------------------------------------------------------------
+def test_edges_and_hold_histograms_recorded(witness):
+    from multiverso_tpu.telemetry import get_registry
+    from multiverso_tpu.telemetry.lockwitness import observed_edges
+    from multiverso_tpu.utils.locks import make_lock
+    a, b = make_lock("t.a"), make_lock("t.b")
+    with a:
+        with b:
+            pass
+    with a:         # second solo acquisition: hold time only, no edge
+        pass
+    edges = observed_edges()
+    assert edges[("t.a", "t.b")] == 1
+    assert ("t.b", "t.a") not in edges
+    hists = get_registry().snapshot()["histograms"]
+    assert hists["lock.t.a.held_ms"]["count"] == 2
+    assert hists["lock.t.b.held_ms"]["count"] == 1
+
+
+def test_rlock_owner_reacquire_records_no_self_edge(witness):
+    from multiverso_tpu.telemetry.lockwitness import observed_edges
+    from multiverso_tpu.utils.locks import make_rlock
+    r = make_rlock("t.r")
+    with r:
+        with r:     # owner re-acquire cannot deadlock: no edge
+            pass
+    assert ("t.r", "t.r") not in observed_edges()
+
+
+def test_condition_wait_integration(witness):
+    """wait() fully releases the witnessed RLock (held-stack stays
+    exact), the park lands in ``lock.<name>.wait_ms``, and edges taken
+    while holding the cv's lock are attributed to its name."""
+    from multiverso_tpu.telemetry import get_registry
+    from multiverso_tpu.telemetry.lockwitness import observed_edges
+    from multiverso_tpu.utils.locks import make_condition, make_lock
+    cv = make_condition("t.cv")
+    other = make_lock("t.other")
+    ready = []
+
+    def consumer():
+        with cv:
+            while not ready:
+                cv.wait(1.0)
+            with other:         # edge: t.cv -> t.other
+                pass
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        ready.append(1)
+        cv.notify()
+    t.join(5)
+    assert not t.is_alive()
+    assert observed_edges().get(("t.cv", "t.other")) == 1
+    hists = get_registry().snapshot()["histograms"]
+    assert hists["lock.t.cv.wait_ms"]["count"] >= 1
+
+
+def test_inversion_detection_counts_and_cycles(witness):
+    from multiverso_tpu.telemetry import get_registry
+    from multiverso_tpu.telemetry.lockwitness import check_inversions
+    from multiverso_tpu.utils.locks import make_lock
+    a, b = make_lock("inv.a"), make_lock("inv.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:     # the inverted order, witnessed
+            pass
+    cycles = check_inversions(postmortem=False)
+    assert cycles == [("inv.a", "inv.b")]
+    counters = get_registry().snapshot()["counters"]
+    assert counters["lock.inversions"]["value"] >= 1
+
+
+def test_merge_ledgers_finds_cross_process_inversion(witness):
+    """Each process's ledger is acyclic on its own; the inversion exists
+    only in the union — exactly what the fleet postmortem merge is for."""
+    from multiverso_tpu.telemetry.lockwitness import (LEDGER_SCHEMA,
+                                                      find_cycles, ledger,
+                                                      merge_ledgers)
+    from multiverso_tpu.utils.locks import make_lock
+    a, b = make_lock("m.a"), make_lock("m.b")
+    with a:
+        with b:
+            pass
+    local = ledger()
+    assert local["schema"] == LEDGER_SCHEMA
+    assert not find_cycles({(e["src"], e["dst"])
+                            for e in local["edges"]})
+    remote = {"schema": LEDGER_SCHEMA, "locks": {},
+              "edges": [{"src": "m.b", "dst": "m.a", "count": 3,
+                         "threads": ["remote-worker"]}]}
+    merged = merge_ledgers([local, remote])
+    assert merged[("m.a", "m.b")] == 1 and merged[("m.b", "m.a")] == 3
+    assert find_cycles(merged.keys()) == [("m.a", "m.b")]
+
+
+def test_reset_telemetry_clears_the_ledger(witness):
+    from multiverso_tpu.telemetry import reset_telemetry
+    from multiverso_tpu.telemetry.lockwitness import (observed_edges,
+                                                      observed_locks)
+    from multiverso_tpu.utils.locks import make_lock
+    a, b = make_lock("z.a"), make_lock("z.b")
+    with a:
+        with b:
+            pass
+    assert observed_edges()
+    reset_telemetry()
+    assert observed_edges() == {} and observed_locks() == {}
+
+
+# ---------------------------------------------------------------------------
+# Regression: the two real bugs the static triage found
+# ---------------------------------------------------------------------------
+def test_register_runner_builds_batcher_outside_registry_lock(
+        mv_env, monkeypatch):
+    """PR-19 triage finding #1: ``register_runner`` used to hold the
+    registry lock across batcher construction (dispatcher threads + the
+    pipeline-depth device probe), convoying quiesce()/close() and every
+    concurrent registration behind one runner's bring-up. The fix
+    reserves the id, builds OUTSIDE the lock, publishes under it."""
+    import multiverso_tpu.serving.service as service_mod
+    gate = threading.Event()
+    entered = threading.Event()
+
+    class StubBatcher:
+        def __init__(self, runner, buckets, **kw):
+            entered.set()
+            assert gate.wait(10), "test gate never opened"
+
+        def quiesce(self, timeout_s=0.0):
+            return True
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(service_mod, "DynamicBatcher", StubBatcher)
+    svc = service_mod.ServingService()
+    try:
+        t = threading.Thread(
+            target=lambda: svc.register_runner(object(), runner_id=7,
+                                               continuous=False),
+            daemon=True)
+        t.start()
+        assert entered.wait(5), "batcher build never started"
+        # The registry lock must be FREE while the slow build runs ...
+        assert svc._lock.acquire(timeout=1.0), \
+            "registry lock held across batcher construction"
+        svc._lock.release()
+        # ... and the id must already be reserved: a duplicate register
+        # fails fast instead of double-building.
+        with pytest.raises(Exception, match="already registered"):
+            svc.register_runner(object(), runner_id=7, continuous=False)
+        gate.set()
+        t.join(5)
+        assert not t.is_alive()
+        assert 7 in svc._batchers      # published after the build
+    finally:
+        gate.set()
+        svc.close()
+
+
+def test_register_runner_failed_build_unreserves_the_id(
+        mv_env, monkeypatch):
+    import multiverso_tpu.serving.service as service_mod
+
+    class ExplodingBatcher:
+        def __init__(self, runner, buckets, **kw):
+            raise RuntimeError("boom")
+
+    monkeypatch.setattr(service_mod, "DynamicBatcher", ExplodingBatcher)
+    svc = service_mod.ServingService()
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            svc.register_runner(object(), runner_id=3, continuous=False)
+        assert 3 not in svc._runners and 3 not in svc._batchers
+    finally:
+        svc.close()
+
+
+def test_router_feed_control_ops_not_blocked_by_inflight_fetch():
+    """PR-19 triage finding #2: ``_RouterFeed`` used one lock for both
+    the socket exchange and the tiny control state, so a fetch parked in
+    recv (or a 4-attempt backoff dial) blocked ``consume_reconnected``
+    and made ``close()`` wait out the exchange. Split locks: control
+    ops return promptly, and close() interrupts the in-flight fetch."""
+    from multiverso_tpu.fleet.client import _RouterFeed
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    feed = _RouterFeed(srv.getsockname())
+    errs = []
+
+    def run_fetch():
+        try:
+            feed.fetch()
+        except (IOError, OSError) as e:
+            errs.append(e)
+
+    t = threading.Thread(target=run_fetch, daemon=True)
+    t.start()
+    conn, _ = srv.accept()          # fetch dialed; it now parks in recv
+    try:
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        feed.consume_reconnected()  # control op: must not wait out recv
+        assert time.monotonic() - t0 < 1.0, \
+            "consume_reconnected blocked behind an in-flight fetch"
+        feed.close()                # must interrupt the parked recv
+        t.join(5)
+        assert not t.is_alive(), "close() did not interrupt the fetch"
+        assert errs, "interrupted fetch should surface an OSError"
+        # closed-for-good: the next fetch fails fast, no re-dial
+        with pytest.raises(OSError):
+            feed.fetch()
+    finally:
+        conn.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Concurrent stress: HotRowCache resize vs lookup vs budget clamp
+# ---------------------------------------------------------------------------
+def test_hot_row_cache_stress_resize_lookup_clamp(witness, mv_env):
+    """Three mutators racing the cache for ~0.5s under the witness:
+    lookups+inserts, explicit resizes, and autosizer budget clamps. No
+    exceptions, the capacity invariant holds throughout, and the
+    witness observes no lock-order inversion around ``serve.cache``."""
+    from multiverso_tpu.serving.cache import CacheAutosizer, HotRowCache
+    from multiverso_tpu.telemetry.lockwitness import check_inversions
+    cache = HotRowCache(capacity=128)
+    sizer = CacheAutosizer(cache, mem_budget=1 << 20, windows=1,
+                           cooldown_s=0.0, min_rows=16)
+    stop = time.monotonic() + 0.5
+    failures = []
+
+    def guard(fn):
+        try:
+            while time.monotonic() < stop:
+                fn()
+        except Exception as e:  # noqa: BLE001 - collected for the assert
+            failures.append(e)
+
+    rng = np.random.default_rng(7)
+
+    def lookups():
+        keys = rng.integers(0, 512, size=8).astype(np.int64)
+        rows = rng.normal(size=(8, 4)).astype(np.float32)
+        cache.put_rows(keys, rows, clock=1.0)
+        cache.get_rows(keys, now_clock=1.0)
+        assert len(cache) <= max(cache.capacity, 1)
+
+    def resizes():
+        cache.resize(64)
+        cache.resize(256)
+
+    fake_now = [0.0]
+
+    def clamps():
+        fake_now[0] += 10.0
+        sizer.on_advice({"predicted_hit_rate": 0.5,
+                         "predicted_hit_rate_2x": 0.9},
+                        now=fake_now[0])
+
+    threads = [threading.Thread(target=guard, args=(fn,), daemon=True)
+               for fn in (lookups, lookups, resizes, clamps)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+        assert not t.is_alive()
+    assert not failures, failures
+    assert len(cache) <= cache.capacity
+    assert cache.capacity >= sizer.min_rows
+    assert check_inversions(postmortem=False) == []
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 cross-check scenario: train + serve + fleet under the witness
+# ---------------------------------------------------------------------------
+#: Statically-claimed cross-module edges the scenario deliberately does
+#: NOT exercise, each with the reason. An entry here is a conscious
+#: decision reviewed with the PR — NOT a way to make the test pass.
+#: Keys are (src_witness, dst_witness).
+REASONED_SUPPRESSIONS = {
+    # (currently empty: every static cross-module claim is exercised
+    # live below — keep it that way when possible)
+}
+
+ROWS, COLS = 256, 8
+
+
+def test_witness_scenario_train_serve_fleet(witness, mv_env, tmp_path):
+    import jax
+    from jax.sharding import Mesh
+
+    from multiverso_tpu.analysis.interproc import \
+        cross_module_witness_claims
+    from multiverso_tpu.core.table import ServerStore
+    from multiverso_tpu.core.updater import get_updater
+    from multiverso_tpu.core.wal import WriteAheadLog
+    from multiverso_tpu.fleet import FleetClient, FleetMember, FleetRouter
+    from multiverso_tpu.fleet.client import request_drain
+    from multiverso_tpu.serving import ServingService, SparseLookupRunner
+    from multiverso_tpu.telemetry import get_registry
+    from multiverso_tpu.telemetry.lockwitness import (check_inversions,
+                                                      observed_edges)
+
+    # -- train plane: WAL group commit under the witnessed lock pair ----
+    wal = WriteAheadLog(str(tmp_path / "wal"))
+    for i in range(64):
+        wal.append(b"rec-%03d" % i)
+    wal.append(b"sync", sync=True)
+    wal.close()
+
+    # -- serve + fleet planes: router + two replicas + routed client ----
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(ROWS, COLS)).astype(np.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1), ("server",))
+    router = FleetRouter(heartbeat_ms=40.0, liveness_misses=5, proxy=True)
+    services, members, cli = [], [], None
+    try:
+        for i in range(2):
+            store = ServerStore(f"wit_t{i}", (ROWS, COLS), np.float32,
+                                get_updater(np.float32, "default"), mesh,
+                                num_workers=1, init_array=data.copy())
+            svc = ServingService()
+            svc.register_runner(SparseLookupRunner(store), buckets=(4, 8),
+                                max_batch=4, max_wait_ms=1.0)
+            svc.warmup()
+            services.append(svc)
+            members.append(FleetMember(router.address, svc,
+                                       member_id=f"r{i}").start())
+        deadline = time.monotonic() + 20
+        while len(router.group.member_ids()) < 2:
+            assert time.monotonic() < deadline, "members never joined"
+            time.sleep(0.02)
+
+        cli = FleetClient(router.address)
+        for _ in range(6):
+            keys = rng.integers(0, ROWS, size=5).astype(np.int32)
+            got = cli.lookup(keys, deadline_ms=10_000, timeout=30)
+            np.testing.assert_array_equal(got, data[keys])
+
+        # Exercise the two statically-claimed cross-module edges live:
+        # the router's lazy proxy client (fleet.router -> fleet.client) …
+        router._proxy()
+        # … and the wire drain trigger's membership check under the
+        # router lock (fleet.router -> fleet.membership).
+        ack = request_drain(router.address, member_id="no-such-member",
+                            timeout_s=1.0)
+        assert ack["started"] is False
+    finally:
+        if cli is not None:
+            cli.close()
+        for m in members:
+            m.close()
+        for s in services:
+            s.close()
+        router.close()
+
+    # -- verdict (a): ZERO observed lock-order inversions ---------------
+    edges = observed_edges()
+    assert edges, "scenario recorded no acquisition-order edges at all"
+    cycles = check_inversions(postmortem=False)
+    assert cycles == [], (
+        "witnessed lock-order inversion(s): "
+        + "; ".join(" -> ".join(c + (c[0],)) for c in cycles))
+
+    # -- verdict (b): every static cross-module claim observed live -----
+    claims = cross_module_witness_claims(
+        [os.path.join(_REPO, "multiverso_tpu")], _REPO)
+    assert claims, "static side produced no cross-module claims — " \
+                   "the call graph or the witness-name join broke"
+    unmatched = []
+    for c in claims:
+        key = (c.src_witness, c.dst_witness)
+        if key in edges or key in REASONED_SUPPRESSIONS:
+            continue
+        unmatched.append(f"{key[0]} -> {key[1]} "
+                         f"(claimed at {c.rel}:{c.line} via {c.via})")
+    assert not unmatched, (
+        "statically-claimed cross-module edges never observed live — "
+        "exercise them in this scenario or add a reasoned suppression:\n"
+        + "\n".join(unmatched))
+
+    # -- and the lock.* histogram family actually populated -------------
+    hists = get_registry().snapshot()["histograms"]
+    for name in ("lock.wal.staging.held_ms", "lock.wal.io.held_ms",
+                 "lock.fleet.router.held_ms",
+                 "lock.fleet.membership.held_ms"):
+        assert hists.get(name, {}).get("count", 0) > 0, \
+            f"{name} never observed a hold"

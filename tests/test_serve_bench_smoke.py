@@ -30,7 +30,7 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     record = json.loads(out.read_text())
     # v9: + chaos block (--chaos-drill seeded kill-any-subset rounds);
     # config grows chaos_seed/chaos_rounds/rpc_timeout_ms
-    assert record["schema"] == "multiverso_tpu.bench_serve/v11"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v12"
     assert record["box"]["cores"] >= 1
     lat = record["latency_ms"]
     assert set(lat) >= {"p50", "p95", "p99", "mean", "max"}
@@ -146,6 +146,21 @@ def test_serve_bench_dry_run_cpu(tmp_path):
     assert ab["overhead_pct"] < 15.0, ab
     prof = record["profile"]
     assert prof["samples"] > 0 and prof["n_stacks"] > 0, prof
+    # graftsan acceptance witnesses: the dry run's witness leg first
+    # proves the OFF path hands out bare threading primitives (zero
+    # overhead by construction — there is no instrumented code to pay
+    # for), then drives a WAL commit + a nested lock pair under the
+    # witness and records hold-time histograms with ZERO observed
+    # inversions.
+    lw = record["lockwitness"]
+    assert lw["ab_off_is_bare_lock"] is True, lw
+    assert lw["inversions"] == 0, lw
+    assert lw["cycles"] == [], lw
+    assert lw["edges"], lw
+    held = lw["held_ms"]
+    assert any(k.startswith("lock.wal.") for k in held), held
+    for name, h in held.items():
+        assert h["count"] > 0, (name, h)
 
 
 def test_serve_main_cli_end_to_end(tmp_path):
