@@ -1,0 +1,13 @@
+"""Online recommender serving+training loop (docs/RECSYS.md).
+
+:mod:`multiverso_tpu.recsys.online` drives
+train -> checkpoint -> replica-publish -> serve -> retrain continuously
+over the DLRM subsystem (:mod:`multiverso_tpu.models.dlrm`).
+"""
+
+from multiverso_tpu.recsys.online import (FreshnessTracker, OnlineConfig,
+                                          OnlineLoop, ServeLoad,
+                                          make_live_runner)
+
+__all__ = ["FreshnessTracker", "OnlineConfig", "OnlineLoop", "ServeLoad",
+           "make_live_runner"]

@@ -73,6 +73,16 @@ SPAN_PHASES: Dict[str, str] = {
     "serve.reply": "wire",
     "serve.deliver": "deliver",
     "fleet.park": "park",
+    # recsys online loop (recsys/online.py): the train step's hot path
+    # maps onto the same taxonomy — row pulls are result collection,
+    # the hybrid jit step is device residency, row-delta pushes are
+    # dispatches onto the PS plane, publish is checkpoint wire-out, and
+    # lane scoring is device work.
+    "recsys.pull": "collect",
+    "recsys.compute": "device",
+    "recsys.push": "dispatch",
+    "recsys.publish": "wire",
+    "recsys.score": "device",
 }
 
 #: Containers: spans that *enclose* phase spans rather than being a
@@ -80,6 +90,7 @@ SPAN_PHASES: Dict[str, str] = {
 _CONTAINER_SPANS = frozenset({
     "serve.request", "serve.batch", "serve.client",
     "fleet.request", "fleet.attempt", "fleet.lookup", "fleet.proxy",
+    "recsys.step",
 })
 
 

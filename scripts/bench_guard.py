@@ -79,7 +79,20 @@ def comparable_key(record):
             # Falsy defaults keep every pre-17 record's key identical.
             int(cfg.get("hotkey_replicas", 0) or 0),
             int(bool(cfg.get("rebalance", False))),
-            int(cfg.get("cache_mem_budget", 0) or 0))
+            int(cfg.get("cache_mem_budget", 0) or 0),
+            # BENCH_RECSYS family (ISSUE 20): recsys_online records gate
+            # on achieved serve QPS like any serving record, but their
+            # throughput also depends on the concurrent-trainer shape —
+            # stream/table sizes and train cadence are workload, not
+            # code. Keyed so a bigger-model run never gates against a
+            # smaller one; falsy defaults keep every serving record's
+            # key identical.
+            int(cfg.get("fields", 0) or 0),
+            int(cfg.get("vocab", 0) or 0),
+            int(cfg.get("embed_dim", 0) or 0),
+            int(cfg.get("batch", 0) or 0),
+            int(cfg.get("steps", 0) or 0),
+            str(cfg.get("lanes", "") or ""))
 
 
 def box_fingerprint(record):
@@ -192,6 +205,13 @@ def _hotkey(qps):
     return r
 
 
+def _recsys(qps, vocab=512):
+    r = _fake(qps, benchmark="recsys_online")
+    r["config"].update({"fields": 3, "vocab": vocab, "embed_dim": 8,
+                        "batch": 64, "steps": 120, "lanes": "1,4"})
+    return r
+
+
 def self_test():
     """--dry-run: exercise the three gate outcomes on synthetic history
     written through the real file path (the tier-1 smoke drives this)."""
@@ -215,6 +235,16 @@ def self_test():
         ("rebalance-enabled history gates rebalance-enabled runs",
          [_rebal(q) for q in (500.0, 510.0, 495.0, 505.0)]
          + [_rebal(400.0)], "regression"),
+        # BENCH_RECSYS family (ISSUE 20): same-shape recsys history
+        # gates recsys runs; a shape change abstains.
+        ("first recsys record abstains against serving history",
+         steady + [_recsys(400.0)], "insufficient_history"),
+        ("recsys history gates recsys runs",
+         [_recsys(q) for q in (300.0, 305.0, 295.0, 302.0)]
+         + [_recsys(200.0)], "regression"),
+        ("recsys table-shape change abstains",
+         [_recsys(q) for q in (300.0, 305.0, 295.0, 302.0)]
+         + [_recsys(298.0, vocab=4096)], "insufficient_history"),
         # p99 axis (ISSUE 18): QPS can hold while the tail blows up.
         ("p99 spike with steady QPS fails",
          [_fake(q, p99=5.0) for q in (500.0, 510.0, 495.0, 505.0)]

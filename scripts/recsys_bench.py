@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Train-while-serve benchmark for the DLRM online recommender.
+
+One process, one measurement of the whole RECSYS loop (docs/RECSYS.md):
+the OnlineLoop trains the PS-backed DLRM on the drifting impression
+stream while a ServeLoad answers row lookups against the LIVE embedding
+table — training throughput and serving QPS are measured *concurrently*,
+which is the property no earlier bench covered (serve_bench serves a
+frozen checkpoint; state_bench trains without lookups).
+
+Four result families land in one BENCH_RECSYS.json record:
+
+* ``train`` — updates/sec + examples/sec sustained WHILE serving.
+* ``achieved_qps`` vs ``offered_qps`` — the serving plane under
+  concurrent writer pressure, with 0 errors required.
+* ``freshness`` — prequential AUC per staleness lane (fresh, s1, s4,
+  frozen). The curve must be monotone with fresh strictly above the
+  frozen (stale-by-infinity) lane, or the record fails: that ordering
+  is the measured proof that publishing fresher tables buys quality.
+* ``quant`` — int8-vs-f32 serving-table AUC on the SAME final
+  checkpoint (two CheckpointReplicas over one directory), the
+  model-quality companion to serve_bench's wire/kv dtype legs.
+
+The record appends to BENCH_SERVE_HISTORY.jsonl so bench_guard gates
+recsys trend points exactly like serving ones (comparable_key knows the
+family's stream/table shape — scripts/bench_guard.py).
+
+    python scripts/recsys_bench.py --dry-run        # tier-1 smoke, <30s
+    python scripts/recsys_bench.py --steps 600 --qps 800
+"""
+
+import argparse
+import json
+import os
+import platform
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+SCHEMA = "multiverso_tpu.bench_recsys/v1"
+
+
+def _history_append(record: dict, out_path: str) -> None:
+    history = os.path.join(os.path.dirname(os.path.abspath(out_path)),
+                           "BENCH_SERVE_HISTORY.jsonl")
+    with open(history, "a") as f:
+        f.write(json.dumps(record, separators=(",", ":")) + "\n")
+
+
+def _quant_auc(ckpt_dir: str, cfg, eval_batches) -> dict:
+    """Int8-vs-f32 *model quality* on the final checkpoint: score the
+    same held-out impressions through two serving snapshots of the same
+    directory that differ ONLY in table storage dtype."""
+    from multiverso_tpu.models.dlrm import SnapshotScorer, exact_auc
+    from multiverso_tpu.serving.replica import CheckpointReplica
+
+    out = {}
+    scores_by_dtype = {}
+    for dtype in ("f32", "int8"):
+        rep = CheckpointReplica(ckpt_dir, load=True, table_dtype=dtype)
+        try:
+            snap = rep.snapshot()
+            scorer = SnapshotScorer(
+                cfg, snap.table(cfg.dense_table_name)[0],
+                lambda f, ids, _s=snap: _s.table(cfg.table_name(f))[ids])
+            scores = np.concatenate([scorer.scores(b.ids, b.dense)
+                                     for b in eval_batches])
+            labels = np.concatenate([b.labels for b in eval_batches])
+            auc = exact_auc(scores, labels)
+            out[dtype] = {"auc": float(auc), "step": int(rep.step)}
+            scores_by_dtype[dtype] = scores
+        finally:
+            rep.close()
+    out["auc_delta"] = abs(out["f32"]["auc"] - out["int8"]["auc"])
+    out["max_score_delta"] = float(np.abs(
+        scores_by_dtype["f32"] - scores_by_dtype["int8"]).max())
+    return out
+
+
+def _check_freshness(curve) -> list:
+    """The acceptance gate: AUC must not increase with staleness
+    (allowing float-level ties), and fresh must beat frozen outright."""
+    failures = []
+    aucs = [lane["auc"] for lane in curve]
+    names = [lane["lane"] for lane in curve]
+    for a, b, na, nb in zip(aucs, aucs[1:], names, names[1:]):
+        if b > a + 1e-9:
+            failures.append(f"freshness not monotone: {nb} auc {b:.4f} "
+                            f"> {na} auc {a:.4f}")
+    if aucs and not aucs[0] > aucs[-1]:
+        failures.append(f"fresh lane auc {aucs[0]:.4f} does not beat "
+                        f"frozen {aucs[-1]:.4f}")
+    return failures
+
+
+def run(args) -> int:
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.dlrm import (DLRMConfig, DLRMModel,
+                                            ImpressionStream, StreamConfig)
+    from multiverso_tpu.recsys import (OnlineConfig, OnlineLoop, ServeLoad,
+                                       make_live_runner)
+
+    small = bool(args.dry_run)
+    steps = args.steps or (120 if small else 600)
+    batch = args.batch or (64 if small else 256)
+    vocab = args.vocab or (512 if small else 4096)
+    fields = args.fields or (3 if small else 4)
+    embed_dim = 8 if small else 16
+    dense_dim = 4 if small else 8
+    publish_every = max(2, steps // (6 if small else 10))
+    qps = args.qps or (300.0 if small else 1000.0)
+    lanes = (1, 4)
+
+    cfg = DLRMConfig(fields=fields, vocab=vocab, embed_dim=embed_dim,
+                     dense_dim=dense_dim,
+                     bottom_mlp=(8,) if small else (32,),
+                     top_mlp=(8,) if small else (32,), seed=args.seed)
+    scfg = StreamConfig(fields=fields, vocab=vocab, dense_dim=dense_dim,
+                        zipf=args.zipf,
+                        drift_every=max(1, (steps * batch) // 12),
+                        drift_scale=0.3, seed=args.seed)
+    ocfg = OnlineConfig(steps=steps, batch=batch,
+                        publish_every=publish_every,
+                        eval_every=2 if small else 4, lanes=lanes)
+
+    mv.init([])
+    t0 = time.time()
+    try:
+        with tempfile.TemporaryDirectory(prefix="recsys_bench_") as td:
+            model = DLRMModel(cfg, mode="ps")
+            stream = ImpressionStream(scfg)
+            loop = OnlineLoop(model, stream, td, ocfg)
+            runner = make_live_runner(model, field=0,
+                                      cache_rows=args.cache_rows,
+                                      cache_staleness=1)
+            load = ServeLoad(runner, vocab=vocab, zipf=args.zipf, qps=qps,
+                             keys_per_req=args.keys_per_req,
+                             max_batch=args.serve_batch)
+            load.start()
+            try:
+                summary = loop.run()
+            finally:
+                serve = load.stop()
+            # Held-out eval AFTER training: same stream distribution
+            # (post-drift), never trained on — the quant comparison is
+            # about the tables, so the set just has to be shared.
+            eval_batches = [stream.batch(batch) for _ in range(4)]
+            quant = _quant_auc(td, cfg, eval_batches)
+    finally:
+        mv.shutdown()
+
+    failures = _check_freshness(summary["freshness"])
+    if serve["errors"]:
+        failures.append(f"serve errors: {serve['errors']}")
+    if serve["requests"] == 0:
+        failures.append("serve plane answered zero lookups")
+    if quant["auc_delta"] > args.quant_tolerance:
+        failures.append(f"int8 AUC delta {quant['auc_delta']:.4f} "
+                        f"exceeds {args.quant_tolerance}")
+
+    record = {
+        "schema": SCHEMA,
+        "benchmark": "recsys_online",
+        "time_unix": time.time(),
+        "box": {"cores": os.cpu_count(),
+                "machine": platform.machine(),
+                "python": platform.python_version()},
+        "config": {
+            "dry_run": small,
+            "steps": steps, "batch": batch,
+            "fields": fields, "vocab": vocab, "embed_dim": embed_dim,
+            "dense_dim": dense_dim, "publish_every": publish_every,
+            "lanes": ",".join(str(s) for s in lanes),
+            "zipf": args.zipf, "qps": qps,
+            "keys_per_req": args.keys_per_req,
+            "max_batch": args.serve_batch,
+            "cache_rows": args.cache_rows,
+            "seed": args.seed,
+        },
+        "train": {
+            "updates_per_sec": summary["updates_per_sec"],
+            "examples_per_sec": summary["examples_per_sec"],
+            "steps": summary["steps"],
+            "publishes": summary["publishes"],
+            "final_loss": summary["final_loss"],
+            "train_auc": summary["train_auc"],
+            "drift_steps": summary["drift_steps"],
+        },
+        "offered_qps": serve["offered_qps"],
+        "achieved_qps": serve["achieved_qps"],
+        "latency_ms": serve["batch_latency_ms"],
+        "serve": serve,
+        "freshness": summary["freshness"],
+        "quant": quant,
+        "elapsed_s": round(time.time() - t0, 3),
+        "failures": failures,
+        "ok": not failures,
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+    _history_append(record, args.out)
+    print(json.dumps({
+        "benchmark": record["benchmark"],
+        "updates_per_sec": round(record["train"]["updates_per_sec"], 1),
+        "offered_qps": record["offered_qps"],
+        "achieved_qps": round(record["achieved_qps"], 1),
+        "serve_errors": serve["errors"],
+        "fresh_auc": round(summary["freshness"][0]["auc"], 4),
+        "frozen_auc": round(summary["freshness"][-1]["auc"], 4),
+        "int8_auc_delta": round(quant["auc_delta"], 5),
+        "ok": record["ok"],
+        "out": args.out,
+    }))
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    return 0 if not failures else 1
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=os.path.join(_REPO,
+                                                 "BENCH_RECSYS.json"))
+    p.add_argument("--steps", type=int, default=0,
+                   help="training steps (0 = mode default)")
+    p.add_argument("--batch", type=int, default=0)
+    p.add_argument("--vocab", type=int, default=0)
+    p.add_argument("--fields", type=int, default=0)
+    p.add_argument("--qps", type=float, default=0.0,
+                   help="offered lookup QPS (0 = mode default)")
+    p.add_argument("--keys-per-req", type=int, default=16)
+    p.add_argument("--serve-batch", type=int, default=8)
+    p.add_argument("--cache-rows", type=int, default=128)
+    p.add_argument("--zipf", type=float, default=1.2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quant-tolerance", type=float, default=0.01,
+                   help="max |AUC(int8) - AUC(f32)| on the same "
+                   "checkpoint before the record fails")
+    p.add_argument("--dry-run", action="store_true",
+                   help="small shapes, <30s — the tier-1 smoke")
+    args = p.parse_args()
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2290,7 +2290,8 @@ def _elastic_round(seed: int) -> dict:
     }
 
 
-def _chaos_drill(args, router_addr, procs, tdir, fleet) -> dict:
+def _chaos_drill(args, router_addr, procs, tdir, fleet,
+                 router_box=None, addr_file=None) -> dict:
     """Seeded kill-any-subset drill over BOTH planes (ISSUE 16): a
     supervised multi-shard PS fleet takes a live training stream while
     the serving fleet takes lookup load; each round the ChaosEngine
@@ -2302,7 +2303,14 @@ def _chaos_drill(args, router_addr, procs, tdir, fleet) -> dict:
     confined to the documented recovery+hedge windows. A seeded subset
     of shard seats runs with an injected WAL fsync delay the whole time
     (the slow-disk fault). Replaces respawned serving handles in
-    ``procs``."""
+    ``procs``.
+
+    ISSUE 20: the ROUTER is a kill candidate too (it was the last
+    spared singleton). When the seeded draw takes it, the drill
+    respawns it on the same port (the `_router_kill_round` recipe) and
+    requires every live member to reconnect-with-backoff through the
+    outage — with the training plane's zero-acked-loss parity still
+    exact, since PS adds never route through the serving router."""
     from multiverso_tpu.fleet import (ChaosEngine, PSShardFleet,
                                       RemoteFleetView, ReplicaSupervisor,
                                       fetch_fleet_stats)
@@ -2355,6 +2363,12 @@ def _chaos_drill(args, router_addr, procs, tdir, fleet) -> dict:
         engine.register_kill(
             f"replica-{i}", lambda sig, i=i: _slot_signal(sup, i, sig),
             kinds=("kill",))
+    if router_box is not None:
+        # Control-plane seat: kill-only (a paused router is the
+        # liveness detector pausing itself — nothing to witness).
+        engine.register_kill(
+            "router", lambda sig: router_box[0].send_signal(sig),
+            kinds=("kill",))
 
     # Live training plane: a paced add stream whose every ack is
     # durable (-wal_sync_acks on every seat); `acked` is ground truth
@@ -2398,6 +2412,8 @@ def _chaos_drill(args, router_addr, procs, tdir, fleet) -> dict:
             serving_kill = any(f.kind == "kill" and
                                (f.target or "").startswith("replica-")
                                for f in faults)
+            router_kill = any(f.kind == "kill" and f.target == "router"
+                              for f in faults)
             sstats = _LoadStats()
             load_s = max(6.0, args.duration)
             loader = threading.Thread(
@@ -2412,16 +2428,33 @@ def _chaos_drill(args, router_addr, procs, tdir, fleet) -> dict:
                     _await_heartbeat_loss(router_addr, timeout_s=30)
 
             poller = None
-            if serving_kill:
+            # The heartbeat-loss detector lives IN the router: a round
+            # that kills the router cannot also demand the router's
+            # alert fired (the respawn starts a fresh alert engine).
+            if serving_kill and not router_kill:
                 poller = threading.Thread(target=poll_alert, daemon=True)
                 poller.start()
             loader.start()
             t0 = time.monotonic()
             applied = engine.run_round(faults)
+            if router_kill:
+                # Same-port respawn, the `_router_kill_round` recipe:
+                # reap the corpse, clear the stale announce, relaunch.
+                old_router = router_box[0]
+                try:
+                    old_router.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass
+                try:
+                    os.remove(addr_file)
+                except OSError:
+                    pass
+                router_box[0] = _spawn_router(args, tdir, addr_file,
+                                              port=router_addr[1])
             ps_ok = psf.wait_converged(timeout_s=180)
             t_ps = time.monotonic()
             serve_ok, t_serve = True, time.monotonic()
-            if serving_kill:
+            if serving_kill or router_kill:
                 serve_ok = False
                 deadline = time.monotonic() + 180
                 while time.monotonic() < deadline:
@@ -2457,14 +2490,16 @@ def _chaos_drill(args, router_addr, procs, tdir, fleet) -> dict:
                 "converged": bool(ps_ok and serve_ok),
                 "ps_converge_s": round(t_ps - t0, 3),
                 "serving_converge_s":
-                    round(t_serve - t0, 3) if serving_kill else None,
+                    round(t_serve - t0, 3)
+                    if (serving_kill or router_kill) else None,
+                "router_killed": router_kill,
                 "parity_ok": parity,
                 "acked_adds": n_adds[0],
                 "serving_errors_outside_window": errs_outside,
                 "serving_window": window,
                 "heartbeat_loss_alert":
                     alert_state.get("heartbeat_loss")
-                    if serving_kill else None,
+                    if (serving_kill and not router_kill) else None,
             })
     finally:
         train_stop.set()
@@ -2793,7 +2828,9 @@ def run_fleet(args) -> dict:
         # its random subset never fights their deterministic victims.
         chaos = None
         if args.chaos_drill:
-            chaos = _chaos_drill(args, router_addr, procs, tdir, fleet)
+            chaos = _chaos_drill(args, router_addr, procs, tdir, fleet,
+                                 router_box=router_box,
+                                 addr_file=addr_file)
             # Control-plane leg AFTER the subset rounds (the serving
             # supervisor is stopped by then — a router outage must not
             # race a healer that reads membership through the router).

@@ -43,7 +43,7 @@ def test_serve_bench_fleet_dry_run(tmp_path):
     assert line["replicas"] == 2
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v11"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v12"
     assert record["replicas"] == 2
 
     # Routed lookups bitwise-equal to the direct table gather.
@@ -251,7 +251,7 @@ def test_serve_bench_chaos_drill_dry_run(tmp_path):
     assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
 
     record = json.loads(out.read_text())
-    assert record["schema"] == "multiverso_tpu.bench_serve/v11"
+    assert record["schema"] == "multiverso_tpu.bench_serve/v12"
     chaos = record["chaos"]
     assert chaos["seed"] == 16
     assert chaos["shards"] == 2
